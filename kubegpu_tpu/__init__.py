@@ -1,0 +1,30 @@
+"""kubegpu_tpu — a TPU-native, topology-aware device scheduling framework.
+
+A ground-up rebuild of the capabilities of Microsoft's KubeGPU
+(reference: /root/reference) for Cloud TPU clusters:
+
+- a node-side **device layer** that enumerates TPU chips, HBM, and ICI links
+  and advertises them as a hierarchical resource inventory in node
+  annotations (reference: crishim/pkg/kubeadvertise, plugins/nvidiagpuplugin);
+- a device-agnostic **hierarchical group allocator** that performs
+  schedule-time device allocation with pluggable scorers and deterministic
+  backtracking search (reference: device-scheduler/grpalloc);
+- a **TPU scheduler plugin** that translates flat chip-count requests into
+  ICI-topology-aware group requests and enforces mesh contiguity
+  (reference: plugins/gpuschedulerplugin);
+- a standalone **scheduler engine** (queue, cache, assume/bind, preemption)
+  shaped like the modern scheduler-framework rather than a kube fork
+  (reference: kube-scheduler/pkg);
+- a **runtime hook** that rewrites container configs to inject
+  `TPU_VISIBLE_CHIPS` and vfio/accel device nodes (reference:
+  crishim/pkg/kubecri);
+- a JAX **workload layer**: given an allocation, builds a
+  `jax.sharding.Mesh` and runs SPMD training steps (data/tensor/sequence
+  parallel with ring attention for long context) — the "8-chip JAX job"
+  the scheduler places.
+
+The string resource grammar (see `kubegpu_tpu.core.grammar`) is the wire
+format, exactly as in the reference (`types/types.go:5-8`).
+"""
+
+__version__ = "0.1.0"

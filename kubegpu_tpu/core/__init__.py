@@ -1,0 +1,8 @@
+"""L1: shared types, the TPU resource-name grammar, and the annotation codec."""
+
+from kubegpu_tpu.core.types import (  # noqa: F401
+    DEVICE_GROUP_PREFIX,
+    ContainerInfo,
+    NodeInfo,
+    PodInfo,
+)

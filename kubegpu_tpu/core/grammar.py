@@ -1,0 +1,129 @@
+"""The TPU resource-name grammar — the system's de-facto data model.
+
+Mirrors the reference's grammar (`SURVEY.md` §4; reference
+`nvidia_gpu_manager.go:104-106,216-219`) with TPU semantics:
+
+- Group resources live under ``alpha/grpresource/<path>``.
+- Chip leaves::
+
+      alpha/grpresource/.../tpu/<chip-id>/chips   = 1
+      alpha/grpresource/.../tpu/<chip-id>/hbm     = bytes
+      alpha/grpresource/.../tpu/<chip-id>/enumLinks = ICI link-direction bitmask
+
+- Topology levels are prepended at discovery time, innermost first::
+
+      alpha/grpresource/tpugrp1/<i>/tpugrp0/<j>/tpu/<chip-id>/chips
+
+  ``tpugrp0`` groups chips that share a direct ICI neighborhood (e.g. a
+  2x2x1 sub-cube / tray); ``tpugrp1`` groups trays that share a host (the
+  DCN boundary).  This replaces the reference's NVLink P2P link-level
+  grouping (`nvidia_gpu_manager.go:93-121`).
+
+- Any leaf segment starting with ``enum`` is a bitmask resource matched by
+  the enum scorer (`resource/resourcetranslate.go:20-27`).
+
+- Chip ids encode ICI mesh coordinates: ``x.y.z`` (e.g. ``0.1.3``), so the
+  contiguity predicate can recover coordinates from the wire format alone.
+
+Pod-level knobs (in the pod annotation's ``requests``):
+
+- ``alpha.tpu/numchips``: flat chip count, translated into per-chip group
+  requests (analogue of ``alpha.gpu/numgpu``, `gpuplugintypes/types.go:7`).
+- ``alpha.tpu/hbm-per-chip``: optional minimum HBM bytes per requested chip.
+- ``alpha.tpu/tpu-generate-topology``: 0 = translate requests as-is;
+  1 = rewrite to the best-shaped inventory tree in the cluster
+  (analogue of ``alpha.gpu/gpu-generate-topology``, `gpu_scheduler.go:13-16`).
+"""
+
+from __future__ import annotations
+
+import re
+
+from kubegpu_tpu.core.types import DEVICE_GROUP_PREFIX
+
+# ---- leaf vocabulary -------------------------------------------------------
+
+TPU_LEAF = "tpu"          # the device level, like "gpu" in the reference
+CHIPS_SUFFIX = "chips"    # 1 per chip, like "cards"
+HBM_SUFFIX = "hbm"        # bytes of HBM, like "memory"
+LINKS_SUFFIX = "enumLinks"  # ICI link-direction bitmask (enum resource)
+
+# ---- topology levels (innermost -> outermost) ------------------------------
+
+TPU_GRP0 = "tpugrp0"  # direct ICI neighborhood (tray / sub-cube)
+TPU_GRP1 = "tpugrp1"  # host / DCN boundary
+TOPOLOGY_LEVELS = (TPU_GRP0, TPU_GRP1)
+
+# ---- pod-level request names ----------------------------------------------
+
+RESOURCE_NUM_CHIPS = "alpha.tpu/numchips"
+RESOURCE_HBM_PER_CHIP = "alpha.tpu/hbm-per-chip"
+TPU_TOPOLOGY_GENERATION = "alpha.tpu/tpu-generate-topology"
+
+_ENUM_RE = re.compile(r"\S*/(\S*)")
+_CHIP_FROM_PATH_RE = re.compile(rf".*/{TPU_LEAF}/([^/]+)/{CHIPS_SUFFIX}$")
+
+
+def is_group_resource(name: str) -> bool:
+    """True if the name is handled by the group allocator.
+
+    Reference: `resource/resourcetranslate.go:15-17`.
+    """
+    return name.startswith(DEVICE_GROUP_PREFIX)
+
+
+def prechecked_resource(name: str) -> bool:
+    """Resources outside the group prefix are the core scheduler's problem.
+
+    Reference: `resource/resourcetranslate.go:97-99`.
+    """
+    return not is_group_resource(name)
+
+
+def is_enum_resource(name: str) -> bool:
+    """Leaf segments starting with ``enum`` are bitmask-typed.
+
+    Reference: `resource/resourcetranslate.go:20-27`.
+    """
+    m = _ENUM_RE.match(name)
+    if m:
+        return m.group(1).lower().startswith("enum")
+    return False
+
+
+def chip_resource(chip_id: str, suffix: str, *levels: tuple) -> str:
+    """Build a full group-resource path for one chip attribute.
+
+    ``levels`` are (level_name, index) pairs, outermost first, e.g.
+    ``chip_resource("0.0.0", "chips", ("tpugrp1", 0), ("tpugrp0", 1))`` ->
+    ``alpha/grpresource/tpugrp1/0/tpugrp0/1/tpu/0.0.0/chips``.
+    """
+    parts = [DEVICE_GROUP_PREFIX]
+    for name, idx in levels:
+        parts.append(f"{name}/{idx}")
+    parts.append(f"{TPU_LEAF}/{chip_id}/{suffix}")
+    return "/".join(parts)
+
+
+def chip_id_from_path(path: str) -> str | None:
+    """Extract the chip id from a ``.../tpu/<chip-id>/chips`` path.
+
+    This is what the runtime hook uses to turn ``allocate_from`` values into
+    ``TPU_VISIBLE_CHIPS`` (reference analogue: UUID regex extraction,
+    `nvidia_gpu_manager.go:238-253`).
+    """
+    m = _CHIP_FROM_PATH_RE.match(path)
+    return m.group(1) if m else None
+
+
+def coords_from_chip_id(chip_id: str) -> tuple | None:
+    """Chip ids encode mesh coordinates as dot-separated ints, e.g. ``1.0.3``."""
+    parts = chip_id.split(".")
+    try:
+        return tuple(int(p) for p in parts)
+    except ValueError:
+        return None
+
+
+def chip_id_from_coords(coords) -> str:
+    return ".".join(str(int(c)) for c in coords)

@@ -1,0 +1,44 @@
+"""Small helpers shared across layers.
+
+Determinism is the framework's core correctness tool (reference:
+`utils/utils.go:34-47`, motivated in `docs/kubegpu.md:24-31`): every map
+iteration that feeds an allocation decision must be sorted so that repeated
+runs of the scheduler produce identical placements.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping
+
+
+def sorted_keys(m: Mapping[str, Any]) -> list[str]:
+    """Deterministic iteration order for any string-keyed mapping.
+
+    Reference: `utils/utils.go:34-47` (SortedStringKeys).
+    """
+    return sorted(m.keys())
+
+
+def assign_nested(d: dict, keys: Iterable[str], value: Any) -> None:
+    """Assign ``value`` at the nested path ``keys``, creating dicts on the way.
+
+    Reference: `utils/maputils.go:43-55` (AssignMap), without reflection —
+    Python dicts nest naturally.
+    """
+    keys = list(keys)
+    for k in keys[:-1]:
+        d = d.setdefault(k, {})
+    d[keys[-1]] = value
+
+
+def get_nested(d: Mapping, keys: Iterable[str], default: Any = None) -> Any:
+    """Fetch the value at nested path ``keys`` or ``default`` if absent.
+
+    Reference: `utils/maputils.go:57-68` (GetMap).
+    """
+    cur: Any = d
+    for k in keys:
+        if not isinstance(cur, Mapping) or k not in cur:
+            return default
+        cur = cur[k]
+    return cur

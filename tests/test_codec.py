@@ -1,0 +1,149 @@
+"""Annotation codec round-trip tests (reference: kubeinterface_test.go)."""
+
+import json
+
+from kubegpu_tpu.core import codec
+from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+
+
+def make_node_info():
+    return NodeInfo(
+        name="host0",
+        capacity={"alpha/grpresource/tpu/0.0.0/chips": 1, "cpu": 8},
+        allocatable={"alpha/grpresource/tpu/0.0.0/chips": 1, "cpu": 8},
+        used={},
+        scorer={"alpha/grpresource/tpu/0.0.0/chips": 0},
+    )
+
+
+def test_node_annotation_roundtrip_preserves_unrelated_annotations():
+    meta = {"name": "host0", "annotations": {"other": "keepme"}}
+    info = make_node_info()
+    codec.node_info_to_annotation(meta, info)
+    assert meta["annotations"]["other"] == "keepme"
+    decoded = codec.annotation_to_node_info(meta)
+    assert decoded.to_json() == info.to_json()
+
+
+def test_node_annotation_preserves_existing_used():
+    meta = {"name": "host0"}
+    codec.node_info_to_annotation(meta, make_node_info())
+    existing = NodeInfo(used={"alpha/grpresource/tpu/0.0.0/chips": 1})
+    decoded = codec.annotation_to_node_info(meta, existing)
+    assert decoded.used == {"alpha/grpresource/tpu/0.0.0/chips": 1}
+
+
+def test_node_annotation_missing_gives_empty():
+    decoded = codec.annotation_to_node_info({"name": "x"})
+    assert decoded.name == ""
+    assert decoded.allocatable == {}
+
+
+def test_pod_annotation_roundtrip():
+    pod = PodInfo(
+        name="p1",
+        node_name="host0",
+        requests={"alpha.tpu/numchips": 4},
+        running_containers={
+            "main": ContainerInfo(
+                requests={"alpha.tpu/numchips": 4},
+                dev_requests={"alpha/grpresource/tpu/0/chips": 1},
+                allocate_from={
+                    "alpha/grpresource/tpu/0/chips": "alpha/grpresource/tpu/0.0.0/chips"
+                },
+            )
+        },
+    )
+    meta = {"name": "p1"}
+    codec.pod_info_to_annotation(meta, pod)
+    kube_pod = {
+        "metadata": meta,
+        "spec": {"containers": [{"name": "main", "resources": {"requests": {"cpu": 2}}}]},
+    }
+    decoded = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
+    assert decoded.name == "p1"
+    assert decoded.node_name == "host0"
+    main = decoded.running_containers["main"]
+    assert main.kube_requests == {"cpu": 2}
+    assert main.allocate_from == {
+        "alpha/grpresource/tpu/0/chips": "alpha/grpresource/tpu/0.0.0/chips"
+    }
+
+
+def test_kube_pod_invalidation_resets_scheduler_output():
+    pod = PodInfo(
+        name="p1",
+        node_name="host0",
+        running_containers={
+            "main": ContainerInfo(
+                requests={"r": 2},
+                dev_requests={"stale": 1},
+                allocate_from={"stale": "loc"},
+            )
+        },
+    )
+    meta = {"name": "p1"}
+    codec.pod_info_to_annotation(meta, pod)
+    kube_pod = {"metadata": meta, "spec": {"containers": [{"name": "main"}]}}
+    decoded = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
+    main = decoded.running_containers["main"]
+    assert main.allocate_from == {}
+    assert main.dev_requests == {"r": 2}
+    assert decoded.node_name == ""
+
+
+def test_kube_pod_adds_spec_containers_not_in_annotation():
+    kube_pod = {
+        "metadata": {"name": "p2"},
+        "spec": {
+            "initContainers": [{"name": "init0", "resources": {"requests": {"cpu": 1}}}],
+            "containers": [{"name": "main"}],
+        },
+    }
+    decoded = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
+    assert "init0" in decoded.init_containers
+    assert decoded.init_containers["init0"].kube_requests == {"cpu": 1}
+    assert "main" in decoded.running_containers
+
+
+def test_annotation_is_stable_json():
+    meta1, meta2 = {"name": "a"}, {"name": "a"}
+    codec.node_info_to_annotation(meta1, make_node_info())
+    codec.node_info_to_annotation(meta2, make_node_info())
+    assert meta1["annotations"] == meta2["annotations"]
+    json.loads(meta1["annotations"][codec.NODE_ANNOTATION_KEY])
+
+
+def test_parse_quantity_kubernetes_strings():
+    from kubegpu_tpu.core.codec import parse_quantity
+
+    assert parse_quantity(2) == 2
+    assert parse_quantity("2") == 2
+    assert parse_quantity("500m") == 1  # Quantity.Value() rounds up
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("1500m") == 2
+    assert parse_quantity("1e3") == 1000
+    assert parse_quantity("2k") == 2000
+    import pytest
+
+    with pytest.raises(ValueError):
+        parse_quantity("garbage-units")
+
+
+def test_kube_pod_with_quantity_strings():
+    kube_pod = {
+        "metadata": {"name": "p3", "annotations": None},
+        "spec": {
+            "containers": [
+                {"name": "m", "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}}
+            ]
+        },
+    }
+    decoded = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
+    assert decoded.running_containers["m"].kube_requests == {"cpu": 1, "memory": 2**30}
+
+
+def test_annotation_write_tolerates_null_annotations():
+    meta = {"name": "n", "annotations": None}
+    codec.node_info_to_annotation(meta, make_node_info())
+    assert codec.NODE_ANNOTATION_KEY in meta["annotations"]

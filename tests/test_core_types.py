@@ -1,0 +1,50 @@
+"""L1 type tests: clone independence, container lookup, group helper."""
+
+from kubegpu_tpu.core.types import (
+    DEVICE_GROUP_PREFIX,
+    ContainerInfo,
+    NodeInfo,
+    PodInfo,
+    add_group_resource,
+)
+
+
+def test_add_group_resource_prefixes():
+    res = {}
+    add_group_resource(res, "tpu/0.0.0/chips", 1)
+    assert res == {f"{DEVICE_GROUP_PREFIX}/tpu/0.0.0/chips": 1}
+
+
+def test_node_info_clone_is_deep_for_maps():
+    n = NodeInfo(name="n1", capacity={"a": 1}, allocatable={"a": 1}, used={"a": 0})
+    c = n.clone()
+    c.used["a"] = 5
+    c.allocatable["b"] = 2
+    assert n.used["a"] == 0
+    assert "b" not in n.allocatable
+    assert c.name == "n1"
+
+
+def test_pod_container_lookup_prefers_init():
+    pod = PodInfo(name="p")
+    pod.init_containers["c"] = ContainerInfo(requests={"x": 1})
+    pod.running_containers["c"] = ContainerInfo(requests={"x": 2})
+    assert pod.container("c").requests["x"] == 1
+    assert pod.container("missing") is None
+
+
+def test_all_containers_order_is_running_then_init_sorted():
+    pod = PodInfo(name="p")
+    pod.running_containers["b"] = ContainerInfo()
+    pod.running_containers["a"] = ContainerInfo()
+    pod.init_containers["z"] = ContainerInfo()
+    order = [(n, init) for n, _, init in pod.all_containers()]
+    assert order == [("a", False), ("b", False), ("z", True)]
+
+
+def test_pod_clone_independent():
+    pod = PodInfo(name="p")
+    pod.running_containers["c"] = ContainerInfo(requests={"x": 1})
+    c = pod.clone()
+    c.running_containers["c"].requests["x"] = 9
+    assert pod.running_containers["c"].requests["x"] == 1

@@ -1,0 +1,46 @@
+"""Resource-name grammar tests (SURVEY.md §4 equivalents)."""
+
+from kubegpu_tpu.core import grammar
+from kubegpu_tpu.core.types import DEVICE_GROUP_PREFIX
+
+
+def test_chip_resource_flat():
+    assert (
+        grammar.chip_resource("0.0.0", "chips")
+        == f"{DEVICE_GROUP_PREFIX}/tpu/0.0.0/chips"
+    )
+
+
+def test_chip_resource_with_levels():
+    path = grammar.chip_resource(
+        "1.0.3", "hbm", (grammar.TPU_GRP1, 0), (grammar.TPU_GRP0, 2)
+    )
+    assert path == f"{DEVICE_GROUP_PREFIX}/tpugrp1/0/tpugrp0/2/tpu/1.0.3/hbm"
+
+
+def test_is_group_and_prechecked():
+    grp = grammar.chip_resource("0.0.0", "chips")
+    assert grammar.is_group_resource(grp)
+    assert not grammar.prechecked_resource(grp)
+    assert grammar.prechecked_resource("cpu")
+    assert grammar.prechecked_resource(grammar.RESOURCE_NUM_CHIPS)
+
+
+def test_enum_resource_detection():
+    assert grammar.is_enum_resource(
+        grammar.chip_resource("0.0.0", grammar.LINKS_SUFFIX)
+    )
+    assert grammar.is_enum_resource("alpha/grpresource/tpu/x/enumFoo")
+    assert not grammar.is_enum_resource(grammar.chip_resource("0.0.0", "chips"))
+    assert not grammar.is_enum_resource("plainname")
+
+
+def test_chip_id_extraction_roundtrip():
+    path = grammar.chip_resource(
+        "1.2.3", grammar.CHIPS_SUFFIX, (grammar.TPU_GRP1, 4), (grammar.TPU_GRP0, 7)
+    )
+    assert grammar.chip_id_from_path(path) == "1.2.3"
+    assert grammar.chip_id_from_path("not/a/chip/path") is None
+    assert grammar.coords_from_chip_id("1.2.3") == (1, 2, 3)
+    assert grammar.chip_id_from_coords((1, 2, 3)) == "1.2.3"
+    assert grammar.coords_from_chip_id("uuid-style") is None
