@@ -1,0 +1,13 @@
+"""L2: the device-agnostic hierarchical group allocator.
+
+Reference: `device-scheduler/grpalloc/` — the scheduling heart. Pure
+functions over L1 types; no Kubernetes, no devices, no I/O.
+"""
+
+from kubegpu_tpu.allocator.grpalloc import (  # noqa: F401
+    compute_pod_group_resources,
+    pod_clear_allocate_from,
+    pod_fits_group_constraints,
+    return_pod_group_resource,
+    take_pod_group_resource,
+)

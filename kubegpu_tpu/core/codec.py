@@ -31,23 +31,27 @@ _QUANTITY_SUFFIXES = {
     "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
     "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
 }
-_QUANTITY_RE = re.compile(r"^([+-]?[0-9.eE+-]+?)(m|[kMGTPE]i?|)$")
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9.eE+-]+?)([A-Za-z]*)$")
 
 
 def parse_quantity(val) -> int:
     """Parse a Kubernetes resource quantity to a whole number, rounding up.
 
     Accepts ints/floats directly and strings like ``"2"``, ``"500m"``,
-    ``"1Gi"``, ``"1e3"``. Mirrors ``resource.Quantity.Value()`` semantics
-    (round up), so ``"500m"`` -> 1.
+    ``"1Ki"``, ``"1Gi"``, ``"1e3"``. Mirrors ``resource.Quantity.Value()``
+    semantics (round up), so ``"500m"`` -> 1.
     """
     if isinstance(val, (int, float)):
         return math.ceil(val)
     m = _QUANTITY_RE.match(str(val).strip())
-    if not m:
+    if not m or m.group(2) not in _QUANTITY_SUFFIXES:
         raise ValueError(f"invalid quantity: {val!r}")
     number, suffix = m.groups()
-    return math.ceil(float(number) * _QUANTITY_SUFFIXES[suffix])
+    try:
+        parsed = float(number)
+    except ValueError:
+        raise ValueError(f"invalid quantity: {val!r}") from None
+    return math.ceil(parsed * _QUANTITY_SUFFIXES[suffix])
 
 
 def _annotations(meta: dict) -> dict:

@@ -147,3 +147,18 @@ def test_annotation_write_tolerates_null_annotations():
     meta = {"name": "n", "annotations": None}
     codec.node_info_to_annotation(meta, make_node_info())
     assert codec.NODE_ANNOTATION_KEY in meta["annotations"]
+
+
+def test_parse_quantity_ki_suffix_and_bad_suffix():
+    from kubegpu_tpu.core.codec import parse_quantity
+
+    import pytest
+
+    assert parse_quantity("500Ki") == 500 * 1024
+    assert parse_quantity("2Mi") == 2 * 2**20
+    with pytest.raises(ValueError):
+        parse_quantity("1ki")  # lowercase ki is not a Kubernetes suffix
+    with pytest.raises(ValueError):
+        parse_quantity("1Xi")
+    with pytest.raises(ValueError):
+        parse_quantity("--5")

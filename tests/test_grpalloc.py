@@ -1,0 +1,315 @@
+"""Group-allocator scenario tests.
+
+Ports the reference's exact-placement scenario table
+(`plugins/gpuschedulerplugin/devicescheduler_test.go`) to TPU names:
+gpu->tpu, cards->chips, memory->hbm, gpugrp0/1->tpugrp0/1, enumType->
+enumLinks. Expected placements and scores are properties of the allocation
+semantics, so they must reproduce exactly (scores within 1%, as in the
+reference's assertions at `devicescheduler_test.go:296-324`).
+"""
+
+import pytest
+
+from kubegpu_tpu.allocator.grpalloc import (
+    compute_pod_group_resources,
+    pod_clear_allocate_from,
+    pod_fits_group_constraints,
+    return_pod_group_resource,
+    take_pod_group_resource,
+)
+from kubegpu_tpu.allocator.translate import translate_resource
+from kubegpu_tpu.core.types import DEVICE_GROUP_PREFIX, ContainerInfo, NodeInfo, PodInfo
+
+G = DEVICE_GROUP_PREFIX
+
+
+def make_node(grpres, res=None, name="node1"):
+    alloc = {k: v for k, v in (res or {}).items()}
+    alloc.update({f"{G}/{k}": v for k, v in grpres.items()})
+    return NodeInfo(name=name, capacity=dict(alloc), allocatable=dict(alloc))
+
+
+def make_cont(grpres=None, res=None):
+    reqs = {k: v for k, v in (res or {}).items()}
+    reqs.update({f"{G}/{k}": v for k, v in (grpres or {}).items()})
+    return ContainerInfo(requests=dict(reqs), dev_requests=dict(reqs),
+                         kube_requests={k: v for k, v in (res or {}).items()})
+
+
+def make_pod(name, iconts, rconts):
+    pod = PodInfo(name=name)
+    for cname, cont in iconts.items():
+        pod.init_containers[cname] = cont
+    for cname, cont in rconts.items():
+        pod.running_containers[cname] = cont
+    return pod
+
+
+def translate_pod(node, pod):
+    """Apply the standard two-stage topology promotion, as the TPU scheduler
+    plugin will (reference analogue: `gpu.go:55-59`)."""
+    for cont in list(pod.init_containers.values()) + list(pod.running_containers.values()):
+        for this_stage, next_stage in (("tpugrp0", "tpu"), ("tpugrp1", "tpugrp0")):
+            _, cont.dev_requests = translate_resource(
+                node.allocatable, cont.dev_requests, this_stage, next_stage)
+
+
+def expand_expected(expected, grpres):
+    """Expand {request-prefix: device-prefix} across the container's resource
+    suffixes, as the reference test helper does
+    (`devicescheduler_test.go:125-163`)."""
+    out = {}
+    for key, val in expected.items():
+        for res_key in grpres:
+            prefix, suffix = res_key.rsplit("/", 1)
+            if key.endswith(prefix):
+                out[f"{G}/{key}/{suffix}"] = f"{G}/{val}/{suffix}"
+    return out
+
+
+def assert_pod_alloc(node, pod, expected_by_cont, expected_score):
+    found, reasons, score = pod_fits_group_constraints(node, pod, allocating=True)
+    assert found, [str(r) for r in reasons]
+    assert score == pytest.approx(expected_score, rel=0.01)
+    for cname, expected in expected_by_cont.items():
+        cont = pod.container(cname)
+        assert cont.allocate_from == expected, (
+            f"{cname}: got {sorted(cont.allocate_from.items())}, "
+            f"expected {sorted(expected.items())}"
+        )
+    # Idempotent re-check: second fit goes through the re-score path and must
+    # agree (`grpallocate.go:471-480`).
+    found2, _, score2 = pod_fits_group_constraints(node, pod, allocating=True)
+    assert found2
+    assert score2 == pytest.approx(score, rel=0.01)
+    # Accounting: take, verify, then returning drains node usage to zero.
+    take_pod_group_resource(node, pod)
+    pod_resources, node_resources = compute_pod_group_resources(node, pod, False)
+    assert pod_resources
+    _, drained = compute_pod_group_resources(node, pod, True)
+    for res, amt in drained.items():
+        assert amt == 0, f"{res} not drained: {amt}"
+    return_pod_group_resource(node, pod)
+    for res, amt in node.used.items():
+        assert amt == 0, f"{res} still used after return: {amt}"
+
+
+FLAT_NODE_ENUM = {
+    "tpu/dev0/hbm": 100000, "tpu/dev0/chips": 1,
+    "tpu/dev1/hbm": 256000, "tpu/dev1/chips": 1, "tpu/dev1/enumLinks": 0x1,
+    "tpu/dev2/hbm": 257000, "tpu/dev2/chips": 1,
+    "tpu/dev3/hbm": 192000, "tpu/dev3/chips": 1, "tpu/dev3/enumLinks": 0x1,
+    "tpu/dev4/hbm": 178000, "tpu/dev4/chips": 1,
+}
+
+
+def test_flat_node_mixed_requests_with_enum():
+    """Reference pod1: hbm+chips+enum requests on a flat 5-chip node."""
+    node = make_node(FLAT_NODE_ENUM, res={"A1": 4000, "B1": 3000})
+    init_grpres = {"tpu/0/hbm": 100000, "tpu/0/chips": 1}
+    run0_grpres = {"tpu/a/hbm": 256000, "tpu/a/chips": 1,
+                   "tpu/b/hbm": 178000, "tpu/b/chips": 1}
+    run1_grpres = {"tpu/0/hbm": 190000, "tpu/0/chips": 1, "tpu/0/enumLinks": 0x3}
+    pod = make_pod(
+        "pod1",
+        {"Init0": make_cont(init_grpres, {"A1": 2200, "B1": 2000})},
+        {"Run0": make_cont(run0_grpres, {"A1": 3000, "B1": 1000}),
+         "Run1": make_cont(run1_grpres, {"A1": 1000, "B1": 2000})},
+    )
+    translate_pod(node, pod)
+    assert_pod_alloc(node, pod, {
+        "Init0": expand_expected({"tpu/0": "tpu/dev4"}, init_grpres),
+        "Run0": expand_expected({"tpu/a": "tpu/dev2", "tpu/b": "tpu/dev4"}, run0_grpres),
+        "Run1": expand_expected({"tpu/0": "tpu/dev3"}, run1_grpres),
+    }, expected_score=0.58214)
+
+
+def test_flat_node_init_larger_than_running():
+    """Reference pod1 variant: init container needs the biggest chip."""
+    node = make_node(FLAT_NODE_ENUM, res={"A1": 4000, "B1": 3000})
+    init_grpres = {"tpu/0/hbm": 257000, "tpu/0/chips": 1}
+    run0_grpres = {"tpu/a/hbm": 256000, "tpu/a/chips": 1,
+                   "tpu/b/hbm": 178000, "tpu/b/chips": 1}
+    run1_grpres = {"tpu/0/hbm": 190000, "tpu/0/chips": 1, "tpu/0/enumLinks": 0x3}
+    pod = make_pod(
+        "pod1b",
+        {"Init0": make_cont(init_grpres, {"A1": 2200, "B1": 2000})},
+        {"Run0": make_cont(run0_grpres, {"A1": 3000, "B1": 1000}),
+         "Run1": make_cont(run1_grpres, {"A1": 1000, "B1": 2000})},
+    )
+    translate_pod(node, pod)
+    assert_pod_alloc(node, pod, {
+        "Init0": expand_expected({"tpu/0": "tpu/dev2"}, init_grpres),
+        "Run0": expand_expected({"tpu/a": "tpu/dev2", "tpu/b": "tpu/dev4"}, run0_grpres),
+        "Run1": expand_expected({"tpu/0": "tpu/dev3"}, run1_grpres),
+    }, expected_score=0.58214)
+
+
+def test_flat_node_chip_count_only():
+    """Reference pod2: chips-only requests (the numchips translation output)."""
+    node = make_node({
+        "tpu/dev0/hbm": 100000, "tpu/dev0/chips": 1,
+        "tpu/dev1/hbm": 256000, "tpu/dev1/chips": 1,
+        "tpu/dev2/hbm": 257000, "tpu/dev2/chips": 1,
+        "tpu/dev3/hbm": 192000, "tpu/dev3/chips": 1,
+        "tpu/dev4/hbm": 178000, "tpu/dev4/chips": 1,
+    }, res={"A1": 4000, "B1": 3000})
+    init_grpres = {"tpu/0/chips": 1}
+    run0_grpres = {"tpu/0/chips": 1, "tpu/1/chips": 1}
+    run1_grpres = {"tpu/0/chips": 1}
+    pod = make_pod(
+        "pod2",
+        {"Init0": make_cont(init_grpres)},
+        {"Run0": make_cont(run0_grpres), "Run1": make_cont(run1_grpres)},
+    )
+    translate_pod(node, pod)
+    assert_pod_alloc(node, pod, {
+        "Init0": expand_expected({"tpu/0": "tpu/dev4"}, init_grpres),
+        "Run0": expand_expected({"tpu/0": "tpu/dev4", "tpu/1": "tpu/dev3"}, run0_grpres),
+        "Run1": expand_expected({"tpu/0": "tpu/dev2"}, run1_grpres),
+    }, expected_score=0.3)
+
+
+def test_two_level_affinity_groups():
+    """Reference pod3: tpugrp0 affinity groups + promotion of flat requests."""
+    node = make_node({
+        "tpugrp0/group0/tpu/dev0/hbm": 100000, "tpugrp0/group0/tpu/dev0/chips": 1,
+        "tpugrp0/group0/tpu/dev1/hbm": 256000, "tpugrp0/group0/tpu/dev1/chips": 1,
+        "tpugrp0/group1/tpu/dev2/hbm": 257000, "tpugrp0/group1/tpu/dev2/chips": 1,
+        "tpugrp0/group2/tpu/dev3/hbm": 192000, "tpugrp0/group2/tpu/dev3/chips": 1,
+        "tpugrp0/group2/tpu/dev4/hbm": 178000, "tpugrp0/group2/tpu/dev4/chips": 1,
+    }, res={"A1": 4000, "B1": 3000})
+    init_grpres = {"tpu/0/hbm": 100000, "tpu/0/chips": 1}
+    run0_grpres = {"tpugrp0/A/tpu/a/hbm": 190000, "tpugrp0/A/tpu/a/chips": 1,
+                   "tpugrp0/A/tpu/b/hbm": 178000, "tpugrp0/A/tpu/b/chips": 1}
+    run1_grpres = {"tpu/0/hbm": 256000, "tpu/0/chips": 1}
+    run2_grpres = {"tpu/0/hbm": 256000, "tpu/0/chips": 1,
+                   "tpu/1/hbm": 100000, "tpu/1/chips": 1}
+    pod = make_pod(
+        "pod3",
+        {"Init0": make_cont(init_grpres)},
+        {"Run0": make_cont(run0_grpres),
+         "Run1": make_cont(run1_grpres),
+         "Run2": make_cont(run2_grpres)},
+    )
+    translate_pod(node, pod)
+    assert_pod_alloc(node, pod, {
+        "Init0": expand_expected(
+            {"tpugrp0/0/tpu/0": "tpugrp0/group0/tpu/dev1"}, init_grpres),
+        "Run0": expand_expected(
+            {"tpugrp0/A/tpu/a": "tpugrp0/group2/tpu/dev3",
+             "tpugrp0/A/tpu/b": "tpugrp0/group2/tpu/dev4"}, run0_grpres),
+        "Run1": expand_expected(
+            {"tpugrp0/0/tpu/0": "tpugrp0/group1/tpu/dev2"}, run1_grpres),
+        "Run2": expand_expected(
+            {"tpugrp0/0/tpu/0": "tpugrp0/group0/tpu/dev1",
+             "tpugrp0/1/tpu/1": "tpugrp0/group0/tpu/dev0"}, run2_grpres),
+    }, expected_score=0.9985692)
+
+
+THREE_LEVEL_NODE = {
+    "tpugrp1/0/tpugrp0/0/tpu/dev0/hbm": 100000, "tpugrp1/0/tpugrp0/0/tpu/dev0/chips": 1,
+    "tpugrp1/0/tpugrp0/0/tpu/dev1/hbm": 256000, "tpugrp1/0/tpugrp0/0/tpu/dev1/chips": 1,
+    "tpugrp1/0/tpugrp0/1/tpu/dev2/hbm": 257000, "tpugrp1/0/tpugrp0/1/tpu/dev2/chips": 1,
+    "tpugrp1/0/tpugrp0/1/tpu/dev3/hbm": 192000, "tpugrp1/0/tpugrp0/1/tpu/dev3/chips": 1,
+    "tpugrp1/1/tpugrp0/2/tpu/dev4/hbm": 178000, "tpugrp1/1/tpugrp0/2/tpu/dev4/chips": 1,
+    "tpugrp1/1/tpugrp0/2/tpu/dev5/hbm": 100000, "tpugrp1/1/tpugrp0/2/tpu/dev5/chips": 1,
+    "tpugrp1/1/tpugrp0/3/tpu/dev6/hbm": 256000, "tpugrp1/1/tpugrp0/3/tpu/dev6/chips": 1,
+    "tpugrp1/1/tpugrp0/3/tpu/dev7/hbm": 257000, "tpugrp1/1/tpugrp0/3/tpu/dev7/chips": 1,
+}
+
+
+def test_three_level_pair_lands_in_one_neighborhood():
+    """Reference pod4: a 2-chip affinity pair stays inside one tpugrp0."""
+    node = make_node(THREE_LEVEL_NODE, res={"A1": 4000, "B1": 3000})
+    run0_grpres = {"tpugrp0/A/tpu/a/chips": 1, "tpugrp0/A/tpu/b/chips": 1}
+    pod = make_pod("pod4", {}, {"Run0": make_cont(run0_grpres)})
+    translate_pod(node, pod)
+    assert_pod_alloc(node, pod, {
+        "Run0": expand_expected(
+            {"tpugrp1/0/tpugrp0/A/tpu/a": "tpugrp1/1/tpugrp0/3/tpu/dev7",
+             "tpugrp1/0/tpugrp0/A/tpu/b": "tpugrp1/1/tpugrp0/3/tpu/dev6"}, run0_grpres),
+    }, expected_score=0.125)
+
+
+def test_three_level_cross_group_split():
+    """Reference pod5: 6 chips split 4+2 across tpugrp1 units."""
+    node = make_node(THREE_LEVEL_NODE, res={"A1": 4000, "B1": 3000})
+    run0_grpres = {
+        "tpugrp1/0/tpugrp0/A/tpu/a/chips": 1,
+        "tpugrp1/0/tpugrp0/B/tpu/b/chips": 1,
+        "tpugrp1/0/tpugrp0/C/tpu/c/chips": 1,
+        "tpugrp1/0/tpugrp0/D/tpu/d/chips": 1,
+        "tpugrp0/A/tpu/a/chips": 1,
+        "tpugrp0/A/tpu/b/chips": 1,
+    }
+    pod = make_pod("pod5", {}, {"Run0": make_cont(run0_grpres)})
+    translate_pod(node, pod)
+    assert_pod_alloc(node, pod, {
+        "Run0": expand_expected({
+            "tpugrp1/0/tpugrp0/A/tpu/a": "tpugrp1/1/tpugrp0/3/tpu/dev7",
+            "tpugrp1/0/tpugrp0/B/tpu/b": "tpugrp1/1/tpugrp0/3/tpu/dev6",
+            "tpugrp1/0/tpugrp0/C/tpu/c": "tpugrp1/1/tpugrp0/2/tpu/dev5",
+            "tpugrp1/0/tpugrp0/D/tpu/d": "tpugrp1/1/tpugrp0/2/tpu/dev4",
+            "tpugrp1/1/tpugrp0/A/tpu/a": "tpugrp1/0/tpugrp0/1/tpu/dev3",
+            "tpugrp1/1/tpugrp0/A/tpu/b": "tpugrp1/0/tpugrp0/1/tpu/dev2",
+        }, run0_grpres),
+    }, expected_score=0.375)
+
+
+def test_unsatisfiable_request_reports_reasons():
+    node = make_node({"tpu/dev0/hbm": 100, "tpu/dev0/chips": 1})
+    pod = make_pod("podx", {}, {"Run0": make_cont({"tpu/0/hbm": 500, "tpu/0/chips": 1})})
+    found, reasons, _ = pod_fits_group_constraints(node, pod, allocating=False)
+    assert not found
+    assert reasons and all("Insufficient" in str(r) for r in reasons)
+    # and the failed fit must not leave a partial placement behind
+    assert pod.running_containers["Run0"].allocate_from == {}
+
+
+def test_more_chips_than_available_fails():
+    node = make_node({"tpu/dev0/chips": 1, "tpu/dev1/chips": 1})
+    pod = make_pod("podx", {}, {"Run0": make_cont(
+        {"tpu/0/chips": 1, "tpu/1/chips": 1, "tpu/2/chips": 1})})
+    found, _, _ = pod_fits_group_constraints(node, pod, allocating=False)
+    assert not found
+
+
+def test_clear_allocate_from_allows_replacement():
+    node = make_node({"tpu/dev0/chips": 1, "tpu/dev1/chips": 1})
+    pod = make_pod("podx", {}, {"Run0": make_cont({"tpu/0/chips": 1})})
+    found, _, _ = pod_fits_group_constraints(node, pod, allocating=True)
+    assert found
+    before = dict(pod.running_containers["Run0"].allocate_from)
+    assert before
+    pod_clear_allocate_from(pod)
+    assert pod.running_containers["Run0"].allocate_from == {}
+    found2, _, _ = pod_fits_group_constraints(node, pod, allocating=True)
+    assert found2
+    assert pod.running_containers["Run0"].allocate_from == before  # deterministic
+
+
+def test_two_pods_sequential_accounting():
+    """Take one pod's chips, second pod must land on the remaining chip."""
+    node = make_node({"tpu/dev0/chips": 1, "tpu/dev1/chips": 1})
+    pod_a = make_pod("a", {}, {"Run0": make_cont({"tpu/0/chips": 1})})
+    found, _, _ = pod_fits_group_constraints(node, pod_a, allocating=True)
+    assert found
+    take_pod_group_resource(node, pod_a)
+    taken = set(pod_a.running_containers["Run0"].allocate_from.values())
+
+    pod_b = make_pod("b", {}, {"Run0": make_cont({"tpu/0/chips": 1})})
+    found_b, _, _ = pod_fits_group_constraints(node, pod_b, allocating=True)
+    assert found_b
+    got = set(pod_b.running_containers["Run0"].allocate_from.values())
+    assert got.isdisjoint(taken)
+
+    # a third pod cannot fit
+    pod_c = make_pod("c", {}, {"Run0": make_cont({"tpu/0/chips": 1})})
+    take_pod_group_resource(node, pod_b)
+    found_c, _, _ = pod_fits_group_constraints(node, pod_c, allocating=False)
+    assert not found_c
+    # release pod_a -> fits again
+    return_pod_group_resource(node, pod_a)
+    found_c2, _, _ = pod_fits_group_constraints(node, pod_c, allocating=False)
+    assert found_c2

@@ -1,0 +1,69 @@
+"""Scorer semantics tests (reference: grpalloc/scorer/scorer.go)."""
+
+import pytest
+
+from kubegpu_tpu.allocator import scorers
+from kubegpu_tpu.core import grammar
+
+
+def test_leftover_basic_fit_and_score():
+    r = scorers.leftover_score(10, 0, 0, [4], False)
+    assert r.found and r.used_by_container == 4
+    assert r.new_used_by_pod == 4 and r.new_used_by_node == 4
+    assert r.score == pytest.approx(0.4)
+
+
+def test_leftover_rejects_overcommit():
+    r = scorers.leftover_score(4, 0, 3, [2], False)
+    assert not r.found
+    assert r.new_used_by_node == 5
+
+
+def test_leftover_zero_allocatable_scores_zero():
+    r = scorers.leftover_score(0, 0, 0, [], False)
+    assert r.found and r.score == 0.0
+
+
+def test_leftover_init_container_max_not_sum():
+    # Init containers run before main containers: demand overlaps.
+    r = scorers.leftover_score(10, 6, 6, [4], True)
+    assert r.found
+    assert r.new_used_by_pod == 6  # max(6, 4)
+    assert r.new_used_by_node == 6  # unchanged
+    r2 = scorers.leftover_score(10, 6, 6, [9], True)
+    assert r2.new_used_by_pod == 9
+    assert r2.new_used_by_node == 9
+
+
+def test_enum_match_any_bit():
+    r = scorers.enum_score(0b0101, 0, 0, [0b0100], False)
+    assert r.found
+    assert r.new_used_by_pod == 0b0100
+    assert r.new_used_by_node == 0  # attributes are not consumed
+    assert r.score == pytest.approx(0.5)
+
+
+def test_enum_no_overlap_fails():
+    r = scorers.enum_score(0b0101, 0, 0, [0b1010], False)
+    assert not r.found
+
+
+def test_enum_empty_request_found():
+    r = scorers.enum_score(0b11, 0, 0, [], False)
+    assert r.found and r.score == 0.0
+
+
+def test_always_found_never_rejects():
+    r = scorers.always_found_score(4, 0, 3, [2], False)
+    assert r.found
+
+
+def test_default_scorer_routing():
+    chips = grammar.chip_resource("0.0.0", grammar.CHIPS_SUFFIX)
+    links = grammar.chip_resource("0.0.0", grammar.LINKS_SUFFIX)
+    assert scorers.default_scorer(chips) is scorers.leftover_score
+    assert scorers.default_scorer(links) is scorers.enum_score
+    assert scorers.default_scorer("cpu") is None
+    assert scorers.scorer_for(chips, scorers.ENUM_LEFTOVER_SCORER) is scorers.enum_score
+    assert scorers.scorer_for(links, scorers.LEFTOVER_SCORER) is scorers.leftover_score
+    assert scorers.scorer_for(chips, 99) is None

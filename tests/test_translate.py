@@ -1,0 +1,68 @@
+"""Topology-promotion tests (reference: grpalloc/resource/resourcetranslate.go)."""
+
+from kubegpu_tpu.allocator.translate import InsufficientResourceError, translate_resource
+from kubegpu_tpu.core.types import DEVICE_GROUP_PREFIX
+
+G = DEVICE_GROUP_PREFIX
+
+
+def test_noop_when_node_is_flat():
+    node = {f"{G}/tpu/dev0/chips": 1}
+    reqs = {f"{G}/tpu/0/chips": 1}
+    modified, out = translate_resource(node, reqs, "tpugrp0", "tpu")
+    assert not modified and out is reqs
+
+
+def test_promotes_one_level_with_deterministic_indices():
+    node = {f"{G}/tpugrp0/g0/tpu/devA/chips": 1}
+    reqs = {
+        f"{G}/tpu/1/chips": 1,
+        f"{G}/tpu/1/hbm": 5,
+        f"{G}/tpu/0/chips": 1,
+    }
+    modified, out = translate_resource(node, reqs, "tpugrp0", "tpu")
+    assert modified
+    # sorted-key iteration: tpu/0 seen first -> index 0, tpu/1 -> index 1
+    assert out == {
+        f"{G}/tpugrp0/0/tpu/0/chips": 1,
+        f"{G}/tpugrp0/1/tpu/1/chips": 1,
+        f"{G}/tpugrp0/1/tpu/1/hbm": 5,
+    }
+
+
+def test_existing_staged_requests_keep_indices_and_new_start_past_max():
+    node = {f"{G}/tpugrp0/g0/tpu/devA/chips": 1}
+    reqs = {
+        f"{G}/tpugrp0/3/tpu/x/chips": 1,
+        f"{G}/tpu/y/chips": 1,
+    }
+    modified, out = translate_resource(node, reqs, "tpugrp0", "tpu")
+    assert modified
+    assert out == {
+        f"{G}/tpugrp0/3/tpu/x/chips": 1,
+        f"{G}/tpugrp0/4/tpu/y/chips": 1,
+    }
+
+
+def test_same_group_shares_new_index():
+    node = {f"{G}/tpugrp1/0/tpugrp0/0/tpu/devA/chips": 1}
+    reqs = {
+        f"{G}/tpugrp0/A/tpu/a/chips": 1,
+        f"{G}/tpugrp0/A/tpu/b/chips": 1,
+        f"{G}/tpugrp0/B/tpu/c/chips": 1,
+    }
+    modified, out = translate_resource(node, reqs, "tpugrp1", "tpugrp0")
+    assert modified
+    assert out == {
+        f"{G}/tpugrp1/0/tpugrp0/A/tpu/a/chips": 1,
+        f"{G}/tpugrp1/0/tpugrp0/A/tpu/b/chips": 1,
+        f"{G}/tpugrp1/1/tpugrp0/B/tpu/c/chips": 1,
+    }
+
+
+def test_insufficient_resource_error_carries_info():
+    e = InsufficientResourceError("x/y", 4, 1, 2)
+    assert e.reason() == "Insufficient x/y"
+    assert e.info() == ("x/y", 4, 1, 2)
+    assert e == InsufficientResourceError("x/y", 4, 1, 2)
+    assert e != InsufficientResourceError("x/z", 4, 1, 2)
