@@ -360,14 +360,17 @@ def _container_fits_group_constraints(
         node_resource=node_resource,
     )
 
-    if not cont.allocate_from:
+    if not cont.allocate_from and required:
         found, reasons = grp.allocate_group()
         score = grp.score
         if set_allocate_from:
             cont.allocate_from = dict(grp.allocate_from)
     else:
         # allocate_from already decided (by a previous pass or a scheduler
-        # restart): re-validate and re-score only, never re-place.
+        # restart), or the container has no group requests: re-validate and
+        # re-score only, never re-place (`grpallocate.go:461,471-480` — in
+        # every reference flow AllocateFrom is non-nil, so its condition
+        # reduces to "allocate iff requests exist and no placement yet").
         grp.allocate_from = dict(cont.allocate_from)
         found, reasons = grp._find_score_and_update(grp_name)
         score = grp.score

@@ -13,9 +13,18 @@ cannot satisfy a request (`resourcetranslate.go:101-126`).
 
 from __future__ import annotations
 
+import functools
 import re
 
 from kubegpu_tpu.utils import sorted_keys
+
+
+@functools.lru_cache(maxsize=256)
+def _stage_patterns(this_stage: str, next_stage: str):
+    return (
+        re.compile(rf".*/{this_stage}/(.*?)/{next_stage}(.*)"),
+        re.compile(rf"(.*?/){next_stage}/((.*?)/(.*))"),
+    )
 
 
 def translate_resource(
@@ -33,7 +42,7 @@ def translate_resource(
     distinct ``next_stage`` group, assigned in sorted-key order so the
     rewrite is deterministic (`resourcetranslate.go:52-94`).
     """
-    staged_re = re.compile(rf".*/{this_stage}/(.*?)/{next_stage}(.*)")
+    staged_re, promote_re = _stage_patterns(this_stage, next_stage)
     # Does the node nest next_stage under this_stage at all?
     if not any(staged_re.match(res) for res in node_resources):
         return False, container_requests
@@ -48,7 +57,6 @@ def translate_resource(
                 pass
 
     next_index = max_index + 1
-    promote_re = re.compile(rf"(.*?/){next_stage}/((.*?)/(.*))")
     group_map: dict = {}
     new_requests: dict = {}
     modified = False

@@ -1,0 +1,10 @@
+"""In-process cluster substrate: the API-server fake and end-to-end wiring.
+
+The Kubernetes API server is the only transport between components
+(SURVEY.md §1) — annotations on Node/Pod objects are the wire protocol — so
+an in-memory implementation of that narrow surface lets the whole framework
+run and be tested without a cluster, exactly as the reference tests itself
+with constructed NodeInfo/PodInfo structs (SURVEY.md §5).
+"""
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer  # noqa: F401

@@ -1,0 +1,145 @@
+"""A thread-safe in-memory stand-in for the Kubernetes API server surface
+this framework uses: node/pod objects (plain JSON-shaped dicts), metadata
+patching, binding, and change notification.
+
+Only the operations the reference performs are modeled
+(`kubeinterface.go:145-193`, scheduler bind at `scheduler.go:405-417`):
+get/patch node metadata, get/update pod annotations, bind.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(RuntimeError):
+    pass
+
+
+def _merge(dst: dict, patch: dict) -> None:
+    """Strategic-merge-patch for the metadata shapes we carry: dicts merge
+    recursively, everything else replaces."""
+    for key, val in patch.items():
+        if isinstance(val, dict) and isinstance(dst.get(key), dict):
+            _merge(dst[key], val)
+        else:
+            dst[key] = copy.deepcopy(val)
+
+
+class InMemoryAPIServer:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: dict = {}
+        self._pods: dict = {}
+        self._watchers: list = []
+
+    # ---- nodes -------------------------------------------------------------
+
+    def create_node(self, node: dict) -> dict:
+        with self._lock:
+            name = node["metadata"]["name"]
+            self._nodes[name] = copy.deepcopy(node)
+            self._notify("node", "added", self._nodes[name])
+            return copy.deepcopy(self._nodes[name])
+
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFound(f"node {name}")
+            return copy.deepcopy(self._nodes[name])
+
+    def list_nodes(self) -> list:
+        with self._lock:
+            return [copy.deepcopy(n) for _, n in sorted(self._nodes.items())]
+
+    def patch_node_metadata(self, name: str, metadata_patch: dict) -> dict:
+        """Strategic-merge-patch of node metadata
+        (`kubeinterface.go:145-158`)."""
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFound(f"node {name}")
+            _merge(self._nodes[name].setdefault("metadata", {}), metadata_patch)
+            self._notify("node", "modified", self._nodes[name])
+            return copy.deepcopy(self._nodes[name])
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is not None:
+                self._notify("node", "deleted", node)
+
+    # ---- pods --------------------------------------------------------------
+
+    def create_pod(self, pod: dict) -> dict:
+        with self._lock:
+            name = pod["metadata"]["name"]
+            if name in self._pods:
+                raise Conflict(f"pod {name} exists")
+            stored = copy.deepcopy(pod)
+            stored.setdefault("spec", {})
+            stored.setdefault("status", {"phase": "Pending"})
+            self._pods[name] = stored
+            self._notify("pod", "added", stored)
+            return copy.deepcopy(stored)
+
+    def get_pod(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._pods:
+                raise NotFound(f"pod {name}")
+            return copy.deepcopy(self._pods[name])
+
+    def list_pods(self, node_name: str | None = None) -> list:
+        with self._lock:
+            pods = [p for _, p in sorted(self._pods.items())]
+            if node_name is not None:
+                pods = [p for p in pods
+                        if p.get("spec", {}).get("nodeName") == node_name]
+            return [copy.deepcopy(p) for p in pods]
+
+    def update_pod_annotations(self, name: str, annotations: dict) -> dict:
+        """Replace a pod's annotations, nothing else — the guarantee
+        `UpdatePodMetadata` provides (`kubeinterface.go:175-193`)."""
+        with self._lock:
+            if name not in self._pods:
+                raise NotFound(f"pod {name}")
+            meta = self._pods[name].setdefault("metadata", {})
+            meta["annotations"] = copy.deepcopy(annotations)
+            self._notify("pod", "modified", self._pods[name])
+            return copy.deepcopy(self._pods[name])
+
+    def bind_pod(self, name: str, node_name: str) -> None:
+        """The bind subresource: sets spec.nodeName exactly once."""
+        with self._lock:
+            if name not in self._pods:
+                raise NotFound(f"pod {name}")
+            pod = self._pods[name]
+            bound = pod.get("spec", {}).get("nodeName")
+            if bound and bound != node_name:
+                raise Conflict(f"pod {name} already bound to {bound}")
+            pod.setdefault("spec", {})["nodeName"] = node_name
+            pod.setdefault("status", {})["phase"] = "Scheduled"
+            self._notify("pod", "modified", pod)
+
+    def delete_pod(self, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop(name, None)
+            if pod is not None:
+                self._notify("pod", "deleted", pod)
+
+    # ---- watch -------------------------------------------------------------
+
+    def add_watcher(self, fn) -> None:
+        """fn(kind, event, obj) called under no lock guarantee ordering by
+        arrival; used by the scheduler's informer loop."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    def _notify(self, kind: str, event: str, obj: dict) -> None:
+        obj_copy = copy.deepcopy(obj)
+        for fn in list(self._watchers):
+            fn(kind, event, obj_copy)

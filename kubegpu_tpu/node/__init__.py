@@ -1,0 +1,8 @@
+"""Node side: TPU device discovery, advertising, and allocation.
+
+Reference layers L3a/L4a/L5a' (`plugins/nvidiagpuplugin`, `crishim/pkg/device`,
+`crishim/pkg/kubeadvertise`).
+"""
+
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager  # noqa: F401
+from kubegpu_tpu.node.fake import FakeTPUBackend  # noqa: F401

@@ -1,0 +1,62 @@
+"""The device advertiser: node inventory -> API-server annotations.
+
+Reference: `crishim/pkg/kubeadvertise/advertise_device.go`. A periodic loop
+(default 20s) builds a fresh NodeInfo from the device manager, serializes
+it, and strategic-merge-patches the node object; on failure it retries on a
+tighter 5s loop until a patch lands (`advertise_device.go:63-95,130`).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubegpu_tpu.core import codec
+from kubegpu_tpu.core.types import NodeInfo
+
+DEFAULT_INTERVAL_S = 20.0
+DEFAULT_RETRY_S = 5.0
+
+
+class DeviceAdvertiser:
+    def __init__(self, client, dev_mgr, node_name: str):
+        self.client = client
+        self.dev_mgr = dev_mgr
+        self.node_name = node_name
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.patch_count = 0
+        self.error_count = 0
+
+    def advertise_once(self) -> None:
+        """One advertise pass (`advertise_device.go:39-61`)."""
+        self.client.get_node(self.node_name)  # fail fast if node is gone
+        info = NodeInfo(name=self.node_name)
+        self.dev_mgr.update_node_info(info)
+        meta: dict = {}
+        codec.node_info_to_annotation(meta, info)
+        self.client.patch_node_metadata(self.node_name, meta)
+        self.patch_count += 1
+
+    def start(self, interval_s: float = DEFAULT_INTERVAL_S,
+              retry_s: float = DEFAULT_RETRY_S) -> None:
+        """Run the advertise loop in a daemon thread
+        (`advertise_device.go:120-133`)."""
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.advertise_once()
+                    wait = interval_s
+                except Exception:
+                    self.error_count += 1
+                    wait = retry_s
+                self._stop.wait(wait)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"advertiser-{self.node_name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

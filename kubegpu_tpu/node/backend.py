@@ -1,0 +1,55 @@
+"""The TPU discovery backend seam.
+
+The reference reaches its native device layer through a tiny interface
+(`NvidiaPlugin`, `nvidia_plugin.go:7-10`) so a fake can replace the
+nvidia-docker REST daemon in tests (`nvidia_fake_plugin.go:29-39`). The TPU
+equivalent: a backend that enumerates chips, HBM, and the ICI mesh. The
+production implementation wraps the native C++ enumerator
+(`kubegpu_tpu.node.enumerator`); tests use `FakeTPUBackend`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubegpu_tpu.core import grammar
+
+
+@dataclass
+class ChipInfo:
+    """One TPU chip as discovered on the host."""
+
+    index: int            # host-local ordinal (devfs numbering)
+    coords: tuple         # global ICI mesh coordinates (x, y, z)
+    hbm_bytes: int
+    device_paths: list = field(default_factory=list)  # e.g. /dev/accel0 or /dev/vfio/..
+
+    @property
+    def chip_id(self) -> str:
+        """Wire-format chip id — encodes coordinates (`core.grammar`)."""
+        return grammar.chip_id_from_coords(self.coords)
+
+
+@dataclass
+class TPUInventory:
+    """A host's chip inventory plus the slice mesh it belongs to."""
+
+    chips: list                      # list[ChipInfo]
+    mesh_dims: tuple = (0, 0, 0)     # full-slice ICI mesh dims
+    mesh_wrap: tuple = (False, False, False)
+    host_bounds: tuple = (2, 2, 1)   # shape of this host's block of the mesh
+    tray_shape: tuple = (2, 1, 1)    # chips sharing the tightest ICI neighborhood
+    runtime_version: str = ""
+
+    def chip(self, chip_id: str) -> ChipInfo | None:
+        for c in self.chips:
+            if c.chip_id == chip_id:
+                return c
+        return None
+
+
+class TPUBackend:
+    """Abstract discovery backend (the fake seam)."""
+
+    def enumerate(self) -> TPUInventory:
+        raise NotImplementedError

@@ -1,0 +1,68 @@
+"""Canned TPU inventories for tests and simulation.
+
+The reference ships a fake backend returning two captured real-world
+inventories (`nvidia_fake_plugin.go:15-39`); these are the TPU analogues:
+standard v5p/v4 host shapes plus a failure-injecting variant.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.node.backend import ChipInfo, TPUBackend, TPUInventory
+
+GIB = 2**30
+
+# Per-chip HBM for the fake generations (approximate real values).
+V5P_HBM = 95 * GIB
+V4_HBM = 32 * GIB
+
+
+def v5p_host_inventory(host_origin=(0, 0, 0), mesh_dims=(2, 2, 1),
+                       mesh_wrap=(False, False, False)) -> TPUInventory:
+    """One v5p host: 4 chips in a 2x2x1 block starting at ``host_origin``.
+
+    ``mesh_dims`` describes the full slice so multi-host simulations can
+    place several hosts in one mesh (e.g. a v5p-32 is 4 hosts of 2x2x1 in a
+    4x2x2... pick dims per scenario).
+    """
+    chips = []
+    ox, oy, oz = host_origin
+    index = 0
+    for dy in range(2):
+        for dx in range(2):
+            chips.append(ChipInfo(
+                index=index,
+                coords=(ox + dx, oy + dy, oz),
+                hbm_bytes=V5P_HBM,
+                device_paths=[f"/dev/accel{index}", f"/dev/vfio/{index}"],
+            ))
+            index += 1
+    return TPUInventory(
+        chips=chips, mesh_dims=mesh_dims, mesh_wrap=mesh_wrap,
+        host_bounds=(2, 2, 1), tray_shape=(2, 1, 1),
+        runtime_version="fake-libtpu-v5p",
+    )
+
+
+def single_chip_inventory() -> TPUInventory:
+    """A 1-chip host — the degenerate no-topology case (BASELINE config 1)."""
+    return TPUInventory(
+        chips=[ChipInfo(index=0, coords=(0, 0, 0), hbm_bytes=V4_HBM,
+                        device_paths=["/dev/accel0"])],
+        mesh_dims=(1, 1, 1), host_bounds=(1, 1, 1), tray_shape=(1, 1, 1),
+        runtime_version="fake-libtpu-v4",
+    )
+
+
+class FakeTPUBackend(TPUBackend):
+    """Backend returning a canned inventory; can simulate discovery failure."""
+
+    def __init__(self, inventory: TPUInventory | None = None, fail: bool = False):
+        self.inventory = inventory if inventory is not None else v5p_host_inventory()
+        self.fail = fail
+        self.enumerate_calls = 0
+
+    def enumerate(self) -> TPUInventory:
+        self.enumerate_calls += 1
+        if self.fail:
+            raise RuntimeError("fake libtpu enumeration failure")
+        return self.inventory

@@ -1,0 +1,188 @@
+"""TPUDeviceManager and the node-side device registry.
+
+Reference: `NvidiaGPUManager` (`nvidia_gpu_manager.go:55-285`) and
+`DevicesManager` (`crishim/pkg/device/devicemanager.go`). The TPU manager
+discovers chips through a `TPUBackend`, advertises them as a
+tpugrp1/tpugrp0/tpu hierarchy derived from ICI mesh coordinates, and at
+container-create time turns ``allocate_from`` into device nodes plus the
+``TPU_VISIBLE_CHIPS``-style env the runtime needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from kubegpu_tpu.core import grammar
+from kubegpu_tpu.core.types import NodeInfo, add_group_resource
+from kubegpu_tpu.node.backend import TPUBackend, TPUInventory
+from kubegpu_tpu.topology.mesh import ICIMesh
+
+
+@dataclass
+class Volume:
+    """Runtime volume to mount (reference: `crishim/pkg/types/types.go:7-10`)."""
+
+    name: str
+    driver: str = ""
+
+
+class TPUDeviceManager:
+    """Node-side `Device` implementation for TPU chips.
+
+    Topology grouping replaces the reference's two-pass NVLink link-level
+    discovery (`nvidia_gpu_manager.go:93-121`): chips sharing a tray block
+    (tightest ICI neighborhood) form a ``tpugrp0`` group; the host is one
+    ``tpugrp1`` group. Group indices are derived from block coordinates, so
+    they are stable across restarts.
+    """
+
+    def __init__(self, backend: TPUBackend, name: str = "tpu"):
+        self.backend = backend
+        self.name = name
+        self.inventory: TPUInventory | None = None
+        self.mesh: ICIMesh | None = None
+
+    def get_name(self) -> str:
+        return self.name
+
+    def new(self) -> None:
+        pass
+
+    def start(self) -> None:
+        """Initial discovery; failure leaves zero chips advertised
+        (`nvidia_gpu_manager.go:198-201, 205-210`)."""
+        try:
+            self._refresh()
+        except Exception:
+            self.inventory = None
+
+    def _refresh(self) -> None:
+        inv = self.backend.enumerate()
+        self.inventory = inv
+        dims = inv.mesh_dims if all(inv.mesh_dims) else (1, 1, 1)
+        self.mesh = ICIMesh(dims, inv.mesh_wrap)
+
+    def _tray_index(self, coords: tuple) -> int:
+        """Linear index of the tray block containing ``coords``."""
+        inv = self.inventory
+        origin = tuple(min(c.coords[i] for c in inv.chips) for i in range(3))
+        tray = tuple((coords[i] - origin[i]) // max(1, inv.tray_shape[i])
+                     for i in range(3))
+        trays_per = tuple(
+            max(1, -(-inv.host_bounds[i] // max(1, inv.tray_shape[i])))
+            for i in range(3))
+        return (tray[2] * trays_per[1] + tray[1]) * trays_per[0] + tray[0]
+
+    def chip_group_path(self, chip) -> str:
+        """``tpugrp1/<host>/tpugrp0/<tray>/tpu/<chip-id>`` for one chip."""
+        tray = self._tray_index(chip.coords)
+        return (f"{grammar.TPU_GRP1}/0/{grammar.TPU_GRP0}/{tray}/"
+                f"{grammar.TPU_LEAF}/{chip.chip_id}")
+
+    def update_node_info(self, node_info: NodeInfo) -> None:
+        """Advertise chip inventory into a NodeInfo
+        (`nvidia_gpu_manager.go:204-223`). Discovery failure advertises
+        zero chips rather than stale state."""
+        try:
+            self._refresh()
+        except Exception:
+            node_info.capacity[grammar.RESOURCE_NUM_CHIPS] = 0
+            node_info.allocatable[grammar.RESOURCE_NUM_CHIPS] = 0
+            return
+        inv = self.inventory
+        node_info.capacity[grammar.RESOURCE_NUM_CHIPS] = len(inv.chips)
+        node_info.allocatable[grammar.RESOURCE_NUM_CHIPS] = len(inv.chips)
+        for chip in inv.chips:
+            base = self.chip_group_path(chip)
+            for res_list in (node_info.capacity, node_info.allocatable):
+                add_group_resource(res_list, f"{base}/{grammar.CHIPS_SUFFIX}", 1)
+                add_group_resource(res_list, f"{base}/{grammar.HBM_SUFFIX}",
+                                   chip.hbm_bytes)
+                add_group_resource(res_list, f"{base}/{grammar.LINKS_SUFFIX}",
+                                   self.mesh.link_mask(chip.coords))
+
+    def allocate(self, pod, container) -> tuple[list, list, dict]:
+        """Turn ``allocate_from`` into (volumes, device paths, env).
+
+        The TPU analogue of `nvidia_gpu_manager.go:226-285`: extract chip
+        ids from the allocation paths, map to device nodes, and derive the
+        chip-visibility env contract:
+
+        - ``TPU_VISIBLE_CHIPS``: host-local chip indices, sorted
+        - ``TPU_CHIP_IDS``: mesh-coordinate ids of the same chips
+        - ``TPU_PROCESS_BOUNDS``: extent of the allocated sub-mesh (x,y,z)
+        """
+        if not container.allocate_from:
+            return [], [], {}
+        if self.inventory is None:
+            raise RuntimeError("TPU inventory not discovered")
+        chips = []
+        for path in container.allocate_from.values():
+            chip_id = grammar.chip_id_from_path(path)
+            if chip_id is None:
+                continue
+            chip = self.inventory.chip(chip_id)
+            if chip is None:
+                raise RuntimeError(
+                    f"pod {pod.name}: allocated chip {chip_id} not on this host")
+            chips.append(chip)
+        if not chips:
+            return [], [], {}
+        chips.sort(key=lambda c: c.index)
+        devices = []
+        for c in chips:
+            devices.extend(c.device_paths)
+        bounds = tuple(
+            max(c.coords[i] for c in chips) - min(c.coords[i] for c in chips) + 1
+            for i in range(3))
+        env = {
+            "TPU_VISIBLE_CHIPS": ",".join(str(c.index) for c in chips),
+            "TPU_CHIP_IDS": ",".join(c.chip_id for c in chips),
+            "TPU_PROCESS_BOUNDS": ",".join(str(b) for b in bounds),
+        }
+        volumes = [Volume(name="libtpu", driver="tpu-runtime")]
+        return volumes, devices, env
+
+
+class DevicesManager:
+    """Registry fanning out to device plugins
+    (`crishim/pkg/device/devicemanager.go:13-122`).
+
+    Devices that fail to start are marked non-operational and skipped —
+    the node keeps advertising what still works.
+    """
+
+    def __init__(self):
+        self.devices: list = []
+        self.operational: dict = {}
+
+    def add_device(self, device) -> None:
+        self.devices.append(device)
+        self.operational[device.get_name()] = False
+
+    def start(self) -> None:
+        for dev in self.devices:
+            try:
+                dev.start()
+                self.operational[dev.get_name()] = True
+            except Exception:
+                self.operational[dev.get_name()] = False
+
+    def update_node_info(self, node_info: NodeInfo) -> None:
+        for dev in self.devices:
+            if self.operational.get(dev.get_name()):
+                dev.update_node_info(node_info)
+
+    def allocate_devices(self, pod, container) -> tuple[list, list, dict]:
+        """Aggregate allocations across plugins (`devicemanager.go:104-122`)."""
+        volumes: list = []
+        devices: list = []
+        env: dict = {}
+        for dev in self.devices:
+            if not self.operational.get(dev.get_name()):
+                continue
+            v, d, e = dev.allocate(pod, container)
+            volumes.extend(v)
+            devices.extend(d)
+            env.update(e)
+        return volumes, devices, env

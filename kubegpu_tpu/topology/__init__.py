@@ -1,0 +1,11 @@
+"""ICI topology: 3-D torus/mesh modeling and contiguous sub-mesh search.
+
+The TPU analogue of the reference's NVLink link-level grouping
+(`nvidia_gpu_manager.go:93-121`), generalized: chips carry mesh coordinates,
+links are modeled explicitly, and the placement constraint is "k chips must
+form an ICI-contiguous sub-mesh" — strictly harder than the reference's
+name-prefix grouping (SURVEY.md §8).
+"""
+
+from kubegpu_tpu.topology.mesh import ICIMesh, find_contiguous_block  # noqa: F401
+from kubegpu_tpu.topology.tree import SortedTreeNode  # noqa: F401

@@ -1,0 +1,234 @@
+"""3-D ICI mesh/torus math.
+
+Models a TPU slice as a 3-D grid of chips with ICI links along +-x/+-y/+-z
+(wraparound per axis for full-size torus dims, as on v4/v5p pods). Provides:
+
+- per-chip ICI link-direction bitmasks (advertised as ``enumLinks``),
+- contiguous sub-mesh search: given the free chip set, find ``count`` chips
+  forming an ICI-connected block, preferring compact axis-aligned shapes
+  and placements that fragment the remaining free space least,
+- fragmentation scoring for bin-packing decisions.
+
+All iteration is in sorted coordinate order so placement is deterministic
+(the framework-wide rule, `docs/kubegpu.md:24-31` in the reference).
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import lru_cache
+
+Coord = tuple  # (x, y, z)
+
+# Link direction order defines enumLinks bit positions: bit i set <=> link
+# present toward LINK_DIRS[i].
+LINK_DIRS = (
+    (1, 0, 0), (-1, 0, 0),
+    (0, 1, 0), (0, -1, 0),
+    (0, 0, 1), (0, 0, -1),
+)
+
+
+class ICIMesh:
+    """A slice-shaped chip grid with optional per-axis wraparound."""
+
+    def __init__(self, dims: tuple, wrap: tuple | bool = False):
+        self.dims = tuple(int(d) for d in dims)
+        if isinstance(wrap, bool):
+            wrap = (wrap,) * len(self.dims)
+        self.wrap = tuple(bool(w) for w in wrap)
+        if len(self.dims) != 3 or len(self.wrap) != 3:
+            raise ValueError(f"ICIMesh is 3-D; got dims={dims}")
+        self.chips = [
+            (x, y, z)
+            for x in range(self.dims[0])
+            for y in range(self.dims[1])
+            for z in range(self.dims[2])
+        ]
+        self._chipset = set(self.chips)
+
+    def __contains__(self, coord: Coord) -> bool:
+        return tuple(coord) in self._chipset
+
+    def size(self) -> int:
+        return len(self.chips)
+
+    def neighbor(self, coord: Coord, direction: Coord) -> Coord | None:
+        """The chip one hop away, honoring wraparound; None off-mesh."""
+        out = []
+        for c, d, dim, w in zip(coord, direction, self.dims, self.wrap):
+            n = c + d
+            if w:
+                n %= dim
+            elif not 0 <= n < dim:
+                return None
+            out.append(n)
+        nxt = tuple(out)
+        # a wrapped link back to itself (dim 1 or 2) is not a distinct link
+        return nxt if nxt != tuple(coord) else None
+
+    def neighbors(self, coord: Coord) -> list:
+        out = []
+        for d in LINK_DIRS:
+            n = self.neighbor(coord, d)
+            if n is not None:
+                out.append(n)
+        return out
+
+    def link_mask(self, coord: Coord) -> int:
+        """ICI link-direction bitmask for one chip (the ``enumLinks`` value)."""
+        mask = 0
+        for i, d in enumerate(LINK_DIRS):
+            if self.neighbor(coord, d) is not None:
+                mask |= 1 << i
+        return mask
+
+    def is_connected(self, coords) -> bool:
+        """Are these chips one ICI-connected component of the mesh?"""
+        coords = set(map(tuple, coords))
+        if not coords:
+            return True
+        seen = set()
+        stack = [min(coords)]
+        while stack:
+            c = stack.pop()
+            if c in seen or c not in coords:
+                continue
+            seen.add(c)
+            for n in self.neighbors(c):
+                if n in coords and n not in seen:
+                    stack.append(n)
+        return seen == coords
+
+    def free_components(self, free) -> list:
+        """Connected components of the free set, largest first."""
+        free = set(map(tuple, free))
+        comps = []
+        while free:
+            comp = set()
+            stack = [min(free)]
+            while stack:
+                c = stack.pop()
+                if c not in free or c in comp:
+                    continue
+                comp.add(c)
+                stack.extend(n for n in self.neighbors(c) if n in free)
+            free -= comp
+            comps.append(comp)
+        comps.sort(key=lambda c: (-len(c), min(c)))
+        return comps
+
+    def fragmentation_score(self, free) -> float:
+        """1.0 = all free chips form one block; lower = more fragmented."""
+        free = set(map(tuple, free))
+        if not free:
+            return 1.0
+        comps = self.free_components(free)
+        return len(comps[0]) / len(free)
+
+
+@lru_cache(maxsize=256)
+def _block_shapes(count: int) -> tuple:
+    """Axis-aligned box shapes of volume ``count``, most compact first.
+
+    Compactness = minimal surface area, the proxy for intra-block ICI hop
+    distance (a 2x2x2 cube beats an 8x1x1 line for all-reduce latency).
+    """
+    shapes = set()
+    for a in range(1, count + 1):
+        if count % a:
+            continue
+        rest = count // a
+        for b in range(1, rest + 1):
+            if rest % b:
+                continue
+            c = rest // b
+            shapes.update(itertools.permutations((a, b, c)))
+    return tuple(sorted(shapes, key=lambda s: (
+        s[0] * s[1] + s[1] * s[2] + s[0] * s[2], s)))
+
+
+def _block_coords(origin: Coord, shape: tuple, mesh: ICIMesh):
+    """Coords of the axis-aligned block at origin; None if it leaves the mesh."""
+    coords = []
+    for dx in range(shape[0]):
+        for dy in range(shape[1]):
+            for dz in range(shape[2]):
+                c = []
+                for o, d, dim, w in zip(origin, (dx, dy, dz), mesh.dims, mesh.wrap):
+                    n = o + d
+                    if n >= dim:
+                        if not w:
+                            return None
+                        n %= dim
+                    c.append(n)
+                coords.append(tuple(c))
+    if len(set(coords)) != len(coords):  # wrapped onto itself
+        return None
+    return coords
+
+
+def _exposure(block, free, mesh: ICIMesh) -> int:
+    """Free chips adjacent to (but outside) the block — the fragmentation
+    a placement causes. Lower is better: prefer corners and edges."""
+    blockset = set(block)
+    seen = set()
+    for c in block:
+        for n in mesh.neighbors(c):
+            if n in free and n not in blockset:
+                seen.add(n)
+    return len(seen)
+
+
+def find_contiguous_block(mesh: ICIMesh, free, count: int):
+    """Find ``count`` free chips forming an ICI-contiguous block.
+
+    Strategy: try axis-aligned box shapes most-compact-first; among all
+    placements of the best feasible shape pick the one exposing the fewest
+    free neighbors (least future fragmentation), ties broken by sorted
+    origin. Falls back to greedy compact connected growth when no box fits
+    (fragmented free space). Returns a sorted coord list, or None if no
+    connected set of that size exists.
+    """
+    free = set(map(tuple, free))
+    if count <= 0:
+        return []
+    if count > len(free):
+        return None
+
+    for shape in _block_shapes(count):
+        if any(s > d for s, d in zip(shape, mesh.dims)):
+            continue
+        best = None
+        for origin in sorted(free):
+            block = _block_coords(origin, shape, mesh)
+            if block is None or not free.issuperset(block):
+                continue
+            key = (_exposure(block, free, mesh), origin)
+            if best is None or key < best[0]:
+                best = (key, block)
+        if best is not None:
+            return sorted(best[1])
+
+    # Fragmented: grow a connected set greedily, preferring chips with the
+    # most already-selected neighbors (keeps the blob compact).
+    for comp in mesh.free_components(free):
+        if len(comp) < count:
+            continue
+        seed = min(comp)
+        selected = [seed]
+        selset = {seed}
+        while len(selected) < count:
+            frontier = {}
+            for c in selected:
+                for n in mesh.neighbors(c):
+                    if n in comp and n not in selset:
+                        frontier[n] = frontier.get(n, 0) + 1
+            if not frontier:
+                break
+            nxt = max(sorted(frontier), key=lambda c: frontier[c])
+            selected.append(nxt)
+            selset.add(nxt)
+        if len(selected) == count:
+            return sorted(selected)
+    return None

@@ -313,3 +313,18 @@ def test_two_pods_sequential_accounting():
     return_pod_group_resource(node, pod_a)
     found_c2, _, _ = pod_fits_group_constraints(node, pod_c, allocating=False)
     assert found_c2
+
+
+def test_requestless_container_rescored_not_replaced():
+    """A container with no group requests goes through the re-score path and
+    reports the node's current packing score (`grpallocate.go:461`)."""
+    node = make_node({"tpu/dev0/chips": 1, "tpu/dev1/chips": 1})
+    pod = make_pod("p", {}, {
+        "Run0": make_cont({"tpu/0/chips": 1}),
+        "Run1": make_cont({}),  # sidecar with no device requests
+    })
+    found, _, score = pod_fits_group_constraints(node, pod, allocating=True)
+    assert found
+    # Run1 sorts last: its re-score over the whole node reflects Run0's chip
+    assert score == pytest.approx(0.5)  # 1 of 2 chip resources used
+    assert pod.running_containers["Run1"].allocate_from == {}

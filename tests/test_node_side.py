@@ -1,0 +1,227 @@
+"""Node-side tests: discovery, advertising, allocation (reference:
+nvidia_gpu_manager_test.go + devicemanager + advertise_device)."""
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+from kubegpu_tpu.node.fake import FakeTPUBackend, single_chip_inventory, v5p_host_inventory
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+
+G = "alpha/grpresource"
+
+
+def test_update_node_info_advertises_topology_hierarchy():
+    mgr = TPUDeviceManager(FakeTPUBackend())
+    mgr.start()
+    info = NodeInfo(name="host0")
+    mgr.update_node_info(info)
+    assert info.allocatable[grammar.RESOURCE_NUM_CHIPS] == 4
+    # 2x2 host with (2,1,1) trays: chips 0.0.0/1.0.0 in tray 0, 0.1.0/1.1.0 in tray 1
+    assert info.allocatable[f"{G}/tpugrp1/0/tpugrp0/0/tpu/0.0.0/chips"] == 1
+    assert info.allocatable[f"{G}/tpugrp1/0/tpugrp0/0/tpu/1.0.0/chips"] == 1
+    assert info.allocatable[f"{G}/tpugrp1/0/tpugrp0/1/tpu/0.1.0/chips"] == 1
+    assert info.allocatable[f"{G}/tpugrp1/0/tpugrp0/1/tpu/1.1.0/chips"] == 1
+    hbm = info.allocatable[f"{G}/tpugrp1/0/tpugrp0/0/tpu/0.0.0/hbm"]
+    assert hbm == 95 * 2**30
+    # corner chip in a 2x2x1 mesh has +x and +y links only
+    links = info.allocatable[f"{G}/tpugrp1/0/tpugrp0/0/tpu/0.0.0/enumLinks"]
+    assert bin(links).count("1") == 2
+    assert info.capacity == info.allocatable
+
+
+def test_update_node_info_discovery_failure_advertises_zero():
+    backend = FakeTPUBackend()
+    mgr = TPUDeviceManager(backend)
+    mgr.start()
+    backend.fail = True
+    info = NodeInfo(name="host0")
+    mgr.update_node_info(info)
+    assert info.allocatable[grammar.RESOURCE_NUM_CHIPS] == 0
+    assert not any(k.startswith(G) for k in info.allocatable)
+
+
+def test_single_chip_inventory_no_links():
+    mgr = TPUDeviceManager(FakeTPUBackend(single_chip_inventory()))
+    mgr.start()
+    info = NodeInfo(name="host0")
+    mgr.update_node_info(info)
+    assert info.allocatable[grammar.RESOURCE_NUM_CHIPS] == 1
+    assert info.allocatable[f"{G}/tpugrp1/0/tpugrp0/0/tpu/0.0.0/enumLinks"] == 0
+
+
+def make_allocated_container(chip_paths):
+    cont = ContainerInfo()
+    for i, path in enumerate(chip_paths):
+        req = f"{G}/tpugrp1/0/tpugrp0/0/tpu/{i}/chips"
+        cont.allocate_from[req] = path
+        cont.dev_requests[req] = 1
+    return cont
+
+
+def test_allocate_returns_devices_and_env():
+    mgr = TPUDeviceManager(FakeTPUBackend())
+    mgr.start()
+    cont = make_allocated_container([
+        f"{G}/tpugrp1/0/tpugrp0/1/tpu/1.1.0/chips",
+        f"{G}/tpugrp1/0/tpugrp0/0/tpu/0.0.0/chips",
+    ])
+    volumes, devices, env = mgr.allocate(PodInfo(name="p"), cont)
+    # chips sorted by host-local index: 0.0.0 (idx 0) before 1.1.0 (idx 3)
+    assert env["TPU_VISIBLE_CHIPS"] == "0,3"
+    assert env["TPU_CHIP_IDS"] == "0.0.0,1.1.0"
+    assert env["TPU_PROCESS_BOUNDS"] == "2,2,1"
+    assert "/dev/accel0" in devices and "/dev/accel3" in devices
+    assert "/dev/vfio/0" in devices
+    assert volumes and volumes[0].name == "libtpu"
+
+
+def test_allocate_empty_is_noop():
+    mgr = TPUDeviceManager(FakeTPUBackend())
+    mgr.start()
+    volumes, devices, env = mgr.allocate(PodInfo(name="p"), ContainerInfo())
+    assert (volumes, devices, env) == ([], [], {})
+
+
+def test_allocate_unknown_chip_raises():
+    mgr = TPUDeviceManager(FakeTPUBackend())
+    mgr.start()
+    cont = make_allocated_container([f"{G}/tpugrp1/0/tpugrp0/0/tpu/9.9.9/chips"])
+    with pytest.raises(RuntimeError, match="not on this host"):
+        mgr.allocate(PodInfo(name="p"), cont)
+
+
+class BrokenDevice:
+    def get_name(self):
+        return "broken"
+
+    def start(self):
+        raise RuntimeError("boom")
+
+    def update_node_info(self, info):
+        raise AssertionError("must not be called")
+
+
+def test_devices_manager_skips_non_operational():
+    reg = DevicesManager()
+    reg.add_device(BrokenDevice())
+    tpu = TPUDeviceManager(FakeTPUBackend())
+    reg.add_device(tpu)
+    reg.start()
+    assert reg.operational == {"broken": False, "tpu": True}
+    info = NodeInfo(name="n")
+    reg.update_node_info(info)  # BrokenDevice.update_node_info not called
+    assert info.allocatable[grammar.RESOURCE_NUM_CHIPS] == 4
+
+
+def test_devices_manager_aggregates_allocation():
+    reg = DevicesManager()
+    tpu = TPUDeviceManager(FakeTPUBackend())
+    reg.add_device(tpu)
+    reg.start()
+    cont = make_allocated_container([f"{G}/tpugrp1/0/tpugrp0/0/tpu/0.0.0/chips"])
+    volumes, devices, env = reg.allocate_devices(PodInfo(name="p"), cont)
+    assert env["TPU_VISIBLE_CHIPS"] == "0"
+    assert devices
+
+
+# ---- advertiser ------------------------------------------------------------
+
+
+def make_cluster_with_node(name="host0"):
+    api = InMemoryAPIServer()
+    api.create_node({"metadata": {"name": name, "annotations": {"keep": "me"}}})
+    reg = DevicesManager()
+    reg.add_device(TPUDeviceManager(FakeTPUBackend()))
+    reg.start()
+    return api, reg
+
+
+def test_advertise_once_patches_node_annotation():
+    api, reg = make_cluster_with_node()
+    adv = DeviceAdvertiser(api, reg, "host0")
+    adv.advertise_once()
+    node = api.get_node("host0")
+    assert node["metadata"]["annotations"]["keep"] == "me"
+    decoded = codec.annotation_to_node_info(node["metadata"])
+    assert decoded.allocatable[grammar.RESOURCE_NUM_CHIPS] == 4
+    assert decoded.name == "host0"
+    assert adv.patch_count == 1
+
+
+def test_advertise_missing_node_raises():
+    api, reg = make_cluster_with_node()
+    adv = DeviceAdvertiser(api, reg, "ghost")
+    with pytest.raises(KeyError):
+        adv.advertise_once()
+
+
+def test_advertise_loop_retries_on_failure():
+    api, reg = make_cluster_with_node()
+    adv = DeviceAdvertiser(api, reg, "host0")
+    api.delete_node("host0")
+    adv.start(interval_s=0.01, retry_s=0.01)
+    import time
+
+    deadline = time.time() + 2
+    while adv.error_count < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    # node comes back -> loop recovers and patches
+    api.create_node({"metadata": {"name": "host0"}})
+    deadline = time.time() + 2
+    while adv.patch_count < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    adv.stop()
+    assert adv.error_count >= 2
+    assert adv.patch_count >= 1
+
+
+# ---- API server fake -------------------------------------------------------
+
+
+def test_apiserver_patch_merges_annotations():
+    api = InMemoryAPIServer()
+    api.create_node({"metadata": {"name": "n", "annotations": {"a": "1"}}})
+    api.patch_node_metadata("n", {"annotations": {"b": "2"}})
+    ann = api.get_node("n")["metadata"]["annotations"]
+    assert ann == {"a": "1", "b": "2"}
+
+
+def test_apiserver_bind_conflict():
+    api = InMemoryAPIServer()
+    api.create_pod({"metadata": {"name": "p"}})
+    api.bind_pod("p", "n1")
+    api.bind_pod("p", "n1")  # idempotent
+    with pytest.raises(RuntimeError):
+        api.bind_pod("p", "n2")
+    assert api.get_pod("p")["spec"]["nodeName"] == "n1"
+
+
+def test_apiserver_watchers_see_events():
+    api = InMemoryAPIServer()
+    events = []
+    api.add_watcher(lambda kind, ev, obj: events.append((kind, ev, obj["metadata"]["name"])))
+    api.create_pod({"metadata": {"name": "p"}})
+    api.bind_pod("p", "n")
+    api.delete_pod("p")
+    assert events == [("pod", "added", "p"), ("pod", "modified", "p"),
+                      ("pod", "deleted", "p")]
+
+
+def test_apiserver_returns_copies():
+    api = InMemoryAPIServer()
+    api.create_node({"metadata": {"name": "n", "annotations": {}}})
+    got = api.get_node("n")
+    got["metadata"]["annotations"]["mutated"] = "yes"
+    assert "mutated" not in api.get_node("n")["metadata"]["annotations"]
+
+
+def test_list_pods_by_node():
+    api = InMemoryAPIServer()
+    api.create_pod({"metadata": {"name": "a"}})
+    api.create_pod({"metadata": {"name": "b"}})
+    api.bind_pod("a", "n1")
+    assert [p["metadata"]["name"] for p in api.list_pods(node_name="n1")] == ["a"]
+    assert len(api.list_pods()) == 2

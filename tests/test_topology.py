@@ -1,0 +1,175 @@
+"""ICI mesh math + canonical shape tree tests."""
+
+import pytest
+
+from kubegpu_tpu.topology.mesh import ICIMesh, find_contiguous_block
+from kubegpu_tpu.topology.tree import (
+    SortedTreeNode,
+    compare_trees,
+    compute_tree_score,
+    tree_from_resources,
+)
+
+G = "alpha/grpresource"
+
+
+# ---- mesh ------------------------------------------------------------------
+
+
+def test_mesh_neighbors_no_wrap():
+    mesh = ICIMesh((2, 2, 1))
+    assert sorted(mesh.neighbors((0, 0, 0))) == [(0, 1, 0), (1, 0, 0)]
+    assert mesh.size() == 4
+
+
+def test_mesh_wraparound_torus():
+    mesh = ICIMesh((4, 4, 4), wrap=True)
+    assert (3, 0, 0) in mesh.neighbors((0, 0, 0))
+    assert (0, 3, 0) in mesh.neighbors((0, 0, 0))
+    assert len(mesh.neighbors((0, 0, 0))) == 6
+
+
+def test_wrap_on_dim_2_does_not_duplicate_link():
+    # In a dim-2 torus, +x and -x reach the same chip; neighbor() still
+    # reports it but link_mask sets both direction bits.
+    mesh = ICIMesh((2, 1, 1), wrap=True)
+    assert mesh.neighbors((0, 0, 0)) == [(1, 0, 0), (1, 0, 0)]
+    assert mesh.link_mask((0, 0, 0)) == 0b11
+
+
+def test_wrap_on_dim_1_no_self_link():
+    mesh = ICIMesh((1, 1, 1), wrap=True)
+    assert mesh.neighbors((0, 0, 0)) == []
+    assert mesh.link_mask((0, 0, 0)) == 0
+
+
+def test_link_mask_corner_vs_interior():
+    mesh = ICIMesh((4, 4, 4))
+    assert bin(mesh.link_mask((0, 0, 0))).count("1") == 3
+    assert bin(mesh.link_mask((1, 1, 1))).count("1") == 6
+
+
+def test_is_connected():
+    mesh = ICIMesh((4, 4, 1))
+    assert mesh.is_connected([(0, 0, 0), (1, 0, 0), (1, 1, 0)])
+    assert not mesh.is_connected([(0, 0, 0), (2, 0, 0)])
+    assert mesh.is_connected([])
+
+
+def test_free_components_and_fragmentation():
+    mesh = ICIMesh((4, 1, 1))
+    comps = mesh.free_components([(0, 0, 0), (1, 0, 0), (3, 0, 0)])
+    assert [sorted(c) for c in comps] == [[(0, 0, 0), (1, 0, 0)], [(3, 0, 0)]]
+    assert mesh.fragmentation_score([(0, 0, 0), (1, 0, 0), (3, 0, 0)]) == pytest.approx(2 / 3)
+    assert mesh.fragmentation_score([]) == 1.0
+
+
+def test_find_block_prefers_compact_shape():
+    mesh = ICIMesh((4, 4, 4))
+    block = find_contiguous_block(mesh, mesh.chips, 8)
+    assert block is not None and len(block) == 8
+    xs = {c[0] for c in block}
+    ys = {c[1] for c in block}
+    zs = {c[2] for c in block}
+    assert (len(xs), len(ys), len(zs)) == (2, 2, 2)  # cube, not a line
+    assert mesh.is_connected(block)
+
+
+def test_find_block_deterministic_and_corner_packed():
+    mesh = ICIMesh((4, 4, 1))
+    b1 = find_contiguous_block(mesh, mesh.chips, 4)
+    b2 = find_contiguous_block(mesh, mesh.chips, 4)
+    assert b1 == b2
+    # corner placement exposes fewest free neighbors
+    assert (0, 0, 0) in b1
+
+
+def test_find_block_avoids_fragmenting_hole():
+    mesh = ICIMesh((4, 1, 1))
+    free = [(0, 0, 0), (1, 0, 0), (3, 0, 0)]
+    block = find_contiguous_block(mesh, free, 1)
+    # taking (3,0,0) exposes no free neighbors; taking (0..1) would split/expose
+    assert block == [(3, 0, 0)]
+
+
+def test_find_block_fallback_connected_growth():
+    # free space is an L-shape: no 1x3 box fits, but a connected trio exists
+    mesh = ICIMesh((2, 2, 1))
+    free = [(0, 0, 0), (1, 0, 0), (1, 1, 0)]
+    block = find_contiguous_block(mesh, free, 3)
+    assert block == sorted(free)
+    assert mesh.is_connected(block)
+
+
+def test_find_block_impossible():
+    mesh = ICIMesh((4, 1, 1))
+    assert find_contiguous_block(mesh, [(0, 0, 0), (2, 0, 0)], 2) is None
+    assert find_contiguous_block(mesh, [(0, 0, 0)], 5) is None
+    assert find_contiguous_block(mesh, [], 0) == []
+
+
+def test_find_block_wraparound_uses_torus_links():
+    mesh = ICIMesh((4, 1, 1), wrap=(True, False, False))
+    free = [(0, 0, 0), (3, 0, 0)]
+    block = find_contiguous_block(mesh, free, 2)
+    assert block == [(0, 0, 0), (3, 0, 0)]  # adjacent via wrap link
+
+
+# ---- shape tree ------------------------------------------------------------
+
+
+THREE_LEVEL = {}
+for g1, g0, dev in [(0, 0, "a"), (0, 0, "b"), (0, 1, "c"), (0, 1, "d"),
+                    (1, 2, "e"), (1, 2, "f"), (1, 3, "g"), (1, 3, "h")]:
+    THREE_LEVEL[f"{G}/tpugrp1/{g1}/tpugrp0/{g0}/tpu/{dev}/chips"] = 1
+    THREE_LEVEL[f"{G}/tpugrp1/{g1}/tpugrp0/{g0}/tpu/{dev}/hbm"] = 1000
+
+
+def test_tree_from_resources_counts_chips_only():
+    tree = tree_from_resources(THREE_LEVEL)
+    assert tree.val == 8
+    assert [c.val for c in tree.children] == [4, 4]
+    assert [c.val for c in tree.children[0].children] == [2, 2]
+
+
+def test_tree_shape_dedup_across_labels():
+    relabeled = {k.replace("/0/", "/9/", 1): v for k, v in THREE_LEVEL.items()}
+    assert compare_trees(tree_from_resources(THREE_LEVEL),
+                         tree_from_resources(relabeled))
+
+
+def test_tree_shape_differs_on_structure():
+    lopsided = dict(THREE_LEVEL)
+    lopsided.pop(f"{G}/tpugrp1/1/tpugrp0/3/tpu/h/chips")
+    assert not compare_trees(tree_from_resources(THREE_LEVEL),
+                             tree_from_resources(lopsided))
+
+
+def test_flat_node_gives_empty_tree():
+    flat = {f"{G}/tpu/x/chips": 1}
+    tree = tree_from_resources(flat)
+    assert tree.val == 0 and tree.children == []
+
+
+def test_sorted_insertion_descending():
+    root = SortedTreeNode()
+    root.add_value(2)
+    root.add_value(5)
+    root.add_value(3, score=0.1)
+    root.add_value(3, score=0.9)
+    assert [(c.val, c.score) for c in root.children] == [
+        (5, 0.0), (3, 0.9), (3, 0.1), (2, 0.0)]
+
+
+def test_tree_score_prefers_denser_hierarchy():
+    # same chip count, one tree deeper/denser than the other
+    shallow = {f"{G}/tpugrp1/0/tpugrp0/{i}/tpu/d{i}/chips": 1 for i in range(4)}
+    dense = {f"{G}/tpugrp1/0/tpugrp0/0/tpu/d{i}/chips": 1 for i in range(4)}
+    s_shallow = compute_tree_score(tree_from_resources(shallow))
+    s_dense = compute_tree_score(tree_from_resources(dense))
+    assert s_dense > s_shallow
+
+
+def test_compare_trees_none_handling():
+    assert compare_trees(None, None)
+    assert not compare_trees(None, SortedTreeNode())
