@@ -50,8 +50,9 @@ LINKS_SUFFIX = "enumLinks"  # ICI link-direction bitmask (enum resource)
 
 # ---- topology levels (innermost -> outermost) ------------------------------
 
-TPU_GRP0 = "tpugrp0"  # direct ICI neighborhood (tray / sub-cube)
-TPU_GRP1 = "tpugrp1"  # host / DCN boundary
+TPU_GRP_STEM = "tpugrp"          # level names are <stem><level-number>
+TPU_GRP0 = f"{TPU_GRP_STEM}0"    # direct ICI neighborhood (tray / sub-cube)
+TPU_GRP1 = f"{TPU_GRP_STEM}1"    # host / DCN boundary
 TOPOLOGY_LEVELS = (TPU_GRP0, TPU_GRP1)
 
 # ---- pod-level request names ----------------------------------------------

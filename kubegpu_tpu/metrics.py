@@ -1,0 +1,107 @@
+"""Prometheus-style metrics and per-pod trace spans.
+
+Mirrors the reference's observability surface (SURVEY.md §6):
+latency histograms (`kube-scheduler/pkg/metrics/metrics.go:29-67`) and
+`utiltrace`-style per-pod spans logged only when they exceed a threshold
+(`core/generic_scheduler.go:131-132`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("kubegpu_tpu")
+
+
+class Histogram:
+    """Exponential-bucket latency histogram, microsecond-valued like the
+    reference's (1ms..~16s buckets)."""
+
+    def __init__(self, name: str, start_us: float = 1000.0, factor: float = 2.0,
+                 count: int = 15):
+        self.name = name
+        self.buckets = [start_us * factor**i for i in range(count)]
+        self.counts = [0] * (count + 1)
+        self.total = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value_us: float) -> None:
+        with self._lock:
+            self.n += 1
+            self.total += value_us
+            for i, bound in enumerate(self.buckets):
+                if value_us <= bound:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate percentile from bucket counts (upper-bound estimate)."""
+        with self._lock:
+            if self.n == 0:
+                return 0.0
+            target = q * self.n
+            seen = 0
+            for i, c in enumerate(self.counts[:-1]):
+                seen += c
+                if seen >= target:
+                    return self.buckets[i]
+            return self.buckets[-1]
+
+    def mean(self) -> float:
+        with self._lock:
+            return self.total / self.n if self.n else 0.0
+
+
+class Counter:
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, by: int = 1) -> None:
+        with self._lock:
+            self.value += by
+
+
+# The reference's three scheduler histograms (`metrics.go:29-54`).
+E2E_SCHEDULING_LATENCY = Histogram("scheduler_e2e_scheduling_latency_microseconds")
+ALGORITHM_LATENCY = Histogram("scheduler_scheduling_algorithm_latency_microseconds")
+BINDING_LATENCY = Histogram("scheduler_binding_latency_microseconds")
+SCHEDULE_ATTEMPTS = Counter("scheduler_schedule_attempts_total")
+SCHEDULE_FAILURES = Counter("scheduler_schedule_failures_total")
+PREEMPTION_VICTIMS = Counter("scheduler_preemption_victims_total")
+
+
+def reset_all() -> None:
+    """Fresh metric state (tests and bench runs)."""
+    for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY):
+        h.__init__(h.name)
+    for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS):
+        c.__init__(c.name)
+
+
+class Trace:
+    """Per-operation step trace, logged only if total exceeds threshold.
+
+    Reference: utiltrace usage at `core/generic_scheduler.go:131-176` with
+    a 100ms threshold.
+    """
+
+    def __init__(self, name: str, threshold_s: float = 0.1):
+        self.name = name
+        self.threshold_s = threshold_s
+        self.start = time.perf_counter()
+        self.steps: list = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.perf_counter() - self.start, msg))
+
+    def log_if_long(self) -> None:
+        total = time.perf_counter() - self.start
+        if total >= self.threshold_s:
+            lines = "; ".join(f"{t * 1e3:.1f}ms {m}" for t, m in self.steps)
+            log.warning("trace %s took %.1fms: %s", self.name, total * 1e3, lines)

@@ -1,0 +1,6 @@
+"""Runtime hook: inject scheduled TPU allocations at container create.
+
+Reference layer L5a (`crishim/pkg/kubecri`).
+"""
+
+from kubegpu_tpu.runtime.hook import TPURuntimeHook  # noqa: F401

@@ -1,0 +1,80 @@
+"""The container-runtime (CRI) hook.
+
+Reference: `crishim/pkg/kubecri/docker_container.go:37-100` — the shim
+overrides exactly one CRI call, ``CreateContainer``: re-fetch the pod from
+the API server (fresh annotations), strip any pre-existing TPU device
+entries from the container config, sanity-check the allocation against the
+request, and append the allocated device nodes and env.
+
+Container configs are CRI-JSON-shaped dicts::
+
+    {"devices": [{"container_path", "host_path", "permissions"}],
+     "envs":    [{"key", "value"}], ...}
+
+The modern CRI (containerd) carries the same fields; dockershim's config
+rewrite maps 1:1 (SURVEY.md §8 "CRI side").
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.core import codec, grammar
+
+# Device-node prefixes this hook owns; anything else is left untouched.
+TPU_DEVICE_PREFIXES = ("/dev/accel", "/dev/vfio")
+
+
+class AllocationMismatch(RuntimeError):
+    """Annotation and request disagree — refuse to start the container
+    (`docker_container.go:58-60`)."""
+
+
+class TPURuntimeHook:
+    def __init__(self, api, dev_mgr):
+        self.api = api
+        self.dev_mgr = dev_mgr
+
+    @staticmethod
+    def _is_tpu_device(path: str) -> bool:
+        return any(path.startswith(p) for p in TPU_DEVICE_PREFIXES)
+
+    def create_container(self, pod_name: str, container_name: str,
+                         config: dict) -> dict:
+        """Rewrite one container config before the runtime sees it."""
+        kube_pod = self.api.get_pod(pod_name)
+        pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
+        cont = pod_info.container(container_name)
+        if cont is None:
+            return config
+
+        # Strip pre-existing TPU device entries: the allocation in the
+        # annotation is the only source of truth (`docker_container.go:39-57`).
+        devices = [d for d in (config.get("devices") or [])
+                   if not self._is_tpu_device(d.get("host_path", ""))]
+
+        # Sanity: the scheduler's allocation must cover the requested count
+        # (`docker_container.go:58-60`).
+        requested = int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
+        allocated_chips = sum(
+            1 for path in cont.allocate_from.values()
+            if grammar.chip_id_from_path(path) is not None)
+        if requested > 0 and allocated_chips < requested:
+            raise AllocationMismatch(
+                f"pod {pod_name}/{container_name}: requested {requested} "
+                f"chips but annotation allocates {allocated_chips}")
+
+        volumes, device_paths, env = self.dev_mgr.allocate_devices(pod_info, cont)
+        for path in device_paths:
+            devices.append({"container_path": path, "host_path": path,
+                            "permissions": "mrw"})
+        config["devices"] = devices
+
+        envs = [e for e in (config.get("envs") or [])
+                if e.get("key") not in env]
+        for key in sorted(env):
+            envs.append({"key": key, "value": env[key]})
+        config["envs"] = envs
+        # Volumes deliberately not mounted here, as in the reference
+        # (`docker_container.go:68`): the runtime's volume driver owns that.
+        config.setdefault("annotations", {})["tpu.volumes"] = \
+            ",".join(v.name for v in volumes)
+        return config

@@ -1,0 +1,9 @@
+"""Scheduler side: device-scheduler registry, the TPU plugin, and the
+standalone scheduling engine (queue, cache, fit/score/bind).
+
+Reference layers L3b/L4b/L5b (`plugins/gpuschedulerplugin`,
+`device-scheduler/device`, `kube-scheduler/pkg`).
+"""
+
+from kubegpu_tpu.scheduler.registry import DevicesScheduler  # noqa: F401
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler  # noqa: F401

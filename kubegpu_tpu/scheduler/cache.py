@@ -1,0 +1,194 @@
+"""Scheduler cache: the in-memory cluster view with device extensions.
+
+Reference: `kube-scheduler/pkg/schedulercache/` with the KubeGPU
+touch-points (SURVEY.md §2.8): each cached node carries the decoded device
+inventory (``node_ex``), pods charge/release device usage through the
+device-scheduler registry on add/remove, and assumed pods expire on a TTL
+so a crashed binding cannot leak chips (`schedulercache/cache.go:40-81`).
+
+The API server remains the checkpoint: a scheduler restart rebuilds this
+cache entirely from node/pod annotations (SURVEY.md §6 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubegpu_tpu.core import codec
+from kubegpu_tpu.core.types import NodeInfo
+
+ASSUMED_POD_TTL_S = 30.0
+
+
+class CacheCorruption(RuntimeError):
+    """An unparseable pod device annotation — fatal, like the reference's
+    panic (`node_info.go:336-340`): scheduling against corrupt accounting
+    would silently misplace every subsequent pod."""
+
+
+class CachedNode:
+    def __init__(self, kube_node: dict):
+        self.kube_node = kube_node
+        self.node_ex: NodeInfo = NodeInfo()
+        self.pod_names: set = set()
+        self.requested_core: dict = {}  # prechecked (cpu/memory) accounting
+
+    @property
+    def name(self) -> str:
+        return self.kube_node["metadata"]["name"]
+
+    def core_allocatable(self) -> dict:
+        alloc = (self.kube_node.get("status") or {}).get("allocatable") or {}
+        return {k: codec.parse_quantity(v) for k, v in alloc.items()}
+
+
+class SchedulerCache:
+    def __init__(self, device_scheduler):
+        self.device_scheduler = device_scheduler
+        self._lock = threading.RLock()
+        self.nodes: dict = {}           # name -> CachedNode
+        self._assumed: dict = {}        # pod name -> (node_name, deadline)
+
+    # ---- nodes (`node_info.go:456-492`) ------------------------------------
+
+    def set_node(self, kube_node: dict) -> None:
+        """Add/update a node: decode its device annotation (preserving the
+        in-memory ``used``) and (re-)register with the device scheduler."""
+        with self._lock:
+            name = kube_node["metadata"]["name"]
+            cached = self.nodes.get(name)
+            existing_ex = cached.node_ex if cached else None
+            node_ex = codec.annotation_to_node_info(
+                kube_node.get("metadata") or {}, existing_ex)
+            node_ex.name = name
+            if cached is None:
+                cached = CachedNode(kube_node)
+                self.nodes[name] = cached
+            else:
+                cached.kube_node = kube_node
+            cached.node_ex = node_ex
+            self.device_scheduler.add_node(name, node_ex)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            if self.nodes.pop(name, None) is not None:
+                self.device_scheduler.remove_node(name)
+
+    def get_node(self, name: str) -> CachedNode | None:
+        with self._lock:
+            return self.nodes.get(name)
+
+    def node_names(self) -> list:
+        with self._lock:
+            return sorted(self.nodes)
+
+    # ---- pod conversion (`schedulercache/devices.go:14-45`) ----------------
+
+    def pod_info_for_node(self, kube_pod: dict, node_name: str):
+        """Convert a kube pod for evaluation against one node, invalidating
+        stale per-node state when the pod was customized for another node."""
+        pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
+        if pod_info.node_name != node_name:
+            pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
+        return pod_info
+
+    # ---- pod lifecycle (`node_info.go:336-398`, `cache.go:40-81`) ----------
+
+    def _charge(self, kube_pod: dict, node_name: str, take: bool) -> None:
+        cached = self.nodes.get(node_name)
+        if cached is None:
+            return
+        try:
+            pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
+        except Exception as e:
+            raise CacheCorruption(
+                f"unparseable device annotation on pod "
+                f"{kube_pod.get('metadata', {}).get('name')}") from e
+        if take:
+            self.device_scheduler.take_pod_resources(pod_info, cached.node_ex)
+        else:
+            self.device_scheduler.return_pod_resources(pod_info, cached.node_ex)
+        sign = 1 if take else -1
+        for cont in list(pod_info.running_containers.values()) + \
+                list(pod_info.init_containers.values()):
+            for res, val in cont.kube_requests.items():
+                cached.requested_core[res] = \
+                    cached.requested_core.get(res, 0) + sign * val
+
+    def assume_pod(self, kube_pod: dict, node_name: str,
+                   now: float | None = None) -> None:
+        """Optimistically place a pod before bind confirms
+        (`scheduler.go:370-392`). Tolerates the node vanishing between
+        allocate and assume — the charge no-ops and bind will fail cleanly."""
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            self._charge(kube_pod, node_name, take=True)
+            node = self.nodes.get(node_name)
+            if node is not None:
+                node.pod_names.add(name)
+            deadline = (now if now is not None else time.monotonic()) + ASSUMED_POD_TTL_S
+            self._assumed[name] = (node_name, deadline, kube_pod)
+
+    def snapshot_node(self, name: str):
+        """Consistent point-in-time copy for lock-free fit evaluation:
+        (node_ex clone, requested_core copy, CachedNode) or None."""
+        with self._lock:
+            cached = self.nodes.get(name)
+            if cached is None:
+                return None
+            return cached.node_ex.clone(), dict(cached.requested_core), cached
+
+    def confirm_pod(self, pod_name: str) -> None:
+        """Bind succeeded: the pod is no longer merely assumed."""
+        with self._lock:
+            self._assumed.pop(pod_name, None)
+
+    def forget_pod(self, kube_pod: dict) -> None:
+        """Bind failed: release the assumed resources
+        (`scheduler.go:394-431`)."""
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            entry = self._assumed.pop(name, None)
+            if entry is None:
+                return
+            node_name = entry[0]
+            self._charge(entry[2], node_name, take=False)
+            node = self.nodes.get(node_name)
+            if node:
+                node.pod_names.discard(name)
+
+    def add_pod(self, kube_pod: dict, node_name: str) -> None:
+        """A bound pod observed from the API server. If it was assumed by
+        us, the charge already happened."""
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            if name in self._assumed:
+                self._assumed.pop(name)
+                return
+            self._charge(kube_pod, node_name, take=True)
+            if node_name in self.nodes:
+                self.nodes[node_name].pod_names.add(name)
+
+    def remove_pod(self, kube_pod: dict, node_name: str) -> None:
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            self._assumed.pop(name, None)
+            self._charge(kube_pod, node_name, take=False)
+            node = self.nodes.get(node_name)
+            if node:
+                node.pod_names.discard(name)
+
+    def expire_assumed(self, now: float | None = None) -> list:
+        """Drop assumed pods whose bind never confirmed (TTL 30s,
+        `cache.go:40-81`). Returns expired pod names."""
+        with self._lock:
+            now = now if now is not None else time.monotonic()
+            expired = [n for n, (_, dl, _) in self._assumed.items() if dl <= now]
+            for name in expired:
+                node_name, _, kube_pod = self._assumed.pop(name)
+                self._charge(kube_pod, node_name, take=False)
+                node = self.nodes.get(node_name)
+                if node:
+                    node.pod_names.discard(name)
+            return expired

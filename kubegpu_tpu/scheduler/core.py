@@ -1,0 +1,346 @@
+"""The standalone scheduling engine.
+
+Replaces the reference's forked kube-scheduler (SURVEY.md §2 row 16) with a
+compact engine exposing the same pipeline and the same five device
+touch-points (§2.8), shaped like the modern scheduler-framework:
+
+    pop -> filter (core fit + PodFitsDevices) -> score -> select host
+        -> allocate devices (fills allocate_from, writes pod annotation)
+        -> assume (charge cache) -> bind (annotation first, then binding)
+
+Scheduling state is rebuilt from the API server on restart — the cache is
+disposable, annotations are the checkpoint (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.core import codec
+from kubegpu_tpu.scheduler.cache import SchedulerCache
+from kubegpu_tpu.scheduler.queue import SchedulingQueue
+
+# Parallel fit evaluation width (reference: 16 workers,
+# `core/generic_scheduler.go:310-383`).
+DEFAULT_PARALLELISM = 16
+
+
+class FitError(Exception):
+    def __init__(self, pod_name: str, failures: dict):
+        self.pod_name = pod_name
+        self.failures = failures  # node name -> [reason strings]
+        super().__init__(f"pod {pod_name} fits no node: {failures}")
+
+
+def _pod_core_requests(kube_pod: dict) -> dict:
+    out: dict = {}
+    spec = kube_pod.get("spec") or {}
+    for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
+        for res, val in ((c.get("resources") or {}).get("requests") or {}).items():
+            out[res] = out.get(res, 0) + codec.parse_quantity(val)
+    return out
+
+
+def _pod_priority(kube_pod: dict) -> int:
+    return int((kube_pod.get("spec") or {}).get("priority") or 0)
+
+
+class GenericScheduler:
+    """Fit/score/select/allocate (`core/generic_scheduler.go:130-188`)."""
+
+    def __init__(self, cache: SchedulerCache, device_scheduler,
+                 parallelism: int = DEFAULT_PARALLELISM):
+        self.cache = cache
+        self.device_scheduler = device_scheduler
+        self.parallelism = max(1, parallelism)
+        self._last_node_index = 0
+        self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
+                                        thread_name_prefix="fit")
+
+    # ---- predicates --------------------------------------------------------
+
+    @staticmethod
+    def _core_fits(kube_pod: dict, cached, requested_core: dict) -> tuple[bool, list]:
+        """The stock PodFitsResources predicate for prechecked resources."""
+        alloc = cached.core_allocatable()
+        reasons = []
+        for res, req in _pod_core_requests(kube_pod).items():
+            if res not in alloc:
+                continue  # unknown core resources are not our predicate
+            if req + requested_core.get(res, 0) > alloc[res]:
+                reasons.append(f"Insufficient {res}")
+        return not reasons, reasons
+
+    def _fits_on_node(self, kube_pod: dict, node_name: str):
+        # Evaluate against a point-in-time snapshot so concurrent watcher
+        # mutations of node usage cannot tear mid-fit.
+        snap = self.cache.snapshot_node(node_name)
+        if snap is None:
+            return False, ["node gone"], 0.0
+        node_ex, requested_core, cached = snap
+        ok_core, core_reasons = self._core_fits(kube_pod, cached, requested_core)
+        if not ok_core:
+            return False, core_reasons, 0.0
+        pod_info = self.cache.pod_info_for_node(kube_pod, node_name)
+        fits, reasons, score = self.device_scheduler.pod_fits_resources(
+            pod_info, node_ex, False)
+        return fits, [str(r) for r in reasons], score
+
+    def find_nodes_that_fit(self, kube_pod: dict):
+        """Parallel filter over all nodes (`generic_scheduler.go:310-383`)."""
+        names = self.cache.node_names()
+        results = list(self._pool.map(
+            lambda n: (n, *self._fits_on_node(kube_pod, n)), names))
+        feasible = {n: score for n, ok, _, score in results if ok}
+        failures = {n: reasons for n, ok, reasons, _ in results if not ok}
+        return feasible, failures
+
+    def select_host(self, scored: dict) -> str:
+        """Max score; round-robin among ties for spreading
+        (`generic_scheduler.go:204-223`)."""
+        best = max(scored.values())
+        top = sorted(n for n, s in scored.items() if s == best)
+        self._last_node_index += 1
+        return top[self._last_node_index % len(top)]
+
+    def schedule(self, kube_pod: dict) -> str:
+        """Choose a host (`generic_scheduler.go:130-188`)."""
+        pod_name = kube_pod["metadata"]["name"]
+        trace = metrics.Trace(f"schedule {pod_name}")
+        t0 = time.perf_counter()
+        feasible, failures = self.find_nodes_that_fit(kube_pod)
+        trace.step("computed predicates")
+        if not feasible:
+            trace.log_if_long()
+            raise FitError(pod_name, failures)
+        host = (next(iter(feasible)) if len(feasible) == 1
+                else self.select_host(feasible))
+        trace.step("selected host")
+        metrics.ALGORITHM_LATENCY.observe((time.perf_counter() - t0) * 1e6)
+        trace.log_if_long()
+        return host
+
+    def allocate_devices(self, kube_pod: dict, node_name: str) -> dict:
+        """Re-run the device scheduler with allocation on, then serialize
+        the decision into the pod's annotation **in memory**
+        (`generic_scheduler.go:108-125`)."""
+        snap = self.cache.snapshot_node(node_name)
+        if snap is None:
+            raise FitError(kube_pod["metadata"]["name"], {node_name: ["node gone"]})
+        node_ex, _, _ = snap
+        pod_info = self.cache.pod_info_for_node(kube_pod, node_name)
+        self.device_scheduler.pod_allocate(pod_info, node_ex)
+        pod_info.node_name = node_name
+        codec.pod_info_to_annotation(kube_pod.setdefault("metadata", {}), pod_info)
+        return kube_pod
+
+    # ---- preemption (`generic_scheduler.go:226-290`, simplified) ----------
+
+    def preempt(self, kube_pod: dict):
+        """Find the node where evicting the fewest lowest-priority pods
+        makes room. Returns (node_name, victim pod dicts) or None."""
+        prio = _pod_priority(kube_pod)
+        best = None
+        for node_name in self.cache.node_names():
+            snap = self.cache.snapshot_node(node_name)
+            if snap is None:
+                continue
+            victims = self._victims_on_node(kube_pod, snap, prio)
+            if victims is None:
+                continue
+            if best is None or len(victims) < len(best[1]):
+                best = (node_name, victims)
+        return best
+
+    def _victims_on_node(self, kube_pod, snap, prio):
+        from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
+
+        sim, core_free, cached = snap
+        api = getattr(self, "api", None)
+        if api is None:
+            return None
+        candidates = []
+        for pod_name in sorted(cached.pod_names):
+            try:
+                p = api.get_pod(pod_name)
+            except NotFound:
+                continue
+            if _pod_priority(p) < prio:
+                candidates.append(p)
+        if not candidates:
+            return None
+        candidates.sort(key=_pod_priority)
+        victims = []
+        for victim in candidates:
+            v_info = codec.kube_pod_to_pod_info(victim, invalidate_existing=False)
+            self.device_scheduler.return_pod_resources(v_info, sim)
+            for res, val in _pod_core_requests(victim).items():
+                core_free[res] = core_free.get(res, 0) - val
+            victims.append(victim)
+            alloc = cached.core_allocatable()
+            core_ok = all(
+                req + core_free.get(res, 0) <= alloc[res]
+                for res, req in _pod_core_requests(kube_pod).items()
+                if res in alloc)
+            pod_info = self.cache.pod_info_for_node(kube_pod, cached.name)
+            fits, _, _ = self.device_scheduler.pod_fits_resources(pod_info, sim, False)
+            if core_ok and fits:
+                return victims
+        return None
+
+
+class Scheduler:
+    """The control loop: queue -> schedule -> assume -> bind
+    (`kube-scheduler/pkg/scheduler.go:174-502`)."""
+
+    def __init__(self, api, device_scheduler, bind_async: bool = False,
+                 parallelism: int = DEFAULT_PARALLELISM):
+        self.api = api
+        self.device_scheduler = device_scheduler
+        self.cache = SchedulerCache(device_scheduler)
+        self.queue = SchedulingQueue()
+        self.generic = GenericScheduler(self.cache, device_scheduler, parallelism)
+        self.generic.api = api
+        self.bind_async = bind_async
+        self.preemption_enabled = True
+        self._stop = threading.Event()
+        api.add_watcher(self._on_event)
+        self._sync_existing()
+
+    # ---- informer plumbing -------------------------------------------------
+
+    def _sync_existing(self) -> None:
+        """Cold start / restart: rebuild state from the API server — the
+        annotations are the checkpoint."""
+        for node in self.api.list_nodes():
+            self.cache.set_node(node)
+        for pod in self.api.list_pods():
+            node_name = (pod.get("spec") or {}).get("nodeName")
+            if node_name:
+                self.cache.add_pod(pod, node_name)
+            else:
+                self.queue.push(pod)
+
+    def _on_event(self, kind: str, event: str, obj: dict) -> None:
+        if kind == "node":
+            name = obj["metadata"]["name"]
+            if event in ("added", "modified"):
+                self.cache.set_node(obj)
+                self.queue.move_all_to_active()
+            elif event == "deleted":
+                self.cache.remove_node(name)
+        elif kind == "pod":
+            node_name = (obj.get("spec") or {}).get("nodeName")
+            if event == "added" and not node_name:
+                self.queue.push(obj)
+            elif event == "added" and node_name:
+                # externally-bound pod (static pod / other binder): charge it
+                self.cache.add_pod(obj, node_name)
+            elif event == "deleted":
+                self.queue.forget(obj["metadata"]["name"])
+                if node_name:
+                    self.cache.remove_pod(obj, node_name)
+                self.queue.move_all_to_active()
+
+    # ---- the loop (`scheduler.go:439-502`) ---------------------------------
+
+    def schedule_one(self, timeout: float = 0.0) -> bool:
+        """One pass; returns False when the queue stayed empty."""
+        kube_pod = self.queue.pop(timeout=timeout)
+        if kube_pod is None:
+            return False
+        name = kube_pod["metadata"]["name"]
+        try:
+            current = self.api.get_pod(name)
+        except KeyError:
+            return True  # deleted while queued
+        if (current.get("spec") or {}).get("nodeName"):
+            return True  # already bound elsewhere
+        kube_pod = current
+
+        metrics.SCHEDULE_ATTEMPTS.inc()
+        t0 = time.perf_counter()
+        self.cache.expire_assumed()
+        try:
+            host = self.generic.schedule(kube_pod)
+            self.generic.allocate_devices(kube_pod, host)
+        except FitError:
+            metrics.SCHEDULE_FAILURES.inc()
+            if self.preemption_enabled and self._try_preempt(kube_pod):
+                self.queue.push(kube_pod)
+            else:
+                self.queue.add_unschedulable(kube_pod)
+            return True
+        except Exception:
+            metrics.SCHEDULE_FAILURES.inc()
+            self.queue.add_unschedulable(kube_pod)
+            return True
+
+        self.cache.assume_pod(kube_pod, host)
+        if self.bind_async:
+            threading.Thread(target=self._bind, args=(kube_pod, host, t0),
+                             daemon=True).start()
+        else:
+            self._bind(kube_pod, host, t0)
+        return True
+
+    def _try_preempt(self, kube_pod: dict) -> bool:
+        found = self.generic.preempt(kube_pod)
+        if not found:
+            return False
+        node_name, victims = found
+        for victim in victims:
+            metrics.PREEMPTION_VICTIMS.inc()
+            self.api.delete_pod(victim["metadata"]["name"])
+        return True
+
+    def _bind(self, kube_pod: dict, host: str, t0: float) -> None:
+        """Annotation first, then the binding — the kubelet-side hook must
+        see allocate_from the moment the pod lands (`scheduler.go:405-417`)."""
+        name = kube_pod["metadata"]["name"]
+        tb = time.perf_counter()
+        try:
+            self.api.update_pod_annotations(
+                name, kube_pod["metadata"].get("annotations") or {})
+            self.api.bind_pod(name, host)
+        except Exception:
+            self.cache.forget_pod(kube_pod)
+            self.queue.add_unschedulable(kube_pod)
+            return
+        self.cache.confirm_pod(name)
+        self.queue.forget(name)  # clears any leftover backoff state
+        now = time.perf_counter()
+        metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
+        metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
+
+    def run_until_idle(self, max_passes: int = 10000) -> int:
+        """Drain the queue synchronously (tests, benchmarks). Returns the
+        number of pods processed."""
+        n = 0
+        while n < max_passes and self.schedule_one(timeout=0.0):
+            n += 1
+        return n
+
+    def run_forever(self, poll_s: float = 0.2) -> None:
+        while not self._stop.is_set():
+            try:
+                if not self.schedule_one(timeout=poll_s):
+                    time.sleep(0)
+            except Exception:
+                # One bad pod or a racing node deletion must not kill the
+                # scheduling thread.
+                metrics.log.exception("schedule_one failed")
+                time.sleep(0.01)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run_forever, daemon=True,
+                             name="scheduler")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.generic._pool.shutdown(wait=False)

@@ -1,0 +1,105 @@
+"""Scheduling queue with unschedulable backoff.
+
+Reference: `kube-scheduler/pkg/core/scheduling_queue.go` +
+`util/backoff_utils.go`, reduced to the behaviors the engine needs:
+priority-FIFO active queue, an unschedulable set with exponential per-pod
+backoff, and "move everything back to active" on cluster events (a new
+node may make unschedulable pods feasible).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+
+INITIAL_BACKOFF_S = 1.0
+MAX_BACKOFF_S = 60.0
+
+
+class SchedulingQueue:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._heap: list = []            # (-priority, seq, pod_name)
+        self._pods: dict = {}            # name -> kube_pod
+        self._seq = itertools.count()
+        self._unschedulable: dict = {}   # name -> (kube_pod, retry_at)
+        self._backoff: dict = {}         # name -> current backoff seconds
+
+    @staticmethod
+    def _priority(pod: dict) -> int:
+        return int((pod.get("spec") or {}).get("priority") or 0)
+
+    def push(self, kube_pod: dict) -> None:
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            if name in self._pods:
+                self._pods[name] = kube_pod
+                return
+            self._pods[name] = kube_pod
+            heapq.heappush(self._heap, (-self._priority(kube_pod),
+                                        next(self._seq), name))
+            self._lock.notify()
+
+    def pop(self, timeout: float | None = None):
+        """Highest-priority pending pod, blocking up to ``timeout``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                self._admit_backed_off_locked()
+                while self._heap:
+                    _, _, name = heapq.heappop(self._heap)
+                    pod = self._pods.pop(name, None)
+                    if pod is not None:
+                        return pod
+                wait = 0.05
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining)
+                self._lock.wait(wait)
+
+    def add_unschedulable(self, kube_pod: dict) -> None:
+        """Park a pod that found no node, with exponential backoff
+        (`backoff_utils.go`)."""
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            backoff = min(self._backoff.get(name, INITIAL_BACKOFF_S / 2) * 2,
+                          MAX_BACKOFF_S)
+            self._backoff[name] = backoff
+            self._unschedulable[name] = (kube_pod, time.monotonic() + backoff)
+
+    def _admit_backed_off_locked(self) -> None:
+        now = time.monotonic()
+        ready = [n for n, (_, at) in self._unschedulable.items() if at <= now]
+        for name in ready:
+            pod, _ = self._unschedulable.pop(name)
+            if name not in self._pods:
+                self._pods[name] = pod
+                heapq.heappush(self._heap, (-self._priority(pod),
+                                            next(self._seq), name))
+
+    def move_all_to_active(self) -> None:
+        """Cluster changed (node added/updated): retry everything now
+        (`scheduling_queue.go:229-252`)."""
+        with self._lock:
+            for name, (pod, _) in list(self._unschedulable.items()):
+                self._unschedulable.pop(name)
+                self._backoff.pop(name, None)
+                if name not in self._pods:
+                    self._pods[name] = pod
+                    heapq.heappush(self._heap, (-self._priority(pod),
+                                                next(self._seq), name))
+            self._lock.notify_all()
+
+    def forget(self, pod_name: str) -> None:
+        with self._lock:
+            self._pods.pop(pod_name, None)
+            self._unschedulable.pop(pod_name, None)
+            self._backoff.pop(pod_name, None)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pods) + len(self._unschedulable)

@@ -1,0 +1,68 @@
+"""The device-scheduler registry.
+
+Holds an ordered list of device scheduler plugins and fans scheduling
+operations out to them. Exactly one plugin — the *last* group-capable one —
+triggers the shared group-allocator pass, so multiple device families can
+coexist without double-running the allocator
+(`device-scheduler/device/devicescheduler.go:23-36`).
+
+Plugins are compiled-in Python objects rather than Go `plugin.Open` .so
+loading — the reference itself half-abandoned dynamic loading
+(`devicescheduler.go:11-13,80-85`), and SURVEY.md §8 recommends a
+compiled-in registry.
+"""
+
+from __future__ import annotations
+
+
+class DevicesScheduler:
+    def __init__(self):
+        self.devices: list = []
+        self.run_group_scheduler: list = []
+
+    def add_device(self, device) -> None:
+        """Register a plugin; the last group-capable plugin owns the shared
+        group-allocation pass (`devicescheduler.go:23-36`)."""
+        self.devices.append(device)
+        if device.uses_group_scheduler():
+            self.run_group_scheduler = [False] * len(self.run_group_scheduler)
+            self.run_group_scheduler.append(True)
+        else:
+            self.run_group_scheduler.append(False)
+
+    def add_node(self, node_name: str, node_info) -> None:
+        for d in self.devices:
+            d.add_node(node_name, node_info)
+
+    def remove_node(self, node_name: str) -> None:
+        for d in self.devices:
+            d.remove_node(node_name)
+
+    def pod_fits_resources(self, pod_info, node_info, fill_allocate_from):
+        """Aggregate fit/score/reasons across plugins
+        (`devicescheduler.go:88-100`)."""
+        total_score = 0.0
+        total_fit = True
+        reasons: list = []
+        for run_grp, d in zip(self.run_group_scheduler, self.devices):
+            fit, rs, score = d.pod_fits_device(
+                node_info, pod_info, fill_allocate_from, run_grp)
+            total_score += score
+            total_fit = total_fit and fit
+            if rs:
+                reasons.extend(rs)
+        return total_fit, reasons, total_score
+
+    def pod_allocate(self, pod_info, node_info) -> None:
+        """Fill allocate_from on the chosen node; raises on failure
+        (`devicescheduler.go:103-111`)."""
+        for run_grp, d in zip(self.run_group_scheduler, self.devices):
+            d.pod_allocate(node_info, pod_info, run_grp)
+
+    def take_pod_resources(self, pod_info, node_info) -> None:
+        for run_grp, d in zip(self.run_group_scheduler, self.devices):
+            d.take_pod_resources(node_info, pod_info, run_grp)
+
+    def return_pod_resources(self, pod_info, node_info) -> None:
+        for run_grp, d in zip(self.run_group_scheduler, self.devices):
+            d.return_pod_resources(node_info, pod_info, run_grp)

@@ -1,0 +1,328 @@
+"""The TPU device-scheduler plugin.
+
+TPU analogue of the reference's GPU plugin (`plugins/gpuschedulerplugin/`),
+with three request-translation modes selected by pod-level knobs:
+
+1. **Explicit / count** (default): flat ``alpha.tpu/numchips`` counts become
+   per-chip group requests (plus per-chip HBM floors via
+   ``alpha.tpu/hbm-per-chip``), then topology-promoted to the node's
+   advertised hierarchy depth (`gpu.go:16-66`).
+2. **Auto-topology** (``alpha.tpu/tpu-generate-topology: 1``): requests are
+   rewritten to the best-connected inventory shape present in the cluster,
+   via the canonical shape-tree cache (`gpu.go:102-261`).
+3. **Contiguous** (``alpha.tpu/contiguous: 1``): TPU-specific upgrade with
+   no reference equivalent — chips must form an ICI-contiguous sub-mesh.
+   The plugin recovers chip coordinates from the node's advertised paths,
+   searches the *free* chip set for the most compact contiguous block, and
+   pins the request to those exact chips; the group allocator then
+   validates availability and fills ``allocate_from``.
+"""
+
+from __future__ import annotations
+
+import re
+
+from kubegpu_tpu.allocator import grpalloc
+from kubegpu_tpu.allocator.translate import (
+    InsufficientResourceError,
+    translate_resource,
+)
+from kubegpu_tpu.core import grammar
+from kubegpu_tpu.core.types import DEVICE_GROUP_PREFIX, NodeInfo, PodInfo
+from kubegpu_tpu.topology import mesh as mesh_mod
+from kubegpu_tpu.topology.tree import (
+    compare_trees,
+    compute_tree_score,
+    tree_from_resources,
+)
+from kubegpu_tpu.utils import sorted_keys
+
+RESOURCE_CONTIGUOUS = "alpha.tpu/contiguous"
+
+_CHIP_REQ_RE = re.compile(
+    re.escape(DEVICE_GROUP_PREFIX) + rf".*/{grammar.TPU_LEAF}/(.*?)/{grammar.CHIPS_SUFFIX}")
+_TPU_PATH_RE = re.compile(rf".*/{grammar.TPU_LEAF}/.*")
+
+
+def translate_chip_count(num_chips: int, hbm_per_chip: int,
+                         node_resources: dict, requests: dict) -> dict:
+    """Expand a flat chip count into per-chip group requests, then promote
+    to the node's hierarchy depth (`gpu.go:16-66`)."""
+    need_translation = any(_CHIP_REQ_RE.match(res) for res in node_resources)
+    if not need_translation:
+        return requests
+
+    have = 0
+    max_index = -1
+    for res in requests:
+        m = _CHIP_REQ_RE.match(res)
+        if m:
+            have += 1
+            try:
+                max_index = max(max_index, int(m.group(1)))
+            except ValueError:
+                pass
+    requests = dict(requests)
+    for i in range(num_chips - have):
+        idx = max_index + i + 1
+        requests[f"{DEVICE_GROUP_PREFIX}/{grammar.TPU_LEAF}/{idx}/{grammar.CHIPS_SUFFIX}"] = 1
+        if hbm_per_chip > 0:
+            requests[f"{DEVICE_GROUP_PREFIX}/{grammar.TPU_LEAF}/{idx}/{grammar.HBM_SUFFIX}"] = hbm_per_chip
+
+    for this_stage, next_stage in ((grammar.TPU_GRP0, grammar.TPU_LEAF),
+                                   (grammar.TPU_GRP1, grammar.TPU_GRP0)):
+        _, requests = translate_resource(node_resources, requests,
+                                         this_stage, next_stage)
+    return requests
+
+
+class ShapeCache:
+    """Cluster-wide canonical inventory-shape cache (`gpu.go:102-183`).
+
+    Nodes with structurally identical topologies share one tree entry, so
+    auto-topology answers "best shape with >= n chips" without scanning
+    every node.
+    """
+
+    def __init__(self):
+        self._entries: list = []       # [tree, node_names:set, score]
+        self._node_entry: dict = {}    # node_name -> entry
+
+    def add_node(self, node_name: str, resources: dict) -> None:
+        if not resources:
+            return
+        tree = tree_from_resources(resources)
+        current = self._node_entry.get(node_name)
+        if current is not None and compare_trees(tree, current[0]):
+            return
+        self.remove_node(node_name)
+        for entry in self._entries:
+            if compare_trees(tree, entry[0]):
+                entry[1].add(node_name)
+                self._node_entry[node_name] = entry
+                return
+        entry = [tree, {node_name}, compute_tree_score(tree)]
+        self._entries.append(entry)
+        self._node_entry[node_name] = entry
+
+    def remove_node(self, node_name: str) -> None:
+        entry = self._node_entry.pop(node_name, None)
+        if entry is not None:
+            entry[1].discard(node_name)
+            if not entry[1]:
+                self._entries.remove(entry)
+
+    def __len__(self):
+        return len(self._entries)
+
+    def best_tree(self, num_chips: int):
+        """Highest-scoring cached shape with capacity >= num_chips
+        (`gpu.go:170-183`)."""
+        best = None
+        best_score = 0.0
+        for tree, _, score in self._entries:
+            if tree.val >= num_chips and score > best_score:
+                best, best_score = tree, score
+        return best
+
+
+def _assign_chips(tree, prefix: str, level: int, num_left: list) -> dict:
+    """Walk a shape tree emitting chip requests shaped like it
+    (`gpu.go:185-209`)."""
+    out: dict = {}
+    if level == 0:
+        take = min(tree.val, num_left[0])
+        for i in range(take):
+            out[f"{prefix}/{grammar.TPU_LEAF}/{i}/{grammar.CHIPS_SUFFIX}"] = 1
+        num_left[0] -= take
+    else:
+        for i, child in enumerate(tree.children):
+            new_prefix = f"{prefix}{level - 1}/{i}"
+            if level - 1 != 0:
+                new_prefix += f"/{grammar.TPU_GRP_STEM}"
+            out.update(_assign_chips(child, new_prefix, level - 1, num_left))
+    return out
+
+
+def _rewrite_to_tree(tree, cont) -> None:
+    """Replace a container's TPU requests with best-tree-shaped ones
+    (`gpu.go:211-228`)."""
+    cont.dev_requests = {
+        k: v for k, v in cont.dev_requests.items() if not _TPU_PATH_RE.match(k)
+    }
+    num = [int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))]
+    prefix = f"{DEVICE_GROUP_PREFIX}/{grammar.TPU_GRP_STEM}"
+    cont.dev_requests.update(_assign_chips(tree, prefix, 2, num))
+
+
+class TPUScheduler:
+    """DeviceScheduler implementation for TPU chips
+    (`gpu_scheduler.go:18-108`)."""
+
+    def __init__(self):
+        self.shape_cache = ShapeCache()
+
+    def get_name(self) -> str:
+        return "tpu"
+
+    def uses_group_scheduler(self) -> bool:
+        return True
+
+    # ---- node lifecycle ----------------------------------------------------
+
+    def add_node(self, node_name: str, node_info: NodeInfo) -> None:
+        self.shape_cache.add_node(node_name, node_info.allocatable)
+
+    def remove_node(self, node_name: str) -> None:
+        self.shape_cache.remove_node(node_name)
+
+    # ---- request translation ----------------------------------------------
+
+    def _translate(self, node_info: NodeInfo, pod_info: PodInfo) -> tuple[bool, list]:
+        mode = int(pod_info.requests.get(grammar.TPU_TOPOLOGY_GENERATION, 0))
+        if int(pod_info.requests.get(RESOURCE_CONTIGUOUS, 0)) == 1:
+            return self._translate_contiguous(node_info, pod_info)
+        if mode == 0:
+            reasons: list = []
+            for name, cont, _ in pod_info.all_containers():
+                num = int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
+                hbm = int(cont.requests.get(grammar.RESOURCE_HBM_PER_CHIP, 0))
+                cont.dev_requests = translate_chip_count(
+                    num, hbm, node_info.allocatable, cont.dev_requests)
+                # A chip demand the node's inventory could not absorb (e.g.
+                # a chipless node, where translation is a no-op) must fail
+                # the predicate — numchips itself is prechecked and would
+                # otherwise fit vacuously.
+                have = sum(1 for r in cont.dev_requests if _CHIP_REQ_RE.match(r))
+                if num > have:
+                    reasons.append(InsufficientResourceError(
+                        f"{name}/{grammar.RESOURCE_NUM_CHIPS}", num, 0, have))
+            return not reasons, reasons
+        if mode == 1:
+            return self._translate_auto_topology(pod_info)
+        return False, [InsufficientResourceError(
+            grammar.TPU_TOPOLOGY_GENERATION, mode, 0, 1)]
+
+    def _translate_auto_topology(self, pod_info: PodInfo) -> tuple[bool, list]:
+        """Rewrite requests to the cluster's best shape (`gpu.go:231-261`)."""
+        num = 0
+        for cont in pod_info.running_containers.values():
+            num += int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
+        for cont in pod_info.init_containers.values():
+            num = max(num, int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0)))
+        tree = self.shape_cache.best_tree(num)
+        if tree is None:
+            return False, [InsufficientResourceError(
+                grammar.RESOURCE_NUM_CHIPS, num, 0, 0)]
+        for name in sorted_keys(pod_info.running_containers):
+            _rewrite_to_tree(tree, pod_info.running_containers[name])
+        for name in sorted_keys(pod_info.init_containers):
+            _rewrite_to_tree(tree, pod_info.init_containers[name])
+        return True, []
+
+    def _node_chip_map(self, node_info: NodeInfo) -> dict:
+        """chip path prefix -> mesh coords, from the advertised grammar."""
+        chips: dict = {}
+        for res in node_info.allocatable:
+            chip_id = grammar.chip_id_from_path(res)
+            if chip_id is None:
+                continue
+            coords = grammar.coords_from_chip_id(chip_id)
+            if coords is None or len(coords) != 3:
+                continue
+            chips[res[: -len(f"/{grammar.CHIPS_SUFFIX}")]] = coords
+        return chips
+
+    def _translate_contiguous(self, node_info: NodeInfo,
+                              pod_info: PodInfo) -> tuple[bool, list]:
+        """Pin each container's chips to an ICI-contiguous free block."""
+        chip_map = self._node_chip_map(node_info)
+        if not chip_map:
+            return False, [InsufficientResourceError(RESOURCE_CONTIGUOUS, 1, 0, 0)]
+        coords_to_prefix = {c: p for p, c in chip_map.items()}
+        origin = tuple(min(c[i] for c in coords_to_prefix) for i in range(3))
+        extent = tuple(
+            max(c[i] for c in coords_to_prefix) - origin[i] + 1 for i in range(3))
+        mesh = mesh_mod.ICIMesh(extent)
+
+        free = {
+            tuple(c[i] - origin[i] for i in range(3))
+            for p, c in chip_map.items()
+            if node_info.used.get(f"{p}/{grammar.CHIPS_SUFFIX}", 0) == 0
+        }
+        reasons: list = []
+        for name, cont, _ in pod_info.all_containers():
+            num = int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
+            hbm = int(cont.requests.get(grammar.RESOURCE_HBM_PER_CHIP, 0))
+            if num == 0:
+                continue
+            if cont.allocate_from:
+                # Already placed (idempotent re-check): keep the pinned
+                # requests; just keep its chips out of the free set.
+                for path in cont.allocate_from.values():
+                    cid = grammar.chip_id_from_path(path)
+                    coords = grammar.coords_from_chip_id(cid) if cid else None
+                    if coords:
+                        free.discard(tuple(c - o for c, o in zip(coords, origin)))
+                continue
+            block = mesh_mod.find_contiguous_block(mesh, free, num)
+            if block is None:
+                reasons.append(InsufficientResourceError(
+                    f"{name}/{RESOURCE_CONTIGUOUS}", num, 0, len(free)))
+                continue
+            cont.dev_requests = {
+                k: v for k, v in cont.dev_requests.items()
+                if not grammar.is_group_resource(k)
+            }
+            # Pin by deciding: group-request indices are only labels, so the
+            # allocator is free to permute chips inside a group. Contiguity
+            # is an exact-chip constraint — the plugin therefore sets
+            # allocate_from itself and the allocator's idempotent re-score
+            # path (`grpallocate.go:471-480`) validates availability and
+            # charges usage.
+            for rel in block:
+                abs_coords = tuple(rel[i] + origin[i] for i in range(3))
+                prefix = coords_to_prefix[abs_coords]
+                cont.dev_requests[f"{prefix}/{grammar.CHIPS_SUFFIX}"] = 1
+                cont.allocate_from[f"{prefix}/{grammar.CHIPS_SUFFIX}"] = \
+                    f"{prefix}/{grammar.CHIPS_SUFFIX}"
+                if hbm > 0:
+                    cont.dev_requests[f"{prefix}/{grammar.HBM_SUFFIX}"] = hbm
+                    cont.allocate_from[f"{prefix}/{grammar.HBM_SUFFIX}"] = \
+                        f"{prefix}/{grammar.HBM_SUFFIX}"
+            free -= set(block)
+        return not reasons, reasons
+
+    # ---- DeviceScheduler surface (`gpu_scheduler.go:54-99`) ---------------
+
+    def pod_fits_device(self, node_info: NodeInfo, pod_info: PodInfo,
+                        fill_allocate_from: bool, run_grp_scheduler: bool):
+        ok, reasons = self._translate(node_info, pod_info)
+        if not ok:
+            return False, reasons, 0.0
+        if run_grp_scheduler:
+            return grpalloc.pod_fits_group_constraints(
+                node_info, pod_info, fill_allocate_from)
+        return True, [], 0.0
+
+    def pod_allocate(self, node_info: NodeInfo, pod_info: PodInfo,
+                     run_grp_scheduler: bool) -> None:
+        ok, reasons = self._translate(node_info, pod_info)
+        if not ok:
+            raise RuntimeError(f"TPU translation failed: {[str(r) for r in reasons]}")
+        if run_grp_scheduler:
+            fits, reasons, _ = grpalloc.pod_fits_group_constraints(
+                node_info, pod_info, True)
+            if not fits:
+                raise RuntimeError(
+                    f"pod {pod_info.name} no longer fits: {[str(r) for r in reasons]}")
+
+    def take_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo,
+                           run_grp_scheduler: bool) -> None:
+        if run_grp_scheduler:
+            grpalloc.take_pod_group_resource(node_info, pod_info)
+
+    def return_pod_resources(self, node_info: NodeInfo, pod_info: PodInfo,
+                             run_grp_scheduler: bool) -> None:
+        if run_grp_scheduler:
+            grpalloc.return_pod_group_resource(node_info, pod_info)

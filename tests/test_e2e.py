@@ -1,0 +1,216 @@
+"""End-to-end slice (SURVEY.md §8): advertiser -> API server -> scheduler ->
+bound pod annotation -> runtime hook env injection.
+
+This is BASELINE configs 1-3 driven without a real cluster, exactly how the
+reference tests itself.
+"""
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
+from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+from kubegpu_tpu.node.fake import FakeTPUBackend, single_chip_inventory, v5p_host_inventory
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+from kubegpu_tpu.runtime.hook import AllocationMismatch, TPURuntimeHook
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import RESOURCE_CONTIGUOUS, TPUScheduler
+
+G = "alpha/grpresource"
+
+
+def tpu_pod(name, numchips, priority=0, pod_requests=None, hbm=0):
+    pi = PodInfo(name=name, requests=dict(pod_requests or {}))
+    reqs = {grammar.RESOURCE_NUM_CHIPS: numchips}
+    if hbm:
+        reqs[grammar.RESOURCE_HBM_PER_CHIP] = hbm
+    pi.running_containers["main"] = ContainerInfo(requests=reqs)
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    return {"metadata": meta,
+            "spec": {"priority": priority,
+                     "containers": [{"name": "main",
+                                     "resources": {"requests": {"cpu": "1"}}}]}}
+
+
+class TPUHost:
+    """One simulated host: backend + manager + advertiser + runtime hook."""
+
+    def __init__(self, api, name, inventory=None):
+        self.api = api
+        self.name = name
+        api.create_node({"metadata": {"name": name},
+                         "status": {"allocatable": {"cpu": "16", "pods": 100}}})
+        self.backend = FakeTPUBackend(inventory or v5p_host_inventory())
+        self.dev_mgr = DevicesManager()
+        self.dev_mgr.add_device(TPUDeviceManager(self.backend))
+        self.dev_mgr.start()
+        self.advertiser = DeviceAdvertiser(api, self.dev_mgr, name)
+        self.advertiser.advertise_once()
+        self.hook = TPURuntimeHook(api, self.dev_mgr)
+
+
+def make_cluster(n_hosts=1, inventory_fn=None):
+    api = InMemoryAPIServer()
+    hosts = {}
+    for i in range(n_hosts):
+        name = f"host{i}"
+        inv = inventory_fn() if inventory_fn else None
+        hosts[name] = TPUHost(api, name, inv)
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched = Scheduler(api, ds)
+    return api, hosts, sched
+
+
+def chips_from_env(env_list):
+    for e in env_list:
+        if e["key"] == "TPU_CHIP_IDS":
+            return e["value"].split(",")
+    return []
+
+
+def test_single_chip_pod_no_topology():
+    """BASELINE config 1: 1-chip pod, no constraints."""
+    api, hosts, sched = make_cluster(inventory_fn=single_chip_inventory)
+    api.create_pod(tpu_pod("p", 1))
+    assert sched.run_until_idle() >= 1
+    pod = api.get_pod("p")
+    assert pod["spec"]["nodeName"] == "host0"
+    config = hosts["host0"].hook.create_container("p", "main", {})
+    assert any(e["key"] == "TPU_VISIBLE_CHIPS" and e["value"] == "0"
+               for e in config["envs"])
+    assert {d["host_path"] for d in config["devices"]} == {"/dev/accel0"}
+
+
+def test_full_lifecycle_two_pods_then_contention():
+    api, hosts, sched = make_cluster()
+    api.create_pod(tpu_pod("a", 2))
+    api.create_pod(tpu_pod("b", 2))
+    api.create_pod(tpu_pod("c", 2))
+    sched.run_until_idle()
+
+    a, b, c = (api.get_pod(n) for n in "abc")
+    assert a["spec"]["nodeName"] == "host0"
+    assert b["spec"]["nodeName"] == "host0"
+    assert c["spec"].get("nodeName") is None  # only 4 chips
+
+    cfg_a = hosts["host0"].hook.create_container("a", "main", {})
+    cfg_b = hosts["host0"].hook.create_container("b", "main", {})
+    chips_a, chips_b = chips_from_env(cfg_a["envs"]), chips_from_env(cfg_b["envs"])
+    assert len(chips_a) == 2 and len(chips_b) == 2
+    assert set(chips_a).isdisjoint(chips_b)
+
+    # delete a -> c becomes schedulable (watch -> move_all_to_active)
+    api.delete_pod("a")
+    sched.run_until_idle()
+    assert api.get_pod("c")["spec"]["nodeName"] == "host0"
+    cfg_c = hosts["host0"].hook.create_container("c", "main", {})
+    assert set(chips_from_env(cfg_c["envs"])).isdisjoint(chips_b)
+
+
+def test_hbm_constrained_pod():
+    """BASELINE config 2: chip request with min-HBM floor."""
+    api, hosts, sched = make_cluster()
+    hbm = 95 * 2**30
+    api.create_pod(tpu_pod("fits", 2, hbm=hbm))
+    api.create_pod(tpu_pod("toobig", 1, hbm=hbm + 1))
+    sched.run_until_idle()
+    assert api.get_pod("fits")["spec"]["nodeName"] == "host0"
+    assert api.get_pod("toobig")["spec"].get("nodeName") is None
+
+
+def test_contiguous_pod_e2e():
+    """BASELINE config 3: chips must form an ICI-contiguous block."""
+    api, hosts, sched = make_cluster()
+    api.create_pod(tpu_pod("c", 2, pod_requests={RESOURCE_CONTIGUOUS: 1}))
+    sched.run_until_idle()
+    assert api.get_pod("c")["spec"]["nodeName"] == "host0"
+    cfg = hosts["host0"].hook.create_container("c", "main", {})
+    coords = [grammar.coords_from_chip_id(c) for c in chips_from_env(cfg["envs"])]
+    from kubegpu_tpu.topology.mesh import ICIMesh
+
+    assert ICIMesh((2, 2, 1)).is_connected(coords)
+
+
+def test_multi_host_spreads_and_packs():
+    api, hosts, sched = make_cluster(n_hosts=2)
+    api.create_pod(tpu_pod("four", 4))
+    api.create_pod(tpu_pod("two", 2))
+    sched.run_until_idle()
+    four_host = api.get_pod("four")["spec"]["nodeName"]
+    two_host = api.get_pod("two")["spec"]["nodeName"]
+    assert {four_host, two_host} <= {"host0", "host1"}
+    assert four_host != two_host  # four saturates its host
+
+
+def test_preemption_e2e():
+    api, hosts, sched = make_cluster()
+    api.create_pod(tpu_pod("low1", 2, priority=0))
+    api.create_pod(tpu_pod("low2", 2, priority=0))
+    sched.run_until_idle()
+    api.create_pod(tpu_pod("high", 4, priority=100))
+    sched.run_until_idle()
+    high = api.get_pod("high")
+    assert high["spec"]["nodeName"] == "host0"
+    # both low-priority pods were evicted
+    assert not any(p["metadata"]["name"].startswith("low") for p in api.list_pods())
+
+
+def test_scheduler_restart_rebuilds_from_annotations():
+    """The API server is the checkpoint: a new scheduler instance must see
+    chips used by bound pods (SURVEY.md §6 checkpoint/resume)."""
+    api, hosts, sched = make_cluster()
+    api.create_pod(tpu_pod("a", 3))
+    sched.run_until_idle()
+    assert api.get_pod("a")["spec"]["nodeName"] == "host0"
+    sched.stop()
+
+    ds2 = DevicesScheduler()
+    ds2.add_device(TPUScheduler())
+    sched2 = Scheduler(api, ds2)
+    api.create_pod(tpu_pod("b", 2))
+    sched2.run_until_idle()
+    assert api.get_pod("b")["spec"].get("nodeName") is None  # only 1 chip free
+    api.create_pod(tpu_pod("c", 1))
+    sched2.run_until_idle()
+    assert api.get_pod("c")["spec"]["nodeName"] == "host0"
+    # and the runtime hook serves the restart-scheduled pod
+    cfg = hosts["host0"].hook.create_container("c", "main", {})
+    assert len(chips_from_env(cfg["envs"])) == 1
+
+
+def test_runtime_hook_strips_stale_devices_and_validates():
+    api, hosts, sched = make_cluster()
+    api.create_pod(tpu_pod("p", 1))
+    sched.run_until_idle()
+    cfg = hosts["host0"].hook.create_container("p", "main", {
+        "devices": [{"host_path": "/dev/accel3", "container_path": "/dev/accel3"},
+                    {"host_path": "/dev/null", "container_path": "/dev/null"}],
+        "envs": [{"key": "KEEP", "value": "1"}],
+    })
+    paths = [d["host_path"] for d in cfg["devices"]]
+    assert "/dev/null" in paths  # non-TPU devices untouched
+    assert paths.count("/dev/accel3") <= 1  # stale TPU entry stripped
+    assert any(e["key"] == "KEEP" for e in cfg["envs"])
+
+    # tamper: annotation claims fewer chips than requested -> refuse
+    pod = api.get_pod("p")
+    pi = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
+    pi.running_containers["main"].allocate_from = {}
+    pi.running_containers["main"].requests[grammar.RESOURCE_NUM_CHIPS] = 1
+    meta = dict(pod["metadata"])
+    codec.pod_info_to_annotation(meta, pi)
+    api.update_pod_annotations("p", meta["annotations"])
+    with pytest.raises(AllocationMismatch):
+        hosts["host0"].hook.create_container("p", "main", {})
+
+
+def test_unschedulable_pod_gets_reasons_not_crash():
+    api, hosts, sched = make_cluster()
+    api.create_pod(tpu_pod("huge", 64))
+    sched.run_until_idle()
+    assert api.get_pod("huge")["spec"].get("nodeName") is None
+    assert sched.queue.pending_count() == 1

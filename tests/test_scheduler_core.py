@@ -1,0 +1,220 @@
+"""Scheduler engine unit tests: queue, cache, fit/score/select, preemption."""
+
+import time
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
+from kubegpu_tpu.scheduler.cache import CacheCorruption, SchedulerCache
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.queue import SchedulingQueue
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+G = "alpha/grpresource"
+
+
+def flat_tpu_node(name="host0", chips=4, cpu="8"):
+    from kubegpu_tpu.core.types import NodeInfo
+
+    info = NodeInfo(name=name)
+    info.allocatable[grammar.RESOURCE_NUM_CHIPS] = chips
+    for i in range(chips):
+        info.allocatable[f"{G}/tpu/dev{i}/chips"] = 1
+        info.allocatable[f"{G}/tpu/dev{i}/hbm"] = 1000
+    info.capacity = dict(info.allocatable)
+    meta = {"name": name}
+    codec.node_info_to_annotation(meta, info)
+    return {"metadata": meta, "status": {"allocatable": {"cpu": cpu, "pods": 100}}}
+
+
+def tpu_pod(name, numchips, priority=0, cpu="1", pod_requests=None):
+    pi = PodInfo(name=name, requests=dict(pod_requests or {}))
+    pi.running_containers["main"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: numchips})
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    return {
+        "metadata": meta,
+        "spec": {
+            "priority": priority,
+            "containers": [{"name": "main",
+                            "resources": {"requests": {"cpu": cpu}}}],
+        },
+    }
+
+
+def make_scheduler(api):
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    return Scheduler(api, ds)
+
+
+# ---- queue -----------------------------------------------------------------
+
+
+def test_queue_priority_order():
+    q = SchedulingQueue()
+    q.push(tpu_pod("low", 1, priority=0))
+    q.push(tpu_pod("high", 1, priority=10))
+    q.push(tpu_pod("mid", 1, priority=5))
+    assert [q.pop(0)["metadata"]["name"] for _ in range(3)] == ["high", "mid", "low"]
+    assert q.pop(timeout=0.0) is None
+
+
+def test_queue_fifo_within_priority():
+    q = SchedulingQueue()
+    for n in ("a", "b", "c"):
+        q.push(tpu_pod(n, 1))
+    assert [q.pop(0)["metadata"]["name"] for _ in range(3)] == ["a", "b", "c"]
+
+
+def test_queue_backoff_and_move_all():
+    q = SchedulingQueue()
+    pod = tpu_pod("p", 1)
+    q.add_unschedulable(pod)
+    assert q.pop(timeout=0.0) is None  # still backing off
+    q.move_all_to_active()
+    assert q.pop(timeout=0.0)["metadata"]["name"] == "p"
+
+
+def test_queue_push_dedup_updates():
+    q = SchedulingQueue()
+    q.push(tpu_pod("p", 1))
+    updated = tpu_pod("p", 2)
+    q.push(updated)
+    popped = q.pop(0)
+    assert popped["metadata"]["annotations"] == updated["metadata"]["annotations"]
+    assert q.pop(timeout=0.0) is None
+
+
+# ---- cache -----------------------------------------------------------------
+
+
+def make_cache():
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    cache = SchedulerCache(ds)
+    return cache, ds
+
+
+def bound_pod_with_alloc(name, chip):
+    pi = PodInfo(name=name, node_name="host0")
+    req = f"{G}/tpu/0/chips"
+    pi.running_containers["main"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: 1},
+        dev_requests={req: 1},
+        allocate_from={req: f"{G}/tpu/{chip}/chips"})
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    return {"metadata": meta,
+            "spec": {"nodeName": "host0",
+                     "containers": [{"name": "main",
+                                     "resources": {"requests": {"cpu": "1"}}}]}}
+
+
+def test_cache_assume_confirm_and_used():
+    cache, _ = make_cache()
+    cache.set_node(flat_tpu_node())
+    pod = bound_pod_with_alloc("p", "dev0")
+    cache.assume_pod(pod, "host0")
+    node = cache.get_node("host0")
+    assert node.node_ex.used[f"{G}/tpu/dev0/chips"] == 1
+    assert node.requested_core.get("cpu") == 1
+    cache.confirm_pod("p")
+    assert cache.expire_assumed(now=time.monotonic() + 100) == []
+    assert node.node_ex.used[f"{G}/tpu/dev0/chips"] == 1  # still charged
+
+
+def test_cache_assume_expires_without_confirm():
+    cache, _ = make_cache()
+    cache.set_node(flat_tpu_node())
+    cache.assume_pod(bound_pod_with_alloc("p", "dev0"), "host0")
+    expired = cache.expire_assumed(now=time.monotonic() + 100)
+    assert expired == ["p"]
+    assert cache.get_node("host0").node_ex.used[f"{G}/tpu/dev0/chips"] == 0
+
+
+def test_cache_forget_releases():
+    cache, _ = make_cache()
+    cache.set_node(flat_tpu_node())
+    pod = bound_pod_with_alloc("p", "dev1")
+    cache.assume_pod(pod, "host0")
+    cache.forget_pod(pod)
+    assert cache.get_node("host0").node_ex.used[f"{G}/tpu/dev1/chips"] == 0
+
+
+def test_cache_node_repatch_preserves_used():
+    cache, _ = make_cache()
+    cache.set_node(flat_tpu_node())
+    cache.add_pod(bound_pod_with_alloc("p", "dev0"), "host0")
+    # advertiser re-patches the node: used must survive
+    cache.set_node(flat_tpu_node())
+    assert cache.get_node("host0").node_ex.used[f"{G}/tpu/dev0/chips"] == 1
+
+
+def test_cache_corrupt_pod_annotation_is_fatal():
+    cache, _ = make_cache()
+    cache.set_node(flat_tpu_node())
+    pod = bound_pod_with_alloc("p", "dev0")
+    pod["metadata"]["annotations"][codec.POD_ANNOTATION_KEY] = "{corrupt"
+    with pytest.raises(CacheCorruption):
+        cache.add_pod(pod, "host0")
+
+
+# ---- engine ----------------------------------------------------------------
+
+
+def test_select_host_round_robins_ties():
+    api = InMemoryAPIServer()
+    sched = make_scheduler(api)
+    picks = {sched.generic.select_host({"a": 1.0, "b": 1.0}) for _ in range(4)}
+    assert picks == {"a", "b"}
+
+
+def test_core_resources_gate_scheduling():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node(cpu="2"))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("big", 1, cpu="4"))
+    sched.run_until_idle()
+    assert api.get_pod("big")["spec"].get("nodeName") is None
+    assert sched.queue.pending_count() == 1
+
+
+def test_chipless_node_rejects_chip_pods():
+    """A node advertising no chip inventory must fail the predicate, not
+    fit vacuously (review finding)."""
+    api = InMemoryAPIServer()
+    api.create_node({"metadata": {"name": "nochips"},
+                     "status": {"allocatable": {"cpu": "8"}}})
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("p", 2))
+    sched.run_until_idle()
+    assert api.get_pod("p")["spec"].get("nodeName") is None
+    assert sched.queue.pending_count() == 1
+
+
+def test_node_deleted_between_allocate_and_assume():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node())
+    sched = make_scheduler(api)
+    pod = tpu_pod("p", 1)
+    # simulate the race: assume against a node that just vanished
+    sched.cache.remove_node("host0")
+    sched.cache.assume_pod(pod, "host0")  # must not raise
+    sched.cache.forget_pod(pod)
+
+
+def test_externally_bound_pod_added_event_charges_cache():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node())
+    sched = make_scheduler(api)
+    pod = bound_pod_with_alloc("ext", "dev2")
+    api.create_pod(pod)  # arrives with nodeName already set
+    node = sched.cache.get_node("host0")
+    assert node.node_ex.used[f"{G}/tpu/dev2/chips"] == 1
+    api.delete_pod("ext")
+    assert node.node_ex.used[f"{G}/tpu/dev2/chips"] == 0

@@ -1,0 +1,325 @@
+"""TPU scheduler plugin tests.
+
+Ports the reference's plugin-level scenarios: chip-count translation through
+the registry (`devicescheduler_test.go:410-441`), the shape-cache dedup and
+best-tree rewrite (`gpu_test.go`), plus the TPU-specific contiguous mode.
+"""
+
+import pytest
+
+from kubegpu_tpu.core import grammar
+from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import (
+    RESOURCE_CONTIGUOUS,
+    ShapeCache,
+    TPUScheduler,
+    translate_chip_count,
+)
+
+G = "alpha/grpresource"
+
+
+def make_node(grpres, name="node1"):
+    alloc = {f"{G}/{k}": v for k, v in grpres.items()}
+    return NodeInfo(name=name, capacity=dict(alloc), allocatable=dict(alloc))
+
+
+def chip_count_pod(name, conts, pod_requests=None):
+    """conts: {cont_name: (is_init, numchips, hbm_per_chip)}"""
+    pod = PodInfo(name=name, requests=dict(pod_requests or {}))
+    for cname, (is_init, num, hbm) in conts.items():
+        reqs = {grammar.RESOURCE_NUM_CHIPS: num}
+        if hbm:
+            reqs[grammar.RESOURCE_HBM_PER_CHIP] = hbm
+        cont = ContainerInfo(requests=reqs, dev_requests={})
+        if is_init:
+            pod.init_containers[cname] = cont
+        else:
+            pod.running_containers[cname] = cont
+    return pod
+
+
+FLAT_NODE = {
+    "tpu/dev0/hbm": 100000, "tpu/dev0/chips": 1,
+    "tpu/dev1/hbm": 256000, "tpu/dev1/chips": 1,
+    "tpu/dev2/hbm": 257000, "tpu/dev2/chips": 1,
+    "tpu/dev3/hbm": 192000, "tpu/dev3/chips": 1,
+    "tpu/dev4/hbm": 178000, "tpu/dev4/chips": 1,
+}
+
+
+def make_registry():
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    return ds
+
+
+def test_numchips_translation_through_registry():
+    """Reference pod2: numgpu-count requests, exact placements and score."""
+    ds = make_registry()
+    node = make_node(FLAT_NODE)
+    pod = chip_count_pod("pod2", {
+        "Init0": (True, 1, 0),
+        "Run0": (False, 2, 0),
+        "Run1": (False, 1, 0),
+    })
+    found, reasons, score = ds.pod_fits_resources(pod, node, True)
+    assert found, [str(r) for r in reasons]
+    assert score == pytest.approx(0.3, rel=0.01)
+    assert pod.running_containers["Run0"].allocate_from == {
+        f"{G}/tpu/0/chips": f"{G}/tpu/dev4/chips",
+        f"{G}/tpu/1/chips": f"{G}/tpu/dev3/chips",
+    }
+    assert pod.running_containers["Run1"].allocate_from == {
+        f"{G}/tpu/0/chips": f"{G}/tpu/dev2/chips",
+    }
+    assert pod.init_containers["Init0"].allocate_from == {
+        f"{G}/tpu/0/chips": f"{G}/tpu/dev4/chips",
+    }
+    # accounting drains
+    ds.take_pod_resources(pod, node)
+    assert node.used[f"{G}/tpu/dev4/chips"] == 1
+    ds.return_pod_resources(pod, node)
+    assert all(v == 0 for v in node.used.values())
+
+
+def test_hbm_per_chip_constraint():
+    """BASELINE config 2: chip-count with per-chip HBM floor."""
+    ds = make_registry()
+    node = make_node(FLAT_NODE)
+    pod = chip_count_pod("p", {"Run0": (False, 2, 200000)})
+    found, _, _ = ds.pod_fits_resources(pod, node, True)
+    assert found
+    targets = set(pod.running_containers["Run0"].allocate_from.values())
+    # only dev1 (256000) and dev2 (257000) satisfy the floor
+    assert targets == {f"{G}/tpu/dev1/chips", f"{G}/tpu/dev1/hbm",
+                       f"{G}/tpu/dev2/chips", f"{G}/tpu/dev2/hbm"}
+
+
+def test_hbm_floor_unsatisfiable():
+    ds = make_registry()
+    node = make_node(FLAT_NODE)
+    pod = chip_count_pod("p", {"Run0": (False, 3, 200000)})
+    found, reasons, _ = ds.pod_fits_resources(pod, node, False)
+    assert not found and reasons
+
+
+def test_translate_chip_count_noop_on_chipless_node():
+    out = translate_chip_count(2, 0, {"cpu": 4}, {"x": 1})
+    assert out == {"x": 1}
+
+
+def test_translate_preserves_existing_indices():
+    node_res = {f"{G}/tpu/a/chips": 1}
+    reqs = {f"{G}/tpu/3/chips": 1}
+    out = translate_chip_count(2, 0, node_res, reqs)
+    assert out == {f"{G}/tpu/3/chips": 1, f"{G}/tpu/4/chips": 1}
+
+
+# ---- shape cache and auto-topology (gpu_test.go port) ----------------------
+
+TREE_NODE_1 = {f"{G}/tpugrp1/{a}/tpugrp0/{b}/tpu/{i}/chips": 1
+               for a, b, i in [("A", 0, 0), ("A", 0, 1), ("A", 1, 2), ("A", 1, 3),
+                               ("B", 2, 4), ("B", 2, 5), ("B", 3, 6), ("B", 3, 7)]}
+TREE_NODE_2 = {f"{G}/tpugrp1/{a}/tpugrp0/{b}/tpu/{i}/chips": 1
+               for a, b, i in [("A", 0, 0), ("A", 0, 1), ("A", 1, 2), ("A", 1, 3),
+                               ("B", 2, 4), ("B", 2, 5), ("B", 2, 6), ("B", 2, 7)]}
+
+
+def test_shape_cache_dedup_and_removal():
+    cache = ShapeCache()
+    cache.add_node("A", TREE_NODE_1)
+    cache.add_node("B", TREE_NODE_2)
+    cache.add_node("C", TREE_NODE_1)  # same shape as A
+    cache.add_node("D", {"ABCD": 4})  # degenerate
+    assert len(cache) == 3
+    cache.remove_node("A")
+    assert len(cache) == 3  # C still holds shape 1
+    cache.remove_node("C")
+    assert len(cache) == 2
+    # re-adding same node shape is a no-op
+    cache.add_node("B", TREE_NODE_2)
+    assert len(cache) == 2
+
+
+def test_auto_topology_rewrites_to_best_shape():
+    """gpu_test.go:61-112 port: 3 chips rewritten to the denser shape."""
+    sched = TPUScheduler()
+    sched.add_node("n1", NodeInfo(allocatable=dict(TREE_NODE_1)))
+    sched.add_node("n2", NodeInfo(allocatable=dict(TREE_NODE_2)))
+    pod = PodInfo(
+        name="p",
+        requests={grammar.TPU_TOPOLOGY_GENERATION: 1},
+        running_containers={"A": ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 3},
+            dev_requests={
+                f"{G}/tpugrp1/B/tpugrp0/3/tpu/6/chips": 1,
+                f"{G}/tpugrp1/B/tpugrp0/3/tpu/7/chips": 1,
+            })},
+    )
+    ok, _ = sched._translate(NodeInfo(), pod)
+    assert ok
+    # node 2's shape (one 4-chip tpugrp0) scores higher: all 3 chips together
+    assert pod.running_containers["A"].dev_requests == {
+        f"{G}/tpugrp1/0/tpugrp0/0/tpu/0/chips": 1,
+        f"{G}/tpugrp1/0/tpugrp0/0/tpu/1/chips": 1,
+        f"{G}/tpugrp1/0/tpugrp0/0/tpu/2/chips": 1,
+    }
+    # after the dense node leaves, only shape 1 remains: 2+1 split
+    sched.remove_node("n2")
+    ok, _ = sched._translate(NodeInfo(), pod)
+    assert ok
+    assert pod.running_containers["A"].dev_requests == {
+        f"{G}/tpugrp1/0/tpugrp0/0/tpu/0/chips": 1,
+        f"{G}/tpugrp1/0/tpugrp0/0/tpu/1/chips": 1,
+        f"{G}/tpugrp1/0/tpugrp0/1/tpu/0/chips": 1,
+    }
+
+
+def test_auto_topology_no_shape_big_enough():
+    sched = TPUScheduler()
+    sched.add_node("n1", NodeInfo(allocatable=dict(TREE_NODE_1)))
+    pod = PodInfo(name="p", requests={grammar.TPU_TOPOLOGY_GENERATION: 1},
+                  running_containers={"A": ContainerInfo(
+                      requests={grammar.RESOURCE_NUM_CHIPS: 9})})
+    ok, reasons = sched._translate(NodeInfo(), pod)
+    assert not ok and reasons
+
+
+def test_invalid_topology_mode_rejected():
+    sched = TPUScheduler()
+    pod = PodInfo(name="p", requests={grammar.TPU_TOPOLOGY_GENERATION: 7})
+    found, reasons, _ = sched.pod_fits_device(NodeInfo(), pod, False, True)
+    assert not found and reasons
+
+
+# ---- contiguous mode (TPU-specific; BASELINE config 3) ---------------------
+
+
+def coord_node(coords, used=(), hbm=1000):
+    """Node advertising chips at given mesh coords (1 tray per pair)."""
+    grpres = {}
+    node = NodeInfo(name="n")
+    for c in coords:
+        cid = grammar.chip_id_from_coords(c)
+        base = f"{G}/tpugrp1/0/tpugrp0/0/tpu/{cid}"
+        node.allocatable[f"{base}/chips"] = 1
+        node.allocatable[f"{base}/hbm"] = hbm
+        if c in used:
+            node.used[f"{base}/chips"] = 1
+    node.capacity = dict(node.allocatable)
+    return node
+
+
+def test_contiguous_mode_pins_adjacent_chips():
+    ds = make_registry()
+    node = coord_node([(x, y, 0) for x in range(2) for y in range(2)])
+    pod = chip_count_pod("p", {"Run0": (False, 2, 0)},
+                         pod_requests={RESOURCE_CONTIGUOUS: 1})
+    found, reasons, _ = ds.pod_fits_resources(pod, node, True)
+    assert found, [str(r) for r in reasons]
+    got = sorted(pod.running_containers["Run0"].allocate_from.values())
+    coords = [grammar.coords_from_chip_id(grammar.chip_id_from_path(p)) for p in got]
+    from kubegpu_tpu.topology.mesh import ICIMesh
+
+    assert ICIMesh((2, 2, 1)).is_connected(coords)
+    # request paths are pinned: identity mapping
+    assert all(k == v for k, v in pod.running_containers["Run0"].allocate_from.items())
+
+
+def test_contiguous_mode_respects_used_chips():
+    ds = make_registry()
+    # row of 4; middle-left chip taken -> only (2,0,0),(3,0,0) form a free pair
+    node = coord_node([(x, 0, 0) for x in range(4)], used=[(1, 0, 0)])
+    pod = chip_count_pod("p", {"Run0": (False, 2, 0)},
+                         pod_requests={RESOURCE_CONTIGUOUS: 1})
+    found, _, _ = ds.pod_fits_resources(pod, node, True)
+    assert found
+    got = sorted(pod.running_containers["Run0"].allocate_from.values())
+    assert [grammar.chip_id_from_path(p) for p in got if p.endswith("chips")] == [
+        "2.0.0", "3.0.0"]
+
+
+def test_contiguous_mode_impossible_fragmentation():
+    ds = make_registry()
+    node = coord_node([(x, 0, 0) for x in range(4)], used=[(1, 0, 0)])
+    pod = chip_count_pod("p", {"Run0": (False, 3, 0)},
+                         pod_requests={RESOURCE_CONTIGUOUS: 1})
+    found, reasons, _ = ds.pod_fits_resources(pod, node, False)
+    assert not found
+    assert any("contiguous" in str(r) for r in reasons)
+
+
+def test_contiguous_mode_idempotent_refit():
+    ds = make_registry()
+    node = coord_node([(x, y, 0) for x in range(2) for y in range(2)])
+    pod = chip_count_pod("p", {"Run0": (False, 2, 0)},
+                         pod_requests={RESOURCE_CONTIGUOUS: 1})
+    found, _, score = ds.pod_fits_resources(pod, node, True)
+    assert found
+    first = dict(pod.running_containers["Run0"].allocate_from)
+    found2, _, score2 = ds.pod_fits_resources(pod, node, True)
+    assert found2
+    assert pod.running_containers["Run0"].allocate_from == first
+    assert score2 == pytest.approx(score, rel=0.01)
+
+
+def test_contiguous_with_hbm_floor():
+    ds = make_registry()
+    node = coord_node([(x, 0, 0) for x in range(2)], hbm=500)
+    pod = chip_count_pod("p", {"Run0": (False, 2, 600)},
+                         pod_requests={RESOURCE_CONTIGUOUS: 1})
+    found, reasons, _ = ds.pod_fits_resources(pod, node, False)
+    assert not found  # chips adjacent but hbm floor unsatisfiable
+    pod2 = chip_count_pod("p2", {"Run0": (False, 2, 400)},
+                          pod_requests={RESOURCE_CONTIGUOUS: 1})
+    found2, _, _ = ds.pod_fits_resources(pod2, node, True)
+    assert found2
+
+
+# ---- registry mechanics ----------------------------------------------------
+
+
+class StubPlugin:
+    def __init__(self, name, grp):
+        self._name, self._grp = name, grp
+        self.calls = []
+
+    def get_name(self):
+        return self._name
+
+    def uses_group_scheduler(self):
+        return self._grp
+
+    def add_node(self, *a):
+        self.calls.append("add_node")
+
+    def remove_node(self, *a):
+        self.calls.append("remove_node")
+
+    def pod_fits_device(self, node, pod, fill, run_grp):
+        self.calls.append(("fit", run_grp))
+        return True, [], 1.0
+
+    def pod_allocate(self, node, pod, run_grp):
+        self.calls.append(("alloc", run_grp))
+
+    def take_pod_resources(self, node, pod, run_grp):
+        self.calls.append(("take", run_grp))
+
+    def return_pod_resources(self, node, pod, run_grp):
+        self.calls.append(("ret", run_grp))
+
+
+def test_registry_last_group_plugin_runs_allocator():
+    ds = DevicesScheduler()
+    a, b, c = StubPlugin("a", True), StubPlugin("b", False), StubPlugin("c", True)
+    ds.add_device(a)
+    ds.add_device(b)
+    ds.add_device(c)
+    assert ds.run_group_scheduler == [False, False, True]
+    found, _, score = ds.pod_fits_resources(PodInfo(), NodeInfo(), False)
+    assert found and score == 3.0
+    assert a.calls[-1] == ("fit", False)
+    assert c.calls[-1] == ("fit", True)
