@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""Benchmark: p50 pod-schedule latency + ICI-locality across the five
+BASELINE configs (BASELINE.md):
+
+1. 1-device pod, no topology constraints
+2. 2-chip pod with min-HBM constraint
+3. 4-chip pod requiring ICI-adjacent chips (contiguous mode)
+4. multi-pod bin-packing / fragmentation on a single v5p-32 host
+5. multi-node gang schedule of a 4x4x4 slice across 16 hosts
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The reference publishes no numbers (SURVEY.md §7); the target is the
+driver's north star: p50 < 50 ms. vs_baseline = 50ms / p50 (higher is
+better; >1 beats the target).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
+from kubegpu_tpu.node.fake import FakeTPUBackend, single_chip_inventory, v5p_host_inventory
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.gang import RESOURCE_GANG, RESOURCE_GANG_SIZE
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import RESOURCE_CONTIGUOUS, TPUScheduler
+from kubegpu_tpu.topology.mesh import ICIMesh
+
+ITERS = 30
+
+
+def make_pod(name, numchips, pod_requests=None, hbm=0):
+    pi = PodInfo(name=name, requests=dict(pod_requests or {}))
+    reqs = {grammar.RESOURCE_NUM_CHIPS: numchips}
+    if hbm:
+        reqs[grammar.RESOURCE_HBM_PER_CHIP] = hbm
+    pi.running_containers["main"] = ContainerInfo(requests=reqs)
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    return {"metadata": meta,
+            "spec": {"containers": [{"name": "main",
+                                     "resources": {"requests": {"cpu": "1"}}}]}}
+
+
+class Cluster:
+    def __init__(self, inventories):
+        self.api = InMemoryAPIServer()
+        self.managers = {}
+        for i, inv in enumerate(inventories):
+            name = f"host{i}"
+            self.api.create_node({
+                "metadata": {"name": name},
+                "status": {"allocatable": {"cpu": "128", "pods": 1000}}})
+            mgr = DevicesManager()
+            mgr.add_device(TPUDeviceManager(FakeTPUBackend(inv)))
+            mgr.start()
+            DeviceAdvertiser(self.api, mgr, name).advertise_once()
+            self.managers[name] = mgr
+        ds = DevicesScheduler()
+        ds.add_device(TPUScheduler())
+        self.sched = Scheduler(self.api, ds)
+
+    def schedule_timed(self, pod) -> float | None:
+        """Create + schedule one pod synchronously; returns latency seconds
+        (creation -> bound) or None if it did not bind."""
+        t0 = time.perf_counter()
+        self.api.create_pod(pod)
+        self.sched.run_until_idle()
+        t1 = time.perf_counter()
+        bound = self.api.get_pod(pod["metadata"]["name"])["spec"].get("nodeName")
+        return (t1 - t0) if bound else None
+
+    def pod_coords(self, name):
+        pod = self.api.get_pod(name)
+        pi = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
+        out = []
+        for cont in pi.running_containers.values():
+            for path in cont.allocate_from.values():
+                cid = grammar.chip_id_from_path(path)
+                if cid:
+                    out.append(grammar.coords_from_chip_id(cid))
+        return out
+
+
+def v5p32_host():
+    """One 16-chip host (v5p-32): a 4x2x2 block."""
+    from kubegpu_tpu.node.backend import ChipInfo, TPUInventory
+    from kubegpu_tpu.node.fake import V5P_HBM
+
+    chips = []
+    idx = 0
+    for z in range(2):
+        for y in range(2):
+            for x in range(4):
+                chips.append(ChipInfo(index=idx, coords=(x, y, z),
+                                      hbm_bytes=V5P_HBM,
+                                      device_paths=[f"/dev/accel{idx}"]))
+                idx += 1
+    return TPUInventory(chips=chips, mesh_dims=(4, 2, 2),
+                        host_bounds=(4, 2, 2), tray_shape=(2, 1, 1))
+
+
+def config1():
+    c = Cluster([single_chip_inventory()])
+    lat = []
+    for i in range(ITERS):
+        t = c.schedule_timed(make_pod(f"p{i}", 1))
+        assert t is not None
+        lat.append(t)
+        c.api.delete_pod(f"p{i}")
+        c.sched.run_until_idle()
+    return lat, 1.0
+
+
+def config2():
+    c = Cluster([v5p_host_inventory()])
+    lat = []
+    for i in range(ITERS):
+        t = c.schedule_timed(make_pod(f"p{i}", 2, hbm=90 * 2**30))
+        assert t is not None
+        lat.append(t)
+        c.api.delete_pod(f"p{i}")
+        c.sched.run_until_idle()
+    return lat, 1.0
+
+
+def config3():
+    c = Cluster([v5p32_host()])
+    mesh = ICIMesh((4, 2, 2))
+    lat, local = [], []
+    for i in range(ITERS):
+        t = c.schedule_timed(make_pod(f"p{i}", 4,
+                                      pod_requests={RESOURCE_CONTIGUOUS: 1}))
+        assert t is not None
+        lat.append(t)
+        local.append(1.0 if mesh.is_connected(c.pod_coords(f"p{i}")) else 0.0)
+        c.api.delete_pod(f"p{i}")
+        c.sched.run_until_idle()
+    return lat, statistics.mean(local)
+
+
+def config4():
+    """Fragmentation churn on one v5p-32: fill with mixed pods, delete a
+    subset, refill — every placement timed."""
+    c = Cluster([v5p32_host()])
+    lat = []
+    sizes = [4, 3, 2, 2, 1, 4]  # fills 16
+    names = []
+    for i, s in enumerate(sizes):
+        t = c.schedule_timed(make_pod(f"fill{i}", s))
+        assert t is not None
+        lat.append(t)
+        names.append(f"fill{i}")
+    for round_i in range(8):
+        victim = names[round_i % len(names)]
+        try:
+            c.api.delete_pod(victim)
+        except KeyError:
+            pass
+        c.sched.run_until_idle()
+        size = 4 if round_i % 2 == 0 else 2
+        name = f"re{round_i}"
+        t = c.schedule_timed(make_pod(name, size))
+        if t is not None:
+            lat.append(t)
+            names.append(name)
+    # utilization after churn
+    snap = c.sched.cache.snapshot_node("host0")
+    used = sum(1 for k, v in snap[0].used.items()
+               if k.endswith("/chips") and v > 0)
+    return lat, used / 16.0
+
+
+def config5():
+    origins = [(x, y, z) for z in range(4) for y in (0, 2) for x in (0, 2)]
+    c = Cluster([v5p_host_inventory(host_origin=o, mesh_dims=(4, 4, 4))
+                 for o in origins])
+    mesh = ICIMesh((4, 4, 4))
+    lat, local = [], []
+    for g in range(3):
+        t0 = time.perf_counter()
+        for i in range(16):
+            c.api.create_pod(make_pod(
+                f"g{g}-{i:02d}", 4,
+                pod_requests={RESOURCE_GANG: g + 1, RESOURCE_GANG_SIZE: 16}))
+        c.sched.run_until_idle()
+        t1 = time.perf_counter()
+        coords = []
+        for i in range(16):
+            name = f"g{g}-{i:02d}"
+            assert c.api.get_pod(name)["spec"].get("nodeName"), name
+            coords.extend(c.pod_coords(name))
+        local.append(1.0 if len(coords) == 64 and mesh.is_connected(coords)
+                     else 0.0)
+        lat.append((t1 - t0) / 16.0)  # per-pod share of the gang commit
+        for i in range(16):
+            c.api.delete_pod(f"g{g}-{i:02d}")
+        c.sched.run_until_idle()
+    return lat, statistics.mean(local)
+
+
+def main():
+    metrics.reset_all()
+    configs = [config1, config2, config3, config4, config5]
+    all_lat = []
+    per_config = {}
+    locality = []
+    packing = None
+    for i, fn in enumerate(configs, 1):
+        lat, aux = fn()
+        all_lat.extend(lat)
+        if i == 4:
+            packing = aux  # chip utilization after churn, not a locality
+        else:
+            locality.append(aux)
+        per_config[f"config{i}_p50_ms"] = round(
+            statistics.median(lat) * 1e3, 3)
+    p50_ms = statistics.median(all_lat) * 1e3
+    result = {
+        "metric": "p50_pod_schedule_latency_ms",
+        "value": round(p50_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(50.0 / p50_ms, 2),
+        "ici_locality": round(statistics.mean(locality), 4),
+        "packing_utilization": round(packing, 4),
+        **per_config,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

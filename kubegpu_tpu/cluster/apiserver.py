@@ -125,6 +125,28 @@ class InMemoryAPIServer:
             pod.setdefault("status", {})["phase"] = "Scheduled"
             self._notify("pod", "modified", pod)
 
+    def bind_many(self, bindings: dict, annotations: dict) -> None:
+        """Atomically annotate and bind a pod-set (gang commit): either every
+        pod binds or none does. ``bindings``: pod name -> node name;
+        ``annotations``: pod name -> annotation dict."""
+        with self._lock:
+            for name, node_name in bindings.items():
+                if name not in self._pods:
+                    raise NotFound(f"pod {name}")
+                bound = self._pods[name].get("spec", {}).get("nodeName")
+                if bound and bound != node_name:
+                    raise Conflict(f"pod {name} already bound to {bound}")
+            changed = []
+            for name, node_name in bindings.items():
+                pod = self._pods[name]
+                meta = pod.setdefault("metadata", {})
+                meta["annotations"] = copy.deepcopy(annotations.get(name, {}))
+                pod.setdefault("spec", {})["nodeName"] = node_name
+                pod.setdefault("status", {})["phase"] = "Scheduled"
+                changed.append(pod)
+            for pod in changed:
+                self._notify("pod", "modified", pod)
+
     def delete_pod(self, name: str) -> None:
         with self._lock:
             pod = self._pods.pop(name, None)

@@ -198,12 +198,16 @@ class Scheduler:
 
     def __init__(self, api, device_scheduler, bind_async: bool = False,
                  parallelism: int = DEFAULT_PARALLELISM):
+        from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
+
         self.api = api
         self.device_scheduler = device_scheduler
         self.cache = SchedulerCache(device_scheduler)
         self.queue = SchedulingQueue()
         self.generic = GenericScheduler(self.cache, device_scheduler, parallelism)
         self.generic.api = api
+        self.gang_buffer = GangBuffer()
+        self.gang_planner = GangPlanner(self.cache)
         self.bind_async = bind_async
         self.preemption_enabled = True
         self._stop = threading.Event()
@@ -241,6 +245,7 @@ class Scheduler:
                 self.cache.add_pod(obj, node_name)
             elif event == "deleted":
                 self.queue.forget(obj["metadata"]["name"])
+                self.gang_buffer.discard_pod(obj["metadata"]["name"])
                 if node_name:
                     self.cache.remove_pod(obj, node_name)
                 self.queue.move_all_to_active()
@@ -260,6 +265,13 @@ class Scheduler:
         if (current.get("spec") or {}).get("nodeName"):
             return True  # already bound elsewhere
         kube_pod = current
+
+        from kubegpu_tpu.scheduler.gang import gang_key
+
+        gang = gang_key(kube_pod)
+        if gang is not None:
+            self._handle_gang_pod(kube_pod, *gang)
+            return True
 
         metrics.SCHEDULE_ATTEMPTS.inc()
         t0 = time.perf_counter()
@@ -286,6 +298,53 @@ class Scheduler:
         else:
             self._bind(kube_pod, host, t0)
         return True
+
+    def _handle_gang_pod(self, kube_pod: dict, gang: int, size: int) -> None:
+        """Buffer gang members; when complete, place the whole pod-set onto
+        one contiguous cross-host block, all-or-nothing."""
+        members = self.gang_buffer.add(kube_pod, gang, size)
+        if members is None:
+            return  # waiting for the rest of the gang
+        metrics.SCHEDULE_ATTEMPTS.inc()
+        t0 = time.perf_counter()
+        self.cache.expire_assumed()
+        assignment = self.gang_planner.plan(members)
+        if assignment is None:
+            metrics.SCHEDULE_FAILURES.inc()
+            # members stay buffered; requeue one so a later pop retries the
+            # whole gang once the cluster changes
+            self.queue.add_unschedulable(kube_pod)
+            return
+        self.gang_buffer.drop_gang(gang)
+        # Two-phase all-or-nothing commit: assume everything (reversible),
+        # then one atomic bind of the whole pod-set.
+        assumed: list = []
+        try:
+            pinned_members = []
+            for member in members:
+                name = member["metadata"]["name"]
+                node_name, chips = assignment[name]
+                pinned = self.gang_planner.pin_pod(member, node_name, chips)
+                self.cache.assume_pod(pinned, node_name)
+                assumed.append(pinned)
+                pinned_members.append((name, node_name, pinned))
+            self.api.bind_many(
+                {n: node for n, node, _ in pinned_members},
+                {n: p["metadata"].get("annotations") or {}
+                 for n, _, p in pinned_members},
+            )
+            for name, _, _ in pinned_members:
+                self.cache.confirm_pod(name)
+                self.queue.forget(name)
+                metrics.E2E_SCHEDULING_LATENCY.observe(
+                    (time.perf_counter() - t0) * 1e6)
+        except Exception:
+            # nothing bound (bind_many is atomic): release every assume
+            metrics.SCHEDULE_FAILURES.inc()
+            for pinned in assumed:
+                self.cache.forget_pod(pinned)
+            for member in members:
+                self.queue.add_unschedulable(member)
 
     def _try_preempt(self, kube_pod: dict) -> bool:
         found = self.generic.preempt(kube_pod)

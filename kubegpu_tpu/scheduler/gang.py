@@ -1,0 +1,187 @@
+"""Multi-node gang scheduling: place a pod-set onto one contiguous slice.
+
+BASELINE config 5: a 4x4x4 slice across a v5p-256 pod. Unlike GPUs, a TPU
+slice spans hosts, so the placement constraint is *cluster-level*: the
+union of all pods' chips must form one ICI-contiguous sub-mesh, and each
+pod must land on the host that physically owns its chunk of the block.
+
+This is the multi-node generalization SURVEY.md §8 calls for; the
+reference's per-node `PodFitsGroupConstraints` stays the per-host
+validator — the gang layer only *decides*, emitting contiguous-mode pinned
+allocations per pod (exact chips, identity allocate_from), then the normal
+assume/bind path commits them all-or-nothing.
+
+Gang membership rides in pod-level annotation requests:
+
+- ``alpha.tpu/gang``:       gang id (int-encoded name hash or index —
+                            ResourceList values are ints on the wire)
+- ``alpha.tpu/gang-size``:  number of pods in the gang
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.utils import sorted_keys
+
+RESOURCE_GANG = "alpha.tpu/gang"
+RESOURCE_GANG_SIZE = "alpha.tpu/gang-size"
+
+
+def gang_key(kube_pod: dict):
+    """(gang id, size) from the pod annotation, or None.
+
+    Fast-paths on the raw annotation string so ordinary pods don't pay a
+    full codec decode in the hot scheduling loop.
+    """
+    raw = ((kube_pod.get("metadata") or {}).get("annotations") or {}).get(
+        codec.POD_ANNOTATION_KEY)
+    if not raw or RESOURCE_GANG not in raw:
+        return None
+    try:
+        pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
+    except Exception:
+        return None
+    gang = pod_info.requests.get(RESOURCE_GANG)
+    size = pod_info.requests.get(RESOURCE_GANG_SIZE)
+    if gang is None or not size:
+        return None
+    return int(gang), int(size)
+
+
+class GangBuffer:
+    """Holds gang members until the full pod-set has arrived. Thread-safe:
+    the watcher thread discards deleted members while the scheduler thread
+    adds and drops."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._gangs: dict = {}  # gang id -> {pod name: kube_pod}
+
+    def add(self, kube_pod: dict, gang: int, size: int):
+        with self._lock:
+            members = self._gangs.setdefault(gang, {})
+            members[kube_pod["metadata"]["name"]] = kube_pod
+            if len(members) >= size:
+                return [members[n] for n in sorted_keys(members)]
+            return None
+
+    def discard_pod(self, pod_name: str) -> None:
+        with self._lock:
+            for members in self._gangs.values():
+                members.pop(pod_name, None)
+
+    def drop_gang(self, gang: int) -> None:
+        with self._lock:
+            self._gangs.pop(gang, None)
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(len(m) for m in self._gangs.values())
+
+
+class GangPlanner:
+    """Chooses one contiguous cross-host block and splits it per pod."""
+
+    def __init__(self, cache):
+        self.cache = cache
+
+    # -- cluster-wide free map ----------------------------------------------
+
+    def _free_chip_map(self):
+        """coords -> (node_name, chip path prefix) for every free chip."""
+        out = {}
+        for node_name in self.cache.node_names():
+            snap = self.cache.snapshot_node(node_name)
+            if snap is None:
+                continue
+            node_ex, _, _ = snap
+            for res in node_ex.allocatable:
+                chip_id = grammar.chip_id_from_path(res)
+                if chip_id is None:
+                    continue
+                coords = grammar.coords_from_chip_id(chip_id)
+                if coords is None or len(coords) != 3:
+                    continue
+                if node_ex.used.get(res, 0) == 0:
+                    out[coords] = (node_name, res[: -len(f"/{grammar.CHIPS_SUFFIX}")])
+        return out
+
+    def plan(self, pods: list):
+        """Assign each gang pod a host and an exact chip set.
+
+        Returns ``{pod_name: (node_name, {chip path prefix})}`` or None.
+        Every pod must need the same chip count (the slice is regular), and
+        the chosen block must split host-aligned: chips per host == chips
+        per pod.
+        """
+        from kubegpu_tpu.topology.mesh import ICIMesh, find_contiguous_block
+
+        per_pod = []
+        for pod in pods:
+            pod_info = codec.kube_pod_to_pod_info(pod, invalidate_existing=True)
+            num = sum(
+                int(c.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
+                for c in pod_info.running_containers.values())
+            per_pod.append(num)
+        if not per_pod or len(set(per_pod)) != 1 or per_pod[0] <= 0:
+            return None
+        chips_per_pod = per_pod[0]
+        total = chips_per_pod * len(pods)
+
+        free = self._free_chip_map()
+        if len(free) < total:
+            return None
+        origin = tuple(min(c[i] for c in free) for i in range(3))
+        extent = tuple(max(c[i] for c in free) - origin[i] + 1 for i in range(3))
+        mesh = ICIMesh(extent)
+        rel_free = {tuple(c[i] - origin[i] for i in range(3)) for c in free}
+
+        block = find_contiguous_block(mesh, rel_free, total)
+        if block is None:
+            return None
+        # host-aligned split: each pod's chips live on exactly one host; a
+        # host owning several pods' worth hosts several pods.
+        by_host: dict = {}
+        for rel in block:
+            coords = tuple(rel[i] + origin[i] for i in range(3))
+            node_name, prefix = free[coords]
+            by_host.setdefault(node_name, []).append(prefix)
+        chunks = []
+        for host in sorted_keys(by_host):
+            chips = sorted(by_host[host])
+            if len(chips) % chips_per_pod != 0:
+                return None
+            for i in range(0, len(chips), chips_per_pod):
+                chunks.append((host, set(chips[i:i + chips_per_pod])))
+        if len(chunks) != len(pods):
+            return None
+
+        return {
+            pod["metadata"]["name"]: chunk
+            for pod, chunk in zip(pods, chunks)
+        }
+
+    @staticmethod
+    def pin_pod(kube_pod: dict, node_name: str, chip_prefixes) -> dict:
+        """Write the pinned contiguous allocation into the pod annotation
+        (same shape the contiguous translation mode produces)."""
+        pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
+        for cont in pod_info.running_containers.values():
+            hbm = int(cont.requests.get(grammar.RESOURCE_HBM_PER_CHIP, 0))
+            cont.dev_requests = {
+                k: v for k, v in cont.dev_requests.items()
+                if not grammar.is_group_resource(k)}
+            cont.allocate_from = {}
+            for prefix in sorted(chip_prefixes):
+                chip_res = f"{prefix}/{grammar.CHIPS_SUFFIX}"
+                cont.dev_requests[chip_res] = 1
+                cont.allocate_from[chip_res] = chip_res
+                if hbm > 0:
+                    hbm_res = f"{prefix}/{grammar.HBM_SUFFIX}"
+                    cont.dev_requests[hbm_res] = hbm
+                    cont.allocate_from[hbm_res] = hbm_res
+        pod_info.node_name = node_name
+        codec.pod_info_to_annotation(kube_pod.setdefault("metadata", {}), pod_info)
+        return kube_pod

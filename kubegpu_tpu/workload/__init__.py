@@ -1,0 +1,9 @@
+"""The workload layer: the SPMD JAX job this framework schedules.
+
+The scheduler's product is a set of ICI-contiguous chips handed to a
+container as ``TPU_VISIBLE_CHIPS``; this package is the other half of that
+contract — it turns an allocation into a `jax.sharding.Mesh` and runs a
+sharded transformer training step on it (data/tensor/sequence parallelism,
+ring attention for long context). It is also the flagship model behind
+``__graft_entry__.py`` and the compute side of ``bench.py``.
+"""

@@ -1,0 +1,176 @@
+"""Flagship model: a decoder-only transformer, TPU-first.
+
+Design choices map straight to the hardware (see the repo prompt and
+`/opt/skills/guides/pallas_guide.md` mental model):
+
+- bfloat16 activations, float32 params/optimizer — MXU-friendly matmuls,
+  stable accumulation;
+- RoPE with *global* positions computed under GSPMD, so sequence-parallel
+  shards agree without communication;
+- attention is either fused causal attention (single shard) or ring
+  attention over the ``seq`` mesh axis (`kubegpu_tpu.workload.ring`);
+- SwiGLU FFN, RMSNorm (no mean subtraction — cheaper on VPU);
+- static shapes everywhere; layers run under `lax.scan`-free Python loop
+  (n_layers is small and static) so XLA sees straight-line fusible HLO.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.workload import spmd
+from kubegpu_tpu.workload.ring import make_sharded_ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 384
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(rng, cfg: TransformerConfig) -> dict:
+    """Parameter pytree; structure mirrors `spmd.param_pspecs` exactly."""
+    k_embed, k_unembed, k_layers = jax.random.split(rng, 3)
+    d, h, f = cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff
+
+    def dense(key, shape):
+        scale = (shape[0]) ** -0.5
+        return jax.random.normal(key, shape, jnp.float32) * scale
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.fold_in(k_layers, i)
+        kq, kk, kv, ko, ku, kg, kd = jax.random.split(k, 7)
+        layers.append({
+            "ln1": jnp.ones((d,), jnp.float32),
+            "wq": dense(kq, (d, h)),
+            "wk": dense(kk, (d, h)),
+            "wv": dense(kv, (d, h)),
+            "wo": dense(ko, (h, d)),
+            "ln2": jnp.ones((d,), jnp.float32),
+            "w_up": dense(ku, (d, f)),
+            "w_gate": dense(kg, (d, f)),
+            "w_down": dense(kd, (f, d)),
+        })
+    return {
+        "embed": jax.random.normal(k_embed, (cfg.vocab, d), jnp.float32) * 0.02,
+        "unembed": dense(k_unembed, (d, cfg.vocab)),
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "layers": layers,
+    }
+
+
+def _rmsnorm(x, gain):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * gain.astype(x.dtype)
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embedding; ``positions`` are global sequence positions."""
+    _, _, _, d = x.shape
+    half = d // 2
+    freqs = jnp.exp(-jnp.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B,T,half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _causal_attention(q, k, v, scale: float):
+    """Single-shard fused causal attention ([B,T,H,D] layout)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def make_forward(cfg: TransformerConfig, mesh=None):
+    """Build ``forward(params, tokens) -> logits``.
+
+    With a mesh whose ``seq`` axis is >1, attention runs as ring attention
+    over that axis; otherwise fused single-shard attention. Everything else
+    is GSPMD-sharded via constraints + param shardings.
+    """
+    use_ring = mesh is not None and mesh.shape.get(spmd.AXIS_SEQ, 1) > 1
+    scale = cfg.head_dim ** -0.5
+    ring_fn = None
+    if use_ring:
+        ring_fn = make_sharded_ring_attention(
+            mesh, spmd.AXIS_DATA, spmd.AXIS_SEQ, spmd.AXIS_MODEL, scale)
+
+    def constrain(x, *spec):
+        if mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    def forward(params, tokens):
+        dt = cfg.compute_dtype()
+        b, t = tokens.shape
+        x = params["embed"].astype(dt)[tokens]
+        x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+
+        for layer in params["layers"]:
+            h = _rmsnorm(x, layer["ln1"])
+            q = (h @ layer["wq"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+            k = (h @ layer["wk"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+            v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            if use_ring:
+                attn = ring_fn(q, k, v)
+            else:
+                attn = _causal_attention(q, k, v, scale)
+            x = x + attn.reshape(b, t, -1) @ layer["wo"].astype(dt)
+            x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
+
+            h = _rmsnorm(x, layer["ln2"])
+            up = h @ layer["w_up"].astype(dt)
+            gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+            x = x + (up * gate) @ layer["w_down"].astype(dt)
+            x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
+
+        x = _rmsnorm(x, params["final_norm"])
+        logits = x @ params["unembed"].astype(dt)
+        return logits.astype(jnp.float32)
+
+    return forward
+
+
+def make_loss_fn(cfg: TransformerConfig, mesh=None):
+    """Next-token cross entropy over ``tokens [B, T+1]``."""
+    fwd = make_forward(cfg, mesh)
+
+    def loss_fn(params, tokens):
+        logits = fwd(params, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return nll.mean()
+
+    return loss_fn

@@ -1,0 +1,108 @@
+"""Mesh construction and sharding layout.
+
+Axis convention:
+
+- ``data``  — batch (pure data parallel; gradients all-reduce here)
+- ``model`` — tensor parallel (attention heads / FFN columns)
+- ``seq``   — sequence/context parallel (ring attention rides this axis)
+
+``mesh_from_env`` consumes the runtime-hook contract
+(`kubegpu_tpu.node.manager`): ``TPU_VISIBLE_CHIPS`` tells the process which
+chips it owns; the mesh is laid out so the ``model``/``seq`` axes map to
+ICI neighbors (the scheduler guaranteed contiguity) and ``data`` to the
+outermost dimension.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+
+def _factor3(n: int) -> tuple:
+    """Factor n into (dp, sp, tp): tp innermost (fastest-varying devices =
+    tightest ICI neighbors), then sp, then dp."""
+    tp = 1
+    for cand in (8, 4, 2):
+        if n % cand == 0:
+            tp = cand
+            break
+    rest = n // tp
+    sp = 1
+    for cand in (4, 2):
+        if rest % cand == 0:
+            sp = cand
+            break
+    dp = rest // sp
+    return dp, sp, tp
+
+
+def make_mesh(n_devices: int | None = None, dp: int | None = None,
+              sp: int | None = None, tp: int | None = None, devices=None):
+    """Build a (data, seq, model) mesh over the first n visible devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if dp is None or sp is None or tp is None:
+        dp, sp, tp = _factor3(n_devices)
+    if dp * sp * tp != n_devices:
+        raise ValueError(f"dp*sp*tp={dp * sp * tp} != n_devices={n_devices}")
+    arr = np.array(devices).reshape(dp, sp, tp)
+    return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_MODEL))
+
+
+def mesh_from_env(env: dict | None = None):
+    """Mesh for the chips this container was allocated (runtime-hook env)."""
+    env = env if env is not None else os.environ
+    visible = env.get("TPU_VISIBLE_CHIPS", "")
+    n = len([c for c in visible.split(",") if c]) if visible else None
+    return make_mesh(n)
+
+
+def batch_pspec():
+    from jax.sharding import PartitionSpec as P
+
+    return P(AXIS_DATA, None)
+
+
+def activation_pspec():
+    from jax.sharding import PartitionSpec as P
+
+    return P(AXIS_DATA, AXIS_SEQ, None)
+
+
+def param_pspecs(cfg):
+    """PartitionSpec pytree matching ``model.init_params`` exactly.
+
+    Tensor-parallel layout: column-parallel in (qkv, FFN up), row-parallel
+    out (attn out, FFN down) — one psum per block, inserted by GSPMD.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    layer = {
+        "ln1": P(None),
+        "wq": P(None, AXIS_MODEL),
+        "wk": P(None, AXIS_MODEL),
+        "wv": P(None, AXIS_MODEL),
+        "wo": P(AXIS_MODEL, None),
+        "ln2": P(None),
+        "w_up": P(None, AXIS_MODEL),
+        "w_gate": P(None, AXIS_MODEL),
+        "w_down": P(AXIS_MODEL, None),
+    }
+    return {
+        "embed": P(None, None),
+        "unembed": P(None, AXIS_MODEL),
+        "final_norm": P(None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }

@@ -1,0 +1,157 @@
+"""Gang scheduling tests (BASELINE config 5): pod-sets onto one contiguous
+cross-host slice, all-or-nothing."""
+
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
+from kubegpu_tpu.node.fake import v5p_host_inventory
+from kubegpu_tpu.scheduler.gang import RESOURCE_GANG, RESOURCE_GANG_SIZE
+from kubegpu_tpu.topology.mesh import ICIMesh
+
+from tests.test_e2e import TPUHost, chips_from_env
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+
+def gang_pod(name, numchips, gang_id, gang_size):
+    pi = PodInfo(name=name, requests={RESOURCE_GANG: gang_id,
+                                      RESOURCE_GANG_SIZE: gang_size})
+    pi.running_containers["main"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: numchips})
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    return {"metadata": meta,
+            "spec": {"containers": [{"name": "main",
+                                     "resources": {"requests": {"cpu": "1"}}}]}}
+
+
+def slice_cluster(host_origins, mesh_dims):
+    """Multi-host cluster, every host a 2x2x1 block of one global mesh."""
+    api = InMemoryAPIServer()
+    hosts = {}
+    for i, origin in enumerate(host_origins):
+        name = f"host{i}"
+        hosts[name] = TPUHost(api, name, v5p_host_inventory(
+            host_origin=origin, mesh_dims=mesh_dims))
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    return api, hosts, Scheduler(api, ds)
+
+
+def bound_coords(api, hosts, pod_names):
+    """Chip coords per pod, via each pod's host runtime hook."""
+    out = {}
+    for name in pod_names:
+        pod = api.get_pod(name)
+        node = pod["spec"].get("nodeName")
+        if not node:
+            out[name] = None
+            continue
+        cfg = hosts[node].hook.create_container(name, "main", {})
+        out[name] = [grammar.coords_from_chip_id(c)
+                     for c in chips_from_env(cfg["envs"])]
+    return out
+
+
+def test_gang_waits_for_all_members():
+    api, hosts, sched = slice_cluster([(0, 0, 0), (2, 0, 0)], (4, 2, 1))
+    api.create_pod(gang_pod("g-0", 4, gang_id=1, gang_size=2))
+    sched.run_until_idle()
+    assert api.get_pod("g-0")["spec"].get("nodeName") is None
+    api.create_pod(gang_pod("g-1", 4, gang_id=1, gang_size=2))
+    sched.run_until_idle()
+    coords = bound_coords(api, hosts, ["g-0", "g-1"])
+    assert all(v is not None for v in coords.values())
+    union = [c for v in coords.values() for c in v]
+    assert len(union) == 8
+    assert ICIMesh((4, 2, 1)).is_connected(union)
+    # each pod's chips on a single host block
+    for v in coords.values():
+        xs = {c[0] for c in v}
+        assert max(xs) - min(xs) <= 1
+
+
+def test_gang_full_4x4x4_slice_across_16_hosts():
+    """BASELINE config 5: 64 chips, 16 hosts, one gang."""
+    origins = [(x, y, z) for z in range(4) for y in (0, 2) for x in (0, 2)]
+    api, hosts, sched = slice_cluster(origins, (4, 4, 4))
+    for i in range(16):
+        api.create_pod(gang_pod(f"g-{i:02d}", 4, gang_id=7, gang_size=16))
+    sched.run_until_idle()
+    coords = bound_coords(api, hosts, [f"g-{i:02d}" for i in range(16)])
+    assert all(v is not None for v in coords.values()), coords
+    union = sorted(c for v in coords.values() for c in v)
+    assert len(union) == 64 and len(set(union)) == 64
+    assert union == sorted((x, y, z) for x in range(4)
+                           for y in range(4) for z in range(4))
+    # every pod is on the host owning its chips
+    for name, chips in coords.items():
+        node = api.get_pod(name)["spec"]["nodeName"]
+        inv_ids = {c.chip_id for c in hosts[node].backend.inventory.chips}
+        assert {grammar.chip_id_from_coords(c) for c in chips} <= inv_ids
+
+
+def test_gang_all_or_nothing_when_no_room():
+    api, hosts, sched = slice_cluster([(0, 0, 0)], (2, 2, 1))
+    # gang needs 8 chips; cluster has 4
+    api.create_pod(gang_pod("g-0", 4, gang_id=2, gang_size=2))
+    api.create_pod(gang_pod("g-1", 4, gang_id=2, gang_size=2))
+    sched.run_until_idle()
+    for n in ("g-0", "g-1"):
+        assert api.get_pod(n)["spec"].get("nodeName") is None
+    # no chips leaked
+    snap = sched.cache.snapshot_node("host0")
+    assert all(v == 0 for v in snap[0].used.values())
+
+
+def test_gang_retries_after_capacity_frees():
+    api, hosts, sched = slice_cluster([(0, 0, 0), (2, 0, 0)], (4, 2, 1))
+    # a non-gang pod occupies one full host
+    from tests.test_e2e import tpu_pod
+
+    api.create_pod(tpu_pod("blocker", 4))
+    sched.run_until_idle()
+    api.create_pod(gang_pod("g-0", 4, gang_id=3, gang_size=2))
+    api.create_pod(gang_pod("g-1", 4, gang_id=3, gang_size=2))
+    sched.run_until_idle()
+    assert api.get_pod("g-0")["spec"].get("nodeName") is None
+    api.delete_pod("blocker")
+    sched.queue.move_all_to_active()
+    sched.run_until_idle()
+    coords = bound_coords(api, hosts, ["g-0", "g-1"])
+    assert all(v is not None for v in coords.values())
+
+
+def test_gang_member_deleted_while_buffered():
+    api, hosts, sched = slice_cluster([(0, 0, 0), (2, 0, 0)], (4, 2, 1))
+    api.create_pod(gang_pod("g-0", 4, gang_id=4, gang_size=2))
+    sched.run_until_idle()
+    api.delete_pod("g-0")
+    assert sched.gang_buffer.pending() == 0
+    # a fresh pair still works
+    api.create_pod(gang_pod("g-1", 4, gang_id=4, gang_size=2))
+    api.create_pod(gang_pod("g-2", 4, gang_id=4, gang_size=2))
+    sched.run_until_idle()
+    coords = bound_coords(api, hosts, ["g-1", "g-2"])
+    assert all(v is not None for v in coords.values())
+
+
+def test_gang_bind_failure_is_atomic():
+    """If the gang commit cannot bind (a member vanished between plan and
+    bind), nothing binds and no chips stay charged."""
+    api, hosts, sched = slice_cluster([(0, 0, 0), (2, 0, 0)], (4, 2, 1))
+    api.create_pod(gang_pod("g-0", 4, gang_id=9, gang_size=2))
+    sched.run_until_idle()
+
+    # sabotage: delete g-1 from the API right after creating it, but hand
+    # the stale pod dict to the gang path directly
+    pod1 = gang_pod("g-1", 4, gang_id=9, gang_size=2)
+    api.create_pod(pod1)
+    api.delete_pod("g-1")
+    sched._handle_gang_pod(pod1, 9, 2)
+
+    assert api.get_pod("g-0")["spec"].get("nodeName") is None
+    for host in hosts:
+        snap = sched.cache.snapshot_node(host)
+        assert all(v == 0 for v in snap[0].used.values()), host
