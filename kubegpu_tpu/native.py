@@ -1,0 +1,97 @@
+"""ctypes bridge to the native library (enumerator + contiguous search).
+
+Build with ``make -C native`` (g++, no external deps). Everything here is
+optional: each caller has a pure-Python fallback, and
+``KUBEGPU_TPU_NATIVE=0`` disables the native path entirely. The Python
+implementations remain the semantic reference; the native ones are
+differentially tested against them.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+LIB_PATH = os.path.join(NATIVE_DIR, "build", "libkubegpu_tpu_native.so")
+
+_lib = None
+_lib_tried = False
+
+
+def build_native(force: bool = False) -> str | None:
+    """Compile the native library; returns its path or None on failure."""
+    if force:
+        subprocess.run(["make", "-C", NATIVE_DIR, "clean"],
+                       capture_output=True, check=False)
+    proc = subprocess.run(["make", "-C", NATIVE_DIR], capture_output=True,
+                          text=True, check=False)
+    if proc.returncode != 0 or not os.path.exists(LIB_PATH):
+        return None
+    global _lib, _lib_tried
+    _lib, _lib_tried = None, False  # reload on next use
+    return LIB_PATH
+
+
+def get_lib():
+    """The loaded native library, or None when unavailable/disabled."""
+    global _lib, _lib_tried
+    if os.environ.get("KUBEGPU_TPU_NATIVE", "1") == "0":
+        return None
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(LIB_PATH)
+        lib.tpu_enumerate.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+        lib.tpu_enumerate.restype = ctypes.c_int
+        lib.tpu_last_error.restype = ctypes.c_char_p
+        lib.tpu_find_contiguous_block.argtypes = [
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.tpu_find_contiguous_block.restype = ctypes.c_int
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def native_enumerate(sysfs_root: str) -> dict:
+    """Run the C++ enumerator over a sysfs-style tree; returns the parsed
+    inventory JSON. Raises RuntimeError with the shim's error message."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library not built (make -C native)")
+    buf = ctypes.create_string_buffer(1 << 20)
+    n = lib.tpu_enumerate(sysfs_root.encode(), buf, len(buf))
+    if n < 0:
+        raise RuntimeError(
+            f"tpu_enumerate failed: {lib.tpu_last_error().decode()}")
+    return json.loads(buf.value.decode())
+
+
+def native_find_contiguous_block(dims, wrap, free_coords, count):
+    """Native contiguous-block search; returns sorted coord list, None when
+    impossible, or raises RuntimeError if the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library not built")
+    free_list = sorted(map(tuple, free_coords))
+    dims_a = (ctypes.c_int * 3)(*dims)
+    wrap_a = (ctypes.c_int * 3)(*(1 if w else 0 for w in wrap))
+    flat = [c for coord in free_list for c in coord]
+    free_a = (ctypes.c_int * max(1, len(flat)))(*flat) if flat else \
+        (ctypes.c_int * 1)(0)
+    out_a = (ctypes.c_int * max(1, count * 3))()
+    n = lib.tpu_find_contiguous_block(dims_a, wrap_a, free_a,
+                                      len(free_list), count, out_a)
+    if n < 0:
+        return None
+    return sorted(tuple(out_a[3 * i + j] for j in range(3)) for i in range(n))

@@ -1,0 +1,76 @@
+"""Native discovery backend: C++ enumerator over an accel-sysfs tree.
+
+Production counterpart of `FakeTPUBackend` behind the same `TPUBackend`
+seam (SURVEY.md §2.9). The C++ shim (`native/tpu_enum.cpp`) does the tree
+walk and JSON emission; this module parses it into a `TPUInventory`.
+
+`write_sysfs_fixture` writes the same tree shape the shim reads, so tests
+and simulations can exercise the full native path against a tmpdir.
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubegpu_tpu import native
+from kubegpu_tpu.core import grammar
+from kubegpu_tpu.node.backend import ChipInfo, TPUBackend, TPUInventory
+
+DEFAULT_SYSFS_ROOT = "/sys/class"
+
+
+class NativeTPUBackend(TPUBackend):
+    """Enumerates chips via the native shim; raises on failure so the
+    device manager's zero-chips-on-failure path engages."""
+
+    def __init__(self, sysfs_root: str = DEFAULT_SYSFS_ROOT):
+        self.sysfs_root = sysfs_root
+
+    def enumerate(self) -> TPUInventory:
+        data = native.native_enumerate(self.sysfs_root)
+        chips = []
+        for c in data["chips"]:
+            coords = grammar.coords_from_chip_id(c["chip_id"])
+            if coords is None or len(coords) != 3:
+                # A malformed id must fail discovery loudly: defaulting the
+                # coords would collide chip identities in the inventory.
+                raise RuntimeError(
+                    f"malformed chip_id {c['chip_id']!r} for accel{c['index']}")
+            chips.append(ChipInfo(
+                index=c["index"], coords=coords,
+                hbm_bytes=int(c["hbm_bytes"]),
+                device_paths=list(c["device_paths"])))
+        return TPUInventory(
+            chips=chips,
+            mesh_dims=tuple(data.get("mesh_dims") or (0, 0, 0)),
+            mesh_wrap=tuple(bool(w) for w in (data.get("wrap") or (0, 0, 0))),
+            host_bounds=tuple(data.get("host_bounds") or (2, 2, 1)),
+            tray_shape=tuple(data.get("tray_shape") or (2, 1, 1)),
+            runtime_version=data.get("runtime_version", ""),
+        )
+
+
+def write_sysfs_fixture(root: str, inventory: TPUInventory) -> None:
+    """Write a TPUInventory as the sysfs-style tree the shim enumerates."""
+
+    def put(path, value):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(f"{value}\n")
+
+    for chip in inventory.chips:
+        dev = os.path.join(root, "accel", f"accel{chip.index}", "device")
+        put(os.path.join(dev, "chip_id"), chip.chip_id)
+        put(os.path.join(dev, "hbm_bytes"), chip.hbm_bytes)
+        for path in chip.device_paths:
+            if path.startswith("/dev/vfio/"):
+                put(os.path.join(dev, "vfio_group"), path.split("/")[-1])
+    topo = os.path.join(root, "topology")
+    put(os.path.join(topo, "mesh_dims"), ",".join(map(str, inventory.mesh_dims)))
+    put(os.path.join(topo, "wrap"),
+        ",".join("1" if w else "0" for w in inventory.mesh_wrap))
+    put(os.path.join(topo, "host_bounds"),
+        ",".join(map(str, inventory.host_bounds)))
+    put(os.path.join(topo, "tray_shape"),
+        ",".join(map(str, inventory.tray_shape)))
+    put(os.path.join(topo, "runtime_version"), inventory.runtime_version)

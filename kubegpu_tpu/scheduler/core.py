@@ -315,19 +315,29 @@ class Scheduler:
             # whole gang once the cluster changes
             self.queue.add_unschedulable(kube_pod)
             return
+        # Pin every member, then validate each against its host through the
+        # full predicate stack (HBM floors, core resources) — the planner
+        # only reasons about chips and must not bypass feasibility.
+        pinned_members = []
+        for member in members:
+            name = member["metadata"]["name"]
+            node_name, chips = assignment[name]
+            pinned = self.gang_planner.pin_pod(member, node_name, chips)
+            pinned_members.append((name, node_name, pinned))
+        for name, node_name, pinned in pinned_members:
+            fits, _, _ = self.generic._fits_on_node(pinned, node_name)
+            if not fits:
+                metrics.SCHEDULE_FAILURES.inc()
+                self.queue.add_unschedulable(kube_pod)
+                return
         self.gang_buffer.drop_gang(gang)
         # Two-phase all-or-nothing commit: assume everything (reversible),
         # then one atomic bind of the whole pod-set.
         assumed: list = []
         try:
-            pinned_members = []
-            for member in members:
-                name = member["metadata"]["name"]
-                node_name, chips = assignment[name]
-                pinned = self.gang_planner.pin_pod(member, node_name, chips)
+            for _, node_name, pinned in pinned_members:
                 self.cache.assume_pod(pinned, node_name)
                 assumed.append(pinned)
-                pinned_members.append((name, node_name, pinned))
             self.api.bind_many(
                 {n: node for n, node, _ in pinned_members},
                 {n: p["metadata"].get("annotations") or {}

@@ -89,53 +89,49 @@ class GangPlanner:
 
     # -- cluster-wide free map ----------------------------------------------
 
-    def _free_chip_map(self):
-        """coords -> (node_name, chip path prefix) for every free chip."""
-        out = {}
-        for node_name in self.cache.node_names():
-            snap = self.cache.snapshot_node(node_name)
-            if snap is None:
-                continue
-            node_ex, _, _ = snap
-            for res in node_ex.allocatable:
-                chip_id = grammar.chip_id_from_path(res)
-                if chip_id is None:
-                    continue
-                coords = grammar.coords_from_chip_id(chip_id)
-                if coords is None or len(coords) != 3:
-                    continue
-                if node_ex.used.get(res, 0) == 0:
-                    out[coords] = (node_name, res[: -len(f"/{grammar.CHIPS_SUFFIX}")])
-        return out
-
     def plan(self, pods: list):
         """Assign each gang pod a host and an exact chip set.
 
         Returns ``{pod_name: (node_name, {chip path prefix})}`` or None.
         Every pod must need the same chip count (the slice is regular), and
-        the chosen block must split host-aligned: chips per host == chips
-        per pod.
+        the chosen block must split host-aligned: chips per host a multiple
+        of chips per pod. Chips that cannot satisfy the pods' per-chip HBM
+        floor are excluded up front.
         """
-        from kubegpu_tpu.topology.mesh import ICIMesh, find_contiguous_block
+        from kubegpu_tpu.topology.inventory import collect_chips, mesh_from_chips
+        from kubegpu_tpu.topology.mesh import find_contiguous_block
 
         per_pod = []
+        hbm_floors = set()
         for pod in pods:
             pod_info = codec.kube_pod_to_pod_info(pod, invalidate_existing=True)
             num = sum(
                 int(c.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
                 for c in pod_info.running_containers.values())
             per_pod.append(num)
+            for c in pod_info.running_containers.values():
+                hbm_floors.add(int(c.requests.get(grammar.RESOURCE_HBM_PER_CHIP, 0)))
         if not per_pod or len(set(per_pod)) != 1 or per_pod[0] <= 0:
             return None
         chips_per_pod = per_pod[0]
         total = chips_per_pod * len(pods)
+        hbm_floor = max(hbm_floors) if hbm_floors else 0
 
-        free = self._free_chip_map()
+        node_infos = {}
+        for node_name in self.cache.node_names():
+            snap = self.cache.snapshot_node(node_name)
+            if snap is not None:
+                node_infos[node_name] = snap[0]
+        all_chips = collect_chips(node_infos)
+        if not all_chips:
+            return None
+        mesh, origin = mesh_from_chips(all_chips)
+        free = {}
+        for chip in all_chips:
+            if chip.free and chip.hbm_free >= hbm_floor:
+                free[chip.coords] = (chip.node_name, chip.prefix)
         if len(free) < total:
             return None
-        origin = tuple(min(c[i] for c in free) for i in range(3))
-        extent = tuple(max(c[i] for c in free) - origin[i] + 1 for i in range(3))
-        mesh = ICIMesh(extent)
         rel_free = {tuple(c[i] - origin[i] for i in range(3)) for c in free}
 
         block = find_contiguous_block(mesh, rel_free, total)
@@ -166,15 +162,21 @@ class GangPlanner:
     @staticmethod
     def pin_pod(kube_pod: dict, node_name: str, chip_prefixes) -> dict:
         """Write the pinned contiguous allocation into the pod annotation
-        (same shape the contiguous translation mode produces)."""
+        (same shape the contiguous translation mode produces). The pod's
+        chip set is split across its containers by their individual
+        ``numchips`` requests — each chip charged exactly once."""
         pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
-        for cont in pod_info.running_containers.values():
+        remaining = sorted(chip_prefixes)
+        for name in sorted(pod_info.running_containers):
+            cont = pod_info.running_containers[name]
+            num = int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
             hbm = int(cont.requests.get(grammar.RESOURCE_HBM_PER_CHIP, 0))
+            mine, remaining = remaining[:num], remaining[num:]
             cont.dev_requests = {
                 k: v for k, v in cont.dev_requests.items()
                 if not grammar.is_group_resource(k)}
             cont.allocate_from = {}
-            for prefix in sorted(chip_prefixes):
+            for prefix in mine:
                 chip_res = f"{prefix}/{grammar.CHIPS_SUFFIX}"
                 cont.dev_requests[chip_res] = 1
                 cont.allocate_from[chip_res] = chip_res

@@ -220,35 +220,19 @@ class TPUScheduler:
             _rewrite_to_tree(tree, pod_info.init_containers[name])
         return True, []
 
-    def _node_chip_map(self, node_info: NodeInfo) -> dict:
-        """chip path prefix -> mesh coords, from the advertised grammar."""
-        chips: dict = {}
-        for res in node_info.allocatable:
-            chip_id = grammar.chip_id_from_path(res)
-            if chip_id is None:
-                continue
-            coords = grammar.coords_from_chip_id(chip_id)
-            if coords is None or len(coords) != 3:
-                continue
-            chips[res[: -len(f"/{grammar.CHIPS_SUFFIX}")]] = coords
-        return chips
-
     def _translate_contiguous(self, node_info: NodeInfo,
                               pod_info: PodInfo) -> tuple[bool, list]:
         """Pin each container's chips to an ICI-contiguous free block."""
-        chip_map = self._node_chip_map(node_info)
-        if not chip_map:
-            return False, [InsufficientResourceError(RESOURCE_CONTIGUOUS, 1, 0, 0)]
-        coords_to_prefix = {c: p for p, c in chip_map.items()}
-        origin = tuple(min(c[i] for c in coords_to_prefix) for i in range(3))
-        extent = tuple(
-            max(c[i] for c in coords_to_prefix) - origin[i] + 1 for i in range(3))
-        mesh = mesh_mod.ICIMesh(extent)
+        from kubegpu_tpu.topology.inventory import collect_chips, mesh_from_chips
 
+        chips = collect_chips({node_info.name or "node": node_info})
+        if not chips:
+            return False, [InsufficientResourceError(RESOURCE_CONTIGUOUS, 1, 0, 0)]
+        mesh, origin = mesh_from_chips(chips)
+        coords_to_prefix = {c.coords: c.prefix for c in chips}
         free = {
-            tuple(c[i] - origin[i] for i in range(3))
-            for p, c in chip_map.items()
-            if node_info.used.get(f"{p}/{grammar.CHIPS_SUFFIX}", 0) == 0
+            tuple(c.coords[i] - origin[i] for i in range(3))
+            for c in chips if c.free
         }
         reasons: list = []
         for name, cont, _ in pod_info.all_containers():

@@ -1,0 +1,78 @@
+"""Recover ICI mesh structure from an advertised NodeInfo (or several).
+
+The annotation wire format is the only channel between node and scheduler,
+so everything placement needs must be derivable from it: chip coordinates
+ride in chip ids, and torus wraparound is recovered from the advertised
+``enumLinks`` bitmasks — a chip at the minimum coordinate of an axis that
+still has the negative-direction link can only mean the axis wraps.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.core import grammar
+from kubegpu_tpu.topology.mesh import ICIMesh
+
+# LINK_DIRS bit positions for the negative direction of each axis
+# (mesh.LINK_DIRS order: +x, -x, +y, -y, +z, -z).
+_NEG_BITS = (1, 3, 5)
+
+
+class ChipEntry:
+    __slots__ = ("coords", "prefix", "node_name", "free", "links", "hbm_free")
+
+    def __init__(self, coords, prefix, node_name, free, links, hbm_free):
+        self.coords = coords
+        self.prefix = prefix        # resource path prefix (.../tpu/<id>)
+        self.node_name = node_name
+        self.free = free
+        self.links = links          # enumLinks bitmask (0 when absent)
+        self.hbm_free = hbm_free    # allocatable - used HBM bytes
+
+
+def collect_chips(node_infos: dict) -> list:
+    """All advertised chips across ``{node_name: NodeInfo}`` with
+    coordinates, freeness, link masks, and free HBM."""
+    chips = []
+    for node_name, node_ex in node_infos.items():
+        for res in node_ex.allocatable:
+            chip_id = grammar.chip_id_from_path(res)
+            if chip_id is None:
+                continue
+            coords = grammar.coords_from_chip_id(chip_id)
+            if coords is None or len(coords) != 3:
+                continue
+            prefix = res[: -len(f"/{grammar.CHIPS_SUFFIX}")]
+            links = node_ex.allocatable.get(
+                f"{prefix}/{grammar.LINKS_SUFFIX}", 0)
+            hbm_path = f"{prefix}/{grammar.HBM_SUFFIX}"
+            hbm_free = (node_ex.allocatable.get(hbm_path, 0)
+                        - node_ex.used.get(hbm_path, 0))
+            chips.append(ChipEntry(
+                coords=coords, prefix=prefix, node_name=node_name,
+                free=node_ex.used.get(res, 0) == 0, links=int(links),
+                hbm_free=hbm_free))
+    return chips
+
+
+def mesh_from_chips(chips: list) -> tuple:
+    """(ICIMesh, origin) spanning all advertised chips.
+
+    Extent comes from the bounding box of *all* chips (not just free ones);
+    per-axis wrap is detected from the link masks: a chip at the axis
+    minimum advertising the negative-direction link implies a torus axis.
+    """
+    if not chips:
+        raise ValueError("no chips")
+    origin = tuple(min(c.coords[i] for c in chips) for i in range(3))
+    extent = tuple(
+        max(c.coords[i] for c in chips) - origin[i] + 1 for i in range(3))
+    wrap = [False, False, False]
+    for axis in range(3):
+        if extent[axis] <= 1:
+            continue
+        for chip in chips:
+            if chip.coords[axis] == origin[axis] and \
+                    chip.links & (1 << _NEG_BITS[axis]):
+                wrap[axis] = True
+                break
+    return ICIMesh(extent, tuple(wrap)), origin

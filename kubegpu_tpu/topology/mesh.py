@@ -189,12 +189,22 @@ def find_contiguous_block(mesh: ICIMesh, free, count: int):
     origin. Falls back to greedy compact connected growth when no box fits
     (fragmented free space). Returns a sorted coord list, or None if no
     connected set of that size exists.
+
+    Dispatches to the native core (`native/contig.cpp`, built via
+    ``make -C native``) when available — semantically identical,
+    differentially tested; this Python implementation is the reference.
     """
     free = set(map(tuple, free))
     if count <= 0:
         return []
     if count > len(free):
         return None
+
+    from kubegpu_tpu import native
+
+    if native.get_lib() is not None:
+        return native.native_find_contiguous_block(
+            mesh.dims, mesh.wrap, free, count)
 
     for shape in _block_shapes(count):
         if any(s > d for s, d in zip(shape, mesh.dims)):

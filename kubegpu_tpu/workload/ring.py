@@ -34,8 +34,10 @@ def ring_attention(q, k, v, axis_name: str, scale: float):
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def step(carry, r):
-        o, m, l, k_blk, v_blk = carry
+    def attend(acc, k_blk, v_blk, r):
+        """One online-softmax accumulation against the block from shard
+        (my_index - r)."""
+        o, m, l = acc
         src = (my_index - r) % axis_size
         kv_pos = src * t_local + jnp.arange(t_local)
 
@@ -49,17 +51,24 @@ def ring_attention(q, k, v, axis_name: str, scale: float):
         l_new = l * alpha + p.sum(axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        return o_new, m_new, l_new
 
+    def step(carry, r):
+        o, m, l, k_blk, v_blk = carry
+        o, m, l = attend((o, m, l), k_blk, v_blk, r)
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+        return (o, m, l, k_nxt, v_nxt), None
 
     b, _, h, d = q.shape
     o0 = jnp.zeros((b, h, t_local, d), jnp.float32)
     m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
-    (o, _, l, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(axis_size))
+    # Rotate only between steps: the last block needs no onward ppermute,
+    # so scan axis_size-1 rotating steps, then accumulate the final block.
+    (o, m, l, k_last, v_last), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(max(0, axis_size - 1)))
+    o, m, l = attend((o, m, l), k_last, v_last, axis_size - 1)
 
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

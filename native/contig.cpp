@@ -1,0 +1,228 @@
+// Contiguous sub-mesh search — native core.
+//
+// Bit-identical port of `kubegpu_tpu/topology/mesh.py::find_contiguous_block`
+// (same shape ordering, same exposure/origin tie-breaking, same greedy
+// fallback), for the gang-scheduling hot path on large slices where the
+// Python search dominates planning time. The Python implementation remains
+// the semantic reference; tests diff the two over randomized cases.
+//
+// C ABI:
+//   int tpu_find_contiguous_block(const int dims[3], const int wrap[3],
+//                                 const int* free_xyz, int n_free,
+//                                 int count, int* out_xyz);
+//     -> number of coords written (== count), or -1 when no connected
+//        subset of that size exists. count<=0 -> 0.
+
+#include <algorithm>
+#include <array>
+#include <cstring>
+#include <map>
+#include <set>
+#include <vector>
+
+namespace {
+
+using Coord = std::array<int, 3>;
+
+const int kDirs[6][3] = {
+    {1, 0, 0}, {-1, 0, 0}, {0, 1, 0}, {0, -1, 0}, {0, 0, 1}, {0, 0, -1},
+};
+
+struct MeshCtx {
+  int dims[3];
+  bool wrap[3];
+
+  bool neighbor(const Coord& c, const int* d, Coord* out) const {
+    Coord n;
+    for (int i = 0; i < 3; i++) {
+      int v = c[i] + d[i];
+      if (wrap[i]) {
+        v = ((v % dims[i]) + dims[i]) % dims[i];
+      } else if (v < 0 || v >= dims[i]) {
+        return false;
+      }
+      n[i] = v;
+    }
+    if (n == c) return false;  // dim-1 wrap self-link
+    *out = n;
+    return true;
+  }
+};
+
+// Axis-aligned box shapes of volume `count`, most compact first — mirrors
+// `_block_shapes` (sort key: surface area, then the shape tuple).
+std::vector<Coord> block_shapes(int count) {
+  std::set<Coord> shapes;
+  for (int a = 1; a <= count; a++) {
+    if (count % a) continue;
+    int rest = count / a;
+    for (int b = 1; b <= rest; b++) {
+      if (rest % b) continue;
+      int c = rest / b;
+      Coord s = {a, b, c};
+      std::sort(s.begin(), s.end());
+      do {
+        shapes.insert(s);
+      } while (std::next_permutation(s.begin(), s.end()));
+    }
+  }
+  std::vector<Coord> out(shapes.begin(), shapes.end());
+  std::stable_sort(out.begin(), out.end(), [](const Coord& x, const Coord& y) {
+    long sx = (long)x[0] * x[1] + (long)x[1] * x[2] + (long)x[0] * x[2];
+    long sy = (long)y[0] * y[1] + (long)y[1] * y[2] + (long)y[0] * y[2];
+    if (sx != sy) return sx < sy;
+    return x < y;
+  });
+  return out;
+}
+
+// Coords of the box at `origin`; false if it leaves the mesh or wraps onto
+// itself — mirrors `_block_coords`.
+bool box_at(const Coord& origin, const Coord& shape, const MeshCtx& mesh,
+            std::vector<Coord>* out) {
+  out->clear();
+  for (int dx = 0; dx < shape[0]; dx++)
+    for (int dy = 0; dy < shape[1]; dy++)
+      for (int dz = 0; dz < shape[2]; dz++) {
+        Coord c;
+        const int d[3] = {dx, dy, dz};
+        for (int i = 0; i < 3; i++) {
+          int v = origin[i] + d[i];
+          if (v >= mesh.dims[i]) {
+            if (!mesh.wrap[i]) return false;
+            v %= mesh.dims[i];
+          }
+          c[i] = v;
+        }
+        out->push_back(c);
+      }
+  std::set<Coord> uniq(out->begin(), out->end());
+  return uniq.size() == out->size();
+}
+
+int exposure(const std::vector<Coord>& block, const std::set<Coord>& free,
+             const MeshCtx& mesh) {
+  std::set<Coord> blockset(block.begin(), block.end());
+  std::set<Coord> seen;
+  for (const Coord& c : block)
+    for (const auto& d : kDirs) {
+      Coord n;
+      if (mesh.neighbor(c, d, &n) && free.count(n) && !blockset.count(n))
+        seen.insert(n);
+    }
+  return (int)seen.size();
+}
+
+// Connected components of the free set, largest first (ties: smallest
+// member) — mirrors `free_components`.
+std::vector<std::set<Coord>> components(const std::set<Coord>& free_in,
+                                        const MeshCtx& mesh) {
+  std::set<Coord> free = free_in;
+  std::vector<std::set<Coord>> comps;
+  while (!free.empty()) {
+    std::set<Coord> comp;
+    std::vector<Coord> stack = {*free.begin()};
+    while (!stack.empty()) {
+      Coord c = stack.back();
+      stack.pop_back();
+      if (!free.count(c) || comp.count(c)) continue;
+      comp.insert(c);
+      for (const auto& d : kDirs) {
+        Coord n;
+        if (mesh.neighbor(c, d, &n) && free.count(n) && !comp.count(n))
+          stack.push_back(n);
+      }
+    }
+    for (const Coord& c : comp) free.erase(c);
+    comps.push_back(std::move(comp));
+  }
+  std::stable_sort(comps.begin(), comps.end(),
+                   [](const std::set<Coord>& a, const std::set<Coord>& b) {
+                     if (a.size() != b.size()) return a.size() > b.size();
+                     return *a.begin() < *b.begin();
+                   });
+  return comps;
+}
+
+}  // namespace
+
+extern "C" int tpu_find_contiguous_block(const int* dims, const int* wrap,
+                                         const int* free_xyz, int n_free,
+                                         int count, int* out_xyz) {
+  if (count <= 0) return 0;
+  MeshCtx mesh;
+  for (int i = 0; i < 3; i++) {
+    mesh.dims[i] = dims[i];
+    mesh.wrap[i] = wrap[i] != 0;
+  }
+  std::set<Coord> free;
+  for (int i = 0; i < n_free; i++)
+    free.insert({free_xyz[3 * i], free_xyz[3 * i + 1], free_xyz[3 * i + 2]});
+  if ((int)free.size() < count) return -1;
+
+  auto emit = [&](std::vector<Coord> block) {
+    std::sort(block.begin(), block.end());
+    for (int i = 0; i < (int)block.size(); i++)
+      for (int j = 0; j < 3; j++) out_xyz[3 * i + j] = block[i][j];
+    return (int)block.size();
+  };
+
+  // Pass 1: compact axis-aligned boxes, least-exposure placement.
+  for (const Coord& shape : block_shapes(count)) {
+    bool fits_dims = true;
+    for (int i = 0; i < 3; i++)
+      if (shape[i] > mesh.dims[i]) fits_dims = false;
+    if (!fits_dims) continue;
+    bool have_best = false;
+    std::pair<int, Coord> best_key;
+    std::vector<Coord> best_block, block;
+    for (const Coord& origin : free) {  // std::set iterates sorted
+      if (!box_at(origin, shape, mesh, &block)) continue;
+      bool subset = true;
+      for (const Coord& c : block)
+        if (!free.count(c)) {
+          subset = false;
+          break;
+        }
+      if (!subset) continue;
+      std::pair<int, Coord> key = {exposure(block, free, mesh), origin};
+      if (!have_best || key < best_key) {
+        have_best = true;
+        best_key = key;
+        best_block = block;
+      }
+    }
+    if (have_best) return emit(best_block);
+  }
+
+  // Pass 2: greedy compact connected growth inside each component.
+  for (const auto& comp : components(free, mesh)) {
+    if ((int)comp.size() < count) continue;
+    Coord seed = *comp.begin();
+    std::vector<Coord> selected = {seed};
+    std::set<Coord> selset = {seed};
+    while ((int)selected.size() < count) {
+      std::map<Coord, int> frontier;  // sorted by coord
+      for (const Coord& c : selected)
+        for (const auto& d : kDirs) {
+          Coord n;
+          if (mesh.neighbor(c, d, &n) && comp.count(n) && !selset.count(n))
+            frontier[n]++;
+        }
+      if (frontier.empty()) break;
+      // Python: max(sorted(frontier), key=count) -> first maximal in
+      // ascending coord order == smallest coord with the max count.
+      Coord next = frontier.begin()->first;
+      int best = frontier.begin()->second;
+      for (const auto& kv : frontier)
+        if (kv.second > best) {
+          best = kv.second;
+          next = kv.first;
+        }
+      selected.push_back(next);
+      selset.insert(next);
+    }
+    if ((int)selected.size() == count) return emit(selected);
+  }
+  return -1;
+}

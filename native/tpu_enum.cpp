@@ -1,0 +1,166 @@
+// TPU chip enumeration shim.
+//
+// The reference reached its native device layer through an external daemon
+// (nvidia-docker-plugin wrapping NVML, `nvidia_docker_plugin.go:21-27`);
+// the TPU build keeps that seam but implements it natively (SURVEY.md
+// §2.9): this library walks an accel-sysfs-style tree (or a fixture tree in
+// tests) and emits the host's chip/ICI inventory as JSON, which the Python
+// `NativeTPUBackend` parses into a TPUInventory.
+//
+// Expected tree layout (modeled on /sys/class/accel + a topology dir the
+// libtpu runtime exposes; fixture-identical in tests):
+//
+//   <root>/accel/accel<N>/device/chip_id     "x.y.z" mesh coordinates
+//   <root>/accel/accel<N>/device/hbm_bytes   decimal bytes
+//   <root>/accel/accel<N>/device/vfio_group  (optional) vfio group number
+//   <root>/topology/mesh_dims                "X,Y,Z"
+//   <root>/topology/wrap                     "0|1,0|1,0|1"
+//   <root>/topology/host_bounds              "X,Y,Z"
+//   <root>/topology/tray_shape               "X,Y,Z"
+//   <root>/topology/runtime_version          free-form string
+//
+// C ABI:
+//   int tpu_enumerate(const char* root, char* out, int out_len);
+//     -> bytes written (JSON), or -1 on error (errno-style via tpu_last_error)
+//   const char* tpu_last_error();
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+bool read_file(const std::string& path, std::string* out) {
+  std::ifstream f(path);
+  if (!f.good()) return false;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  while (!out->empty() && (out->back() == '\n' || out->back() == ' '))
+    out->pop_back();
+  return true;
+}
+
+bool is_dir(const std::string& path) {
+  struct stat st;
+  return stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+struct Chip {
+  int index = -1;
+  std::string chip_id;
+  long long hbm_bytes = 0;
+  std::string vfio_group;  // empty when absent
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* tpu_last_error() { return g_last_error.c_str(); }
+
+int tpu_enumerate(const char* root_c, char* out, int out_len) {
+  g_last_error.clear();
+  const std::string root = root_c ? root_c : "";
+  const std::string accel_dir = root + "/accel";
+  if (!is_dir(accel_dir)) {
+    g_last_error = "no accel directory under " + root;
+    return -1;
+  }
+
+  // Collect accel<N> entries.
+  std::vector<Chip> chips;
+  DIR* d = opendir(accel_dir.c_str());
+  if (!d) {
+    g_last_error = "cannot open " + accel_dir;
+    return -1;
+  }
+  while (dirent* ent = readdir(d)) {
+    const std::string name = ent->d_name;
+    if (name.rfind("accel", 0) != 0 || name == "accel") continue;
+    char* endp = nullptr;
+    long idx = strtol(name.c_str() + 5, &endp, 10);
+    if (endp == nullptr || *endp != '\0') continue;
+    const std::string dev = accel_dir + "/" + name + "/device";
+    Chip chip;
+    chip.index = static_cast<int>(idx);
+    if (!read_file(dev + "/chip_id", &chip.chip_id)) continue;
+    std::string hbm;
+    if (read_file(dev + "/hbm_bytes", &hbm))
+      chip.hbm_bytes = strtoll(hbm.c_str(), nullptr, 10);
+    read_file(dev + "/vfio_group", &chip.vfio_group);
+    chips.push_back(std::move(chip));
+  }
+  closedir(d);
+  if (chips.empty()) {
+    g_last_error = "no chips found under " + accel_dir;
+    return -1;
+  }
+  std::sort(chips.begin(), chips.end(),
+            [](const Chip& a, const Chip& b) { return a.index < b.index; });
+
+  auto topo = [&](const char* f, const char* dflt) {
+    std::string v;
+    if (read_file(root + "/topology/" + f, &v) && !v.empty()) return v;
+    return std::string(dflt);
+  };
+
+  std::ostringstream js;
+  js << "{\"chips\":[";
+  for (size_t i = 0; i < chips.size(); i++) {
+    const Chip& c = chips[i];
+    if (i) js << ",";
+    js << "{\"index\":" << c.index
+       << ",\"chip_id\":\"" << json_escape(c.chip_id) << "\""
+       << ",\"hbm_bytes\":" << c.hbm_bytes
+       << ",\"device_paths\":[\"/dev/accel" << c.index << "\"";
+    if (!c.vfio_group.empty())
+      js << ",\"/dev/vfio/" << json_escape(c.vfio_group) << "\"";
+    js << "]}";
+  }
+  js << "],\"mesh_dims\":[" << topo("mesh_dims", "0,0,0")
+     << "],\"wrap\":[" << topo("wrap", "0,0,0")
+     << "],\"host_bounds\":[" << topo("host_bounds", "2,2,1")
+     << "],\"tray_shape\":[" << topo("tray_shape", "2,1,1")
+     << "],\"runtime_version\":\""
+     << json_escape(topo("runtime_version", "")) << "\"}";
+
+  const std::string s = js.str();
+  if (static_cast<int>(s.size()) + 1 > out_len) {
+    g_last_error = "output buffer too small";
+    return -1;
+  }
+  std::memcpy(out, s.c_str(), s.size() + 1);
+  return static_cast<int>(s.size());
+}
+
+}  // extern "C"

@@ -155,3 +155,104 @@ def test_gang_bind_failure_is_atomic():
     for host in hosts:
         snap = sched.cache.snapshot_node(host)
         assert all(v == 0 for v in snap[0].used.values()), host
+
+
+def test_gang_respects_hbm_floor():
+    """Gang planning must not overcommit HBM (review finding)."""
+    from kubegpu_tpu.node.fake import V5P_HBM
+
+    api, hosts, sched = slice_cluster([(0, 0, 0), (2, 0, 0)], (4, 2, 1))
+
+    def hbm_gang_pod(name, gang_id, hbm):
+        pi = PodInfo(name=name, requests={RESOURCE_GANG: gang_id,
+                                          RESOURCE_GANG_SIZE: 2})
+        pi.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 4,
+                      grammar.RESOURCE_HBM_PER_CHIP: hbm})
+        meta = {"name": name}
+        codec.pod_info_to_annotation(meta, pi)
+        return {"metadata": meta, "spec": {"containers": [{"name": "main"}]}}
+
+    api.create_pod(hbm_gang_pod("big-0", 5, 10 * V5P_HBM))
+    api.create_pod(hbm_gang_pod("big-1", 5, 10 * V5P_HBM))
+    sched.run_until_idle()
+    for n in ("big-0", "big-1"):
+        assert api.get_pod(n)["spec"].get("nodeName") is None, n
+    for host in hosts:
+        snap = sched.cache.snapshot_node(host)
+        assert all(v == 0 for v in snap[0].used.values()), host
+
+    # a feasible HBM floor still binds
+    api.create_pod(hbm_gang_pod("ok-0", 6, V5P_HBM))
+    api.create_pod(hbm_gang_pod("ok-1", 6, V5P_HBM))
+    sched.run_until_idle()
+    for n in ("ok-0", "ok-1"):
+        assert api.get_pod(n)["spec"].get("nodeName"), n
+
+
+def test_gang_pod_multi_container_chips_split():
+    """Each container gets its own chips, charged once (review finding)."""
+    api, hosts, sched = slice_cluster([(0, 0, 0)], (2, 2, 1))
+    pi = PodInfo(name="mc", requests={RESOURCE_GANG: 8, RESOURCE_GANG_SIZE: 1})
+    pi.running_containers["a"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: 1})
+    pi.running_containers["b"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: 1})
+    meta = {"name": "mc"}
+    codec.pod_info_to_annotation(meta, pi)
+    api.create_pod({"metadata": meta,
+                    "spec": {"containers": [{"name": "a"}, {"name": "b"}]}})
+    sched.run_until_idle()
+    assert api.get_pod("mc")["spec"].get("nodeName") == "host0"
+    pod_info = codec.kube_pod_to_pod_info(api.get_pod("mc"), False)
+    chips_a = set(pod_info.running_containers["a"].allocate_from.values())
+    chips_b = set(pod_info.running_containers["b"].allocate_from.values())
+    assert len(chips_a) == 1 and len(chips_b) == 1
+    assert chips_a.isdisjoint(chips_b)
+    snap = sched.cache.snapshot_node("host0")
+    assert all(v <= 1 for v in snap[0].used.values())
+
+
+def test_gang_uses_torus_wrap_links():
+    """Free chips connected only via wraparound still form a gang block
+    (review finding): a 4-wide ring with the middle columns taken."""
+    from kubegpu_tpu.node.backend import ChipInfo, TPUInventory
+    from kubegpu_tpu.node.fake import V5P_HBM
+    from tests.test_e2e import tpu_pod
+
+    def ring_host(origin_x, idx0):
+        chips = [ChipInfo(index=i, coords=(origin_x + i, 0, 0),
+                          hbm_bytes=V5P_HBM,
+                          device_paths=[f"/dev/accel{i}"])
+                 for i in range(2)]
+        return TPUInventory(chips=chips, mesh_dims=(4, 1, 1),
+                            mesh_wrap=(True, False, False),
+                            host_bounds=(2, 1, 1), tray_shape=(1, 1, 1))
+
+    api = InMemoryAPIServer()
+    hosts = {}
+    for i, ox in enumerate((0, 2)):
+        name = f"host{i}"
+        hosts[name] = TPUHost(api, name, ring_host(ox, i))
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched = Scheduler(api, ds)
+
+    # occupy the middle chips (1,0,0) and (2,0,0): host0 chip1, host1 chip0
+    from kubegpu_tpu.scheduler.gang import GangPlanner
+
+    for node, res_sub in (("host0", "1.0.0"), ("host1", "2.0.0")):
+        snap = sched.cache.get_node(node)
+        for res in snap.node_ex.allocatable:
+            if f"/tpu/{res_sub}/chips" in res:
+                snap.node_ex.used[res] = 1
+
+    planner = GangPlanner(sched.cache)
+    pods = [gang_pod("w-0", 1, 11, 2), gang_pod("w-1", 1, 11, 2)]
+    assignment = planner.plan(pods)
+    # (0,0,0) and (3,0,0) are adjacent only through the torus wrap link
+    assert assignment is not None
+    got = sorted(chips for _, chips in assignment.values())
+    ids = sorted(p.split("/tpu/")[1] for _, chips in assignment.values()
+                 for p in chips)
+    assert ids == ["0.0.0", "3.0.0"]
