@@ -1,0 +1,305 @@
+"""HTTP transport for the API server: real multi-process control plane.
+
+The reference's components communicate *only* through the Kubernetes API
+server (SURVEY.md §1); this module gives the framework the same property
+across processes: `serve_api` exposes an `InMemoryAPIServer` over HTTP, and
+`HTTPAPIClient` implements the identical client surface (get/patch nodes,
+pods, bind, watch), so the node agent, scheduler, and runtime hook run as
+separate OS processes wired only by the API endpoint.
+
+Routes (JSON bodies):
+
+    GET    /healthz
+    GET    /nodes            | POST /nodes        | GET/DELETE /nodes/<name>
+    PATCH  /nodes/<name>/metadata
+    GET    /pods[?node=...]  | POST /pods         | GET/DELETE /pods/<name>
+    PUT    /pods/<name>/annotations
+    POST   /pods/<name>/bind            {"node": ...}
+    POST   /bindmany                    {"bindings": {...}, "annotations": {...}}
+    GET    /watch?since=<seq>           -> {"events": [[seq, kind, event, obj]...]}
+    POST   /leases/<name>               {"holder":..., "ttl":...} -> 200/409
+
+Leases implement the scheduler's HA leader election (reference:
+`cmd/app/server.go:396-403,437-461`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubegpu_tpu.cluster.apiserver import Conflict, InMemoryAPIServer, NotFound
+
+
+class LeaseTable:
+    """TTL leases for leader election."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leases: dict = {}  # name -> (holder, expires_at)
+
+    def acquire(self, name: str, holder: str, ttl_s: float) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            current = self._leases.get(name)
+            if current is not None and current[1] > now and current[0] != holder:
+                return False
+            self._leases[name] = (holder, now + ttl_s)
+            return True
+
+    def holder(self, name: str):
+        with self._lock:
+            current = self._leases.get(name)
+            if current is None or current[1] <= time.monotonic():
+                return None
+            return current[0]
+
+
+class _EventLog:
+    """Bounded sequence-numbered event log backing /watch long-polls."""
+
+    def __init__(self, api: InMemoryAPIServer, limit: int = 10000):
+        self._lock = threading.Condition()
+        self._events: list = []
+        self._seq = 0
+        self.limit = limit
+        api.add_watcher(self._record)
+
+    def _record(self, kind, event, obj):
+        with self._lock:
+            self._seq += 1
+            self._events.append((self._seq, kind, event, obj))
+            if len(self._events) > self.limit:
+                self._events = self._events[-self.limit:]
+            self._lock.notify_all()
+
+    def since(self, seq: int, timeout: float = 10.0):
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                out = [e for e in self._events if e[0] > seq]
+                if out or time.monotonic() >= deadline:
+                    return out, self._seq
+                self._lock.wait(min(0.5, deadline - time.monotonic()))
+
+
+def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
+    """Start serving; returns (ThreadingHTTPServer, base_url). The server
+    runs on a daemon thread; call ``server.shutdown()`` to stop."""
+    log = _EventLog(api)
+    leases = LeaseTable()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            return json.loads(self.rfile.read(n).decode()) if n else {}
+
+        def _send(self, code: int, obj=None):
+            data = json.dumps(obj if obj is not None else {}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _route(self, method: str):
+            parts = [p for p in self.path.split("?")[0].split("/") if p]
+            query = {}
+            if "?" in self.path:
+                for kv in self.path.split("?", 1)[1].split("&"):
+                    if "=" in kv:
+                        k, v = kv.split("=", 1)
+                        query[k] = v
+            try:
+                return self._dispatch(method, parts, query)
+            except NotFound as e:
+                self._send(404, {"error": str(e)})
+            except Conflict as e:
+                self._send(409, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _dispatch(self, method, parts, query):
+            if parts == ["healthz"]:
+                return self._send(200, {"ok": True})
+            if parts == ["watch"]:
+                events, seq = log.since(int(query.get("since", 0)),
+                                        float(query.get("timeout", 10.0)))
+                return self._send(200, {"events": events, "seq": seq})
+            if parts and parts[0] == "leases" and method == "POST":
+                body = self._body()
+                ok = leases.acquire(parts[1], body["holder"],
+                                    float(body.get("ttl", 15.0)))
+                return self._send(200 if ok else 409,
+                                  {"holder": leases.holder(parts[1])})
+            if parts and parts[0] == "nodes":
+                if method == "GET" and len(parts) == 1:
+                    return self._send(200, {"items": api.list_nodes()})
+                if method == "POST" and len(parts) == 1:
+                    return self._send(201, api.create_node(self._body()))
+                if method == "GET":
+                    return self._send(200, api.get_node(parts[1]))
+                if method == "DELETE":
+                    api.delete_node(parts[1])
+                    return self._send(200)
+                if method == "PATCH" and parts[2:] == ["metadata"]:
+                    return self._send(200, api.patch_node_metadata(
+                        parts[1], self._body()))
+            if parts and parts[0] == "pods":
+                if method == "GET" and len(parts) == 1:
+                    return self._send(200, {"items": api.list_pods(
+                        node_name=query.get("node"))})
+                if method == "POST" and len(parts) == 1:
+                    return self._send(201, api.create_pod(self._body()))
+                if method == "GET":
+                    return self._send(200, api.get_pod(parts[1]))
+                if method == "DELETE":
+                    api.delete_pod(parts[1])
+                    return self._send(200)
+                if method == "PUT" and parts[2:] == ["annotations"]:
+                    return self._send(200, api.update_pod_annotations(
+                        parts[1], self._body()))
+                if method == "POST" and parts[2:] == ["bind"]:
+                    api.bind_pod(parts[1], self._body()["node"])
+                    return self._send(200)
+            if parts == ["bindmany"] and method == "POST":
+                body = self._body()
+                api.bind_many(body["bindings"], body.get("annotations") or {})
+                return self._send(200)
+            self._send(404, {"error": f"no route {method} {self.path}"})
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_PUT(self):
+            self._route("PUT")
+
+        def do_PATCH(self):
+            self._route("PATCH")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="apiserver-http").start()
+    return server, f"http://{host}:{server.server_address[1]}"
+
+
+class HTTPAPIClient:
+    """Client with the same surface as `InMemoryAPIServer`, over HTTP.
+
+    ``add_watcher`` spawns a long-poll thread replaying the server's event
+    log, so informer-style consumers (the scheduler) work unchanged.
+    """
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._watchers: list = []
+        self._watch_thread = None
+        self._stop = threading.Event()
+
+    def _req(self, method: str, path: str, body=None, timeout=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode()
+            if e.code == 404:
+                raise NotFound(payload)
+            if e.code == 409:
+                raise Conflict(payload)
+            raise RuntimeError(f"HTTP {e.code}: {payload}")
+
+    # -- node/pod surface ---------------------------------------------------
+
+    def create_node(self, node):
+        return self._req("POST", "/nodes", node)
+
+    def get_node(self, name):
+        return self._req("GET", f"/nodes/{name}")
+
+    def list_nodes(self):
+        return self._req("GET", "/nodes")["items"]
+
+    def patch_node_metadata(self, name, patch):
+        return self._req("PATCH", f"/nodes/{name}/metadata", patch)
+
+    def delete_node(self, name):
+        return self._req("DELETE", f"/nodes/{name}")
+
+    def create_pod(self, pod):
+        return self._req("POST", "/pods", pod)
+
+    def get_pod(self, name):
+        return self._req("GET", f"/pods/{name}")
+
+    def list_pods(self, node_name=None):
+        path = "/pods" + (f"?node={node_name}" if node_name else "")
+        return self._req("GET", path)["items"]
+
+    def update_pod_annotations(self, name, annotations):
+        return self._req("PUT", f"/pods/{name}/annotations", annotations)
+
+    def bind_pod(self, name, node_name):
+        return self._req("POST", f"/pods/{name}/bind", {"node": node_name})
+
+    def bind_many(self, bindings, annotations):
+        return self._req("POST", "/bindmany",
+                         {"bindings": bindings, "annotations": annotations})
+
+    def delete_pod(self, name):
+        return self._req("DELETE", f"/pods/{name}")
+
+    def acquire_lease(self, name, holder, ttl_s):
+        try:
+            self._req("POST", f"/leases/{name}",
+                      {"holder": holder, "ttl": ttl_s})
+            return True
+        except Conflict:
+            return False
+
+    # -- watch --------------------------------------------------------------
+
+    def add_watcher(self, fn):
+        self._watchers.append(fn)
+        if self._watch_thread is None:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, daemon=True, name="api-watch")
+            self._watch_thread.start()
+
+    def _watch_loop(self):
+        seq = 0
+        while not self._stop.is_set():
+            try:
+                out = self._req("GET", f"/watch?since={seq}&timeout=5",
+                                timeout=30.0)
+            except Exception:
+                time.sleep(0.5)
+                continue
+            for ev_seq, kind, event, obj in out.get("events", []):
+                seq = max(seq, ev_seq)
+                for fn in list(self._watchers):
+                    try:
+                        fn(kind, event, obj)
+                    except Exception:
+                        pass
+            seq = max(seq, out.get("seq", seq))
+
+    def close(self):
+        self._stop.set()

@@ -1,0 +1,11 @@
+"""Process entry points (the reference's two binaries, plus transport).
+
+- ``apiserver``  — serve the in-memory API server over HTTP
+- ``node-agent`` — device discovery + advertiser loop (crishim's node half,
+  reference `crishim/pkg/app/app.go`)
+- ``scheduler``  — the scheduling engine with optional leader election
+  (reference `kube-scheduler/cmd`)
+- ``cri-hook``   — per-container config rewrite on stdin/stdout (OCI-hook
+  style; reference `crishim/pkg/kubecri`)
+- ``simulate``   — single-process cluster demo
+"""

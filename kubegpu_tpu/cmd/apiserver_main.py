@@ -1,0 +1,31 @@
+"""``kgtpu-apiserver``: serve the cluster state over HTTP."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.cluster.httpapi import serve_api
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8070)
+    args = parser.parse_args(argv)
+
+    api = InMemoryAPIServer()
+    server, url = serve_api(api, args.host, args.port)
+    print(f"apiserver listening at {url}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

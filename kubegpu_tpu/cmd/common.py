@@ -1,0 +1,117 @@
+"""Shared CLI plumbing: config files, health/metrics endpoints, backends.
+
+Mirrors the reference's flag/config conventions (SURVEY.md §6): a
+``--config`` file (JSON, or YAML when available) merged under explicit
+flags, and healthz + Prometheus metrics HTTP servers
+(`cmd/app/server.go:405-417,463-476`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubegpu_tpu import metrics
+
+
+def load_config(path: str | None) -> dict:
+    if not path:
+        return {}
+    with open(path) as f:
+        text = f.read()
+    try:
+        parsed = json.loads(text)
+    except ValueError:
+        try:
+            import yaml  # optional
+
+            parsed = yaml.safe_load(text)
+        except ImportError:
+            raise ValueError(f"{path} is not JSON and PyYAML is unavailable")
+    if not isinstance(parsed, dict):
+        raise ValueError(f"{path}: config must be a mapping, got "
+                         f"{type(parsed).__name__}")
+    return parsed
+
+
+def merge_flags(args, config: dict, keys: list) -> None:
+    """Config file fills in any flag left at its parser default (explicit
+    flags win, like componentconfig vs legacy flags)."""
+    for key in keys:
+        if key in config and getattr(args, key, None) in (None, ""):
+            setattr(args, key, config[key])
+
+
+def prometheus_text() -> str:
+    """Render the process's metrics in Prometheus exposition format."""
+    lines = []
+    for h in (metrics.E2E_SCHEDULING_LATENCY, metrics.ALGORITHM_LATENCY,
+              metrics.BINDING_LATENCY):
+        lines.append(f"# TYPE {h.name} histogram")
+        cumulative = 0
+        for bound, count in zip(h.buckets, h.counts):
+            cumulative += count
+            lines.append(f'{h.name}_bucket{{le="{bound:.0f}"}} {cumulative}')
+        lines.append(f'{h.name}_bucket{{le="+Inf"}} {h.n}')
+        lines.append(f"{h.name}_sum {h.total:.0f}")
+        lines.append(f"{h.name}_count {h.n}")
+    for c in (metrics.SCHEDULE_ATTEMPTS, metrics.SCHEDULE_FAILURES,
+              metrics.PREEMPTION_VICTIMS):
+        lines.append(f"# TYPE {c.name} counter")
+        lines.append(f"{c.name} {c.value}")
+    return "\n".join(lines) + "\n"
+
+
+def serve_health(port: int, extra_status=None):
+    """healthz + /metrics server; returns the server (daemon thread), or
+    None when port <= 0."""
+    if port is None or port <= 0:
+        return None
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                ok = True
+                if extra_status is not None:
+                    ok = bool(extra_status())
+                body = b"ok" if ok else b"unhealthy"
+                self.send_response(200 if ok else 500)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/metrics":
+                body = prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="health").start()
+    return server
+
+
+def build_backend(kind: str, sysfs_root: str):
+    """Device backend selection (the ``--cridevices`` analogue)."""
+    if kind == "native":
+        from kubegpu_tpu.node.enumerator import NativeTPUBackend
+
+        return NativeTPUBackend(sysfs_root)
+    if kind == "fake-v5p":
+        from kubegpu_tpu.node.fake import FakeTPUBackend
+
+        return FakeTPUBackend()
+    if kind == "fake-single":
+        from kubegpu_tpu.node.fake import FakeTPUBackend, single_chip_inventory
+
+        return FakeTPUBackend(single_chip_inventory())
+    raise ValueError(f"unknown backend {kind!r}")

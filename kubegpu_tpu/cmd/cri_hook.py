@@ -1,0 +1,49 @@
+"""``kgtpu-cri-hook``: per-container device injection, OCI-hook style.
+
+Reference: `crishim/pkg/kubecri/docker_container.go` — the shim intercepts
+CreateContainer and rewrites the container config. The modern equivalent
+plugs into containerd as an NRI/OCI hook: the runtime pipes the container
+config JSON to stdin and uses the rewritten JSON from stdout.
+
+    kgtpu-cri-hook --api ... --pod mypod --container main < config.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
+from kubegpu_tpu.cmd import common
+from kubegpu_tpu.cmd.node_agent import build_manager
+from kubegpu_tpu.runtime.hook import TPURuntimeHook
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--api", default="http://127.0.0.1:8070")
+    parser.add_argument("--pod", required=True)
+    parser.add_argument("--container", required=True)
+    parser.add_argument("--backend", default="native",
+                        choices=["native", "fake-v5p", "fake-single"])
+    parser.add_argument("--sysfs-root", default="/sys/class")
+    parser.add_argument("--config", default=None)
+    args = parser.parse_args(argv)
+    common.merge_flags(args, common.load_config(args.config),
+                       ["api", "backend", "sysfs_root"])
+
+    raw = sys.stdin.read()
+    container_config = json.loads(raw) if raw.strip() else {}
+
+    client = HTTPAPIClient(args.api)
+    mgr = build_manager(args.backend, args.sysfs_root)
+    hook = TPURuntimeHook(client, mgr)
+    out = hook.create_container(args.pod, args.container, container_config)
+    json.dump(out, sys.stdout, indent=2, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

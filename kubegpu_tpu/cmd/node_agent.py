@@ -1,0 +1,70 @@
+"""``kgtpu-node-agent``: device discovery + advertiser (the node half).
+
+Reference: `crishim/pkg/app/app.go` — flag parsing, device plugin loading
+(here: backend selection), advertiser startup. The CRI interception half
+lives in ``kgtpu-cri-hook``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import socket
+import threading
+
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
+from kubegpu_tpu.cmd import common
+from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+
+
+def build_manager(backend_kind: str, sysfs_root: str) -> DevicesManager:
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(common.build_backend(backend_kind, sysfs_root)))
+    mgr.start()
+    return mgr
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--api", default="http://127.0.0.1:8070")
+    parser.add_argument("--node-name", default=None,
+                        help="defaults to the hostname, like kubelet")
+    parser.add_argument("--backend", default="native",
+                        choices=["native", "fake-v5p", "fake-single"])
+    parser.add_argument("--sysfs-root", default="/sys/class")
+    parser.add_argument("--advertise-interval", type=float, default=20.0)
+    parser.add_argument("--retry-interval", type=float, default=5.0)
+    parser.add_argument("--register-node", action="store_true",
+                        help="create the node object if absent")
+    parser.add_argument("--healthz-port", type=int, default=0)
+    parser.add_argument("--config", default=None)
+    args = parser.parse_args(argv)
+    common.merge_flags(args, common.load_config(args.config),
+                       ["api", "node_name", "backend", "sysfs_root"])
+
+    node_name = args.node_name or socket.gethostname()
+    client = HTTPAPIClient(args.api)
+    if args.register_node:
+        try:
+            client.get_node(node_name)
+        except KeyError:
+            client.create_node({"metadata": {"name": node_name}})
+
+    mgr = build_manager(args.backend, args.sysfs_root)
+    adv = DeviceAdvertiser(client, mgr, node_name)
+    adv.start(interval_s=args.advertise_interval, retry_s=args.retry_interval)
+    common.serve_health(args.healthz_port,
+                        extra_status=lambda: adv.patch_count > 0)
+    print(f"node-agent advertising {node_name} -> {args.api}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    adv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

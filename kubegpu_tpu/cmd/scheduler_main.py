@@ -1,0 +1,91 @@
+"""``kgtpu-scheduler``: the scheduling engine binary.
+
+Reference: `kube-scheduler/cmd/scheduler.go` + `cmd/app/server.go` —
+componentconfig-style ``--config``, healthz/metrics servers, and
+lease-based leader election for HA (`server.go:396-403,437-461`): replicas
+contend for one lease; only the holder schedules, and a lost lease demotes
+the replica back to standby.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+import time
+
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
+from kubegpu_tpu.cmd import common
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+LEASE_NAME = "kgtpu-scheduler"
+
+
+def build_scheduler(client, args) -> Scheduler:
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched = Scheduler(client, ds, bind_async=bool(args.bind_async),
+                      parallelism=args.parallelism)
+    sched.preemption_enabled = not args.disable_preemption
+    return sched
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--api", default="http://127.0.0.1:8070")
+    parser.add_argument("--parallelism", type=int, default=16)
+    parser.add_argument("--bind-async", action="store_true")
+    parser.add_argument("--disable-preemption", action="store_true")
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--lease-ttl", type=float, default=15.0)
+    parser.add_argument("--healthz-port", type=int, default=0)
+    parser.add_argument("--config", default=None,
+                        help="JSON/YAML file; explicit flags win")
+    args = parser.parse_args(argv)
+    common.merge_flags(args, common.load_config(args.config),
+                       ["api", "parallelism", "lease_ttl"])
+
+    client = HTTPAPIClient(args.api)
+    holder = f"{os.uname().nodename}-{os.getpid()}"
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    sched: Scheduler | None = None
+    common.serve_health(args.healthz_port,
+                        extra_status=lambda: True)
+
+    if not args.leader_elect:
+        sched = build_scheduler(client, args)
+        sched.start()
+        print(f"scheduler running against {args.api}", flush=True)
+        stop.wait()
+        sched.stop()
+        return 0
+
+    # Leader election: acquire -> run; renew at ttl/3; demote on loss.
+    print(f"scheduler candidate {holder} (leader election on)", flush=True)
+    leading = False
+    while not stop.is_set():
+        acquired = client.acquire_lease(LEASE_NAME, holder, args.lease_ttl)
+        if acquired and not leading:
+            sched = build_scheduler(client, args)
+            sched.start()
+            leading = True
+            print(f"{holder} became leader", flush=True)
+        elif not acquired and leading:
+            sched.stop()
+            sched = None
+            leading = False
+            print(f"{holder} lost the lease, standing by", flush=True)
+        stop.wait(args.lease_ttl / 3.0)
+    if sched is not None:
+        sched.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

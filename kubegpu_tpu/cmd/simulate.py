@@ -1,0 +1,100 @@
+"""``kgtpu-simulate``: one-process cluster demo.
+
+Spins up the API server, N fake v5p hosts with advertisers, and the
+scheduler; submits a workload mix (plain, HBM-floored, contiguous, and a
+gang) and prints the placements plus what each container would receive
+from the runtime hook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
+from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+from kubegpu_tpu.node.fake import FakeTPUBackend, v5p_host_inventory
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+from kubegpu_tpu.runtime.hook import TPURuntimeHook
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.gang import RESOURCE_GANG, RESOURCE_GANG_SIZE
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import RESOURCE_CONTIGUOUS, TPUScheduler
+
+
+def make_pod(name, numchips, pod_requests=None, hbm=0):
+    pi = PodInfo(name=name, requests=dict(pod_requests or {}))
+    reqs = {grammar.RESOURCE_NUM_CHIPS: numchips}
+    if hbm:
+        reqs[grammar.RESOURCE_HBM_PER_CHIP] = hbm
+    pi.running_containers["main"] = ContainerInfo(requests=reqs)
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    return {"metadata": meta,
+            "spec": {"containers": [{"name": "main",
+                                     "resources": {"requests": {"cpu": "1"}}}]}}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--hosts", type=int, default=4)
+    parser.add_argument("--json", action="store_true", help="machine output")
+    args = parser.parse_args(argv)
+
+    api = InMemoryAPIServer()
+    hooks = {}
+    origins = [(2 * (i % 2), 2 * (i // 2), 0) for i in range(args.hosts)]
+    mesh_dims = (4, 2 * ((args.hosts + 1) // 2), 1)
+    for i, origin in enumerate(origins):
+        name = f"host{i}"
+        api.create_node({"metadata": {"name": name},
+                         "status": {"allocatable": {"cpu": "64", "pods": 100}}})
+        mgr = DevicesManager()
+        mgr.add_device(TPUDeviceManager(FakeTPUBackend(
+            v5p_host_inventory(host_origin=origin, mesh_dims=mesh_dims))))
+        mgr.start()
+        DeviceAdvertiser(api, mgr, name).advertise_once()
+        hooks[name] = TPURuntimeHook(api, mgr)
+
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched = Scheduler(api, ds)
+
+    api.create_pod(make_pod("plain-2chip", 2))
+    api.create_pod(make_pod("hbm-floored", 1, hbm=90 * 2**30))
+    api.create_pod(make_pod("contig-4chip", 4,
+                            pod_requests={RESOURCE_CONTIGUOUS: 1}))
+    gang_n = min(2, args.hosts)
+    for i in range(gang_n):
+        api.create_pod(make_pod(f"gang-{i}", 4,
+                                pod_requests={RESOURCE_GANG: 1,
+                                              RESOURCE_GANG_SIZE: gang_n}))
+    sched.run_until_idle()
+
+    rows = []
+    for pod in api.list_pods():
+        name = pod["metadata"]["name"]
+        node = pod.get("spec", {}).get("nodeName")
+        env = {}
+        if node:
+            cfg = hooks[node].create_container(name, "main", {})
+            env = {e["key"]: e["value"] for e in cfg.get("envs", [])}
+        rows.append({"pod": name, "node": node or "<pending>",
+                     "chips": env.get("TPU_CHIP_IDS", ""),
+                     "bounds": env.get("TPU_PROCESS_BOUNDS", "")})
+
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        width = max(len(r["pod"]) for r in rows) + 2
+        print(f"{'POD':<{width}}{'NODE':<10}{'CHIPS':<28}BOUNDS")
+        for r in rows:
+            print(f"{r['pod']:<{width}}{r['node']:<10}{r['chips']:<28}{r['bounds']}")
+    sched.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,213 @@
+"""Multi-process control-plane tests: HTTP API transport, CLI binaries,
+leader election."""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+from kubegpu_tpu.node.fake import FakeTPUBackend
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+from tests.test_scheduler_core import tpu_pod
+
+REPO = "/root/repo"
+
+
+@pytest.fixture()
+def http_cluster():
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    yield api, url
+    server.shutdown()
+
+
+def test_http_roundtrip_and_errors(http_cluster):
+    _, url = http_cluster
+    client = HTTPAPIClient(url)
+    client.create_node({"metadata": {"name": "n1", "annotations": {"a": "1"}}})
+    client.patch_node_metadata("n1", {"annotations": {"b": "2"}})
+    node = client.get_node("n1")
+    assert node["metadata"]["annotations"] == {"a": "1", "b": "2"}
+    with pytest.raises(KeyError):
+        client.get_node("ghost")
+    client.create_pod({"metadata": {"name": "p"}})
+    client.bind_pod("p", "n1")
+    with pytest.raises(RuntimeError):
+        client.bind_pod("p", "n2")
+    assert [p["metadata"]["name"] for p in client.list_pods(node_name="n1")] == ["p"]
+    client.close()
+
+
+def test_scheduler_over_http_transport(http_cluster):
+    """The whole engine runs against the HTTP client: watch events drive
+    the queue exactly as with the in-process API."""
+    _, url = http_cluster
+    client = HTTPAPIClient(url)
+    client.create_node({"metadata": {"name": "host0"},
+                        "status": {"allocatable": {"cpu": "8"}}})
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(FakeTPUBackend()))
+    mgr.start()
+    DeviceAdvertiser(client, mgr, "host0").advertise_once()
+
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched_client = HTTPAPIClient(url)
+    sched = Scheduler(sched_client, ds)
+    sched.start()
+    try:
+        client.create_pod(tpu_pod("j1", 2))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if client.get_pod("j1")["spec"].get("nodeName"):
+                break
+            time.sleep(0.05)
+        assert client.get_pod("j1")["spec"].get("nodeName") == "host0"
+    finally:
+        sched.stop()
+        sched_client.close()
+        client.close()
+
+
+def test_lease_leader_election(http_cluster):
+    _, url = http_cluster
+    a, b = HTTPAPIClient(url), HTTPAPIClient(url)
+    assert a.acquire_lease("sched", "holder-a", ttl_s=0.5)
+    assert not b.acquire_lease("sched", "holder-b", ttl_s=0.5)
+    assert a.acquire_lease("sched", "holder-a", ttl_s=0.5)  # renew
+    time.sleep(0.6)  # expire
+    assert b.acquire_lease("sched", "holder-b", ttl_s=0.5)
+    assert not a.acquire_lease("sched", "holder-a", ttl_s=0.5)
+    a.close()
+    b.close()
+
+
+def test_real_processes_end_to_end(tmp_path):
+    """apiserver, node-agent, and scheduler as separate OS processes; the
+    test acts as the user submitting a pod, then runs the CRI hook CLI."""
+    from kubegpu_tpu import native
+    from kubegpu_tpu.node.enumerator import write_sysfs_fixture
+    from kubegpu_tpu.node.fake import v5p_host_inventory
+
+    procs = []
+
+    def spawn(*args):
+        p = subprocess.Popen([sys.executable, "-m", *args], cwd=REPO,
+                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                             text=True)
+        procs.append(p)
+        return p
+
+    port = 8471
+    url = f"http://127.0.0.1:{port}"
+    try:
+        spawn("kubegpu_tpu.cmd.apiserver_main", "--port", str(port))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(f"{url}/healthz", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.1)
+
+        sysfs = str(tmp_path / "sysfs")
+        write_sysfs_fixture(sysfs, v5p_host_inventory())
+        backend = ["--backend", "native", "--sysfs-root", sysfs] \
+            if native.build_native() else ["--backend", "fake-v5p"]
+        spawn("kubegpu_tpu.cmd.node_agent", "--api", url,
+              "--node-name", "host0", "--register-node",
+              "--advertise-interval", "0.2", *backend)
+        spawn("kubegpu_tpu.cmd.scheduler_main", "--api", url)
+
+        client = HTTPAPIClient(url)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            nodes = client.list_nodes()
+            if nodes and "node.alpha/DeviceInformation" in (
+                    nodes[0]["metadata"].get("annotations") or {}):
+                break
+            time.sleep(0.1)
+
+        client.create_pod(tpu_pod("job", 2))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if client.get_pod("job")["spec"].get("nodeName"):
+                break
+            time.sleep(0.1)
+        assert client.get_pod("job")["spec"].get("nodeName") == "host0"
+
+        hook = subprocess.run(
+            [sys.executable, "-m", "kubegpu_tpu.cmd.cri_hook", "--api", url,
+             "--pod", "job", "--container", "main", *backend],
+            cwd=REPO, input="{}", capture_output=True, text=True, timeout=30)
+        assert hook.returncode == 0, hook.stderr
+        cfg = json.loads(hook.stdout)
+        env = {e["key"]: e["value"] for e in cfg["envs"]}
+        assert env["TPU_VISIBLE_CHIPS"]
+        assert len(env["TPU_CHIP_IDS"].split(",")) == 2
+        client.close()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_simulate_cli_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.cmd.simulate", "--hosts", "2",
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    rows = json.loads(out.stdout)
+    placed = {r["pod"]: r["node"] for r in rows}
+    assert placed["plain-2chip"] != "<pending>"
+    assert placed["contig-4chip"] != "<pending>"
+
+
+def test_prometheus_text_renders():
+    from kubegpu_tpu import metrics
+    from kubegpu_tpu.cmd.common import prometheus_text
+
+    metrics.reset_all()
+    metrics.E2E_SCHEDULING_LATENCY.observe(1500.0)
+    text = prometheus_text()
+    assert "scheduler_e2e_scheduling_latency_microseconds_count 1" in text
+    assert 'le="+Inf"' in text
+    assert "scheduler_schedule_attempts_total 0" in text
+
+
+def test_config_file_merging(tmp_path):
+    from argparse import Namespace
+
+    from kubegpu_tpu.cmd.common import load_config, merge_flags
+
+    cfg = tmp_path / "conf.json"
+    cfg.write_text(json.dumps({"api": "http://cfg:1", "parallelism": 4}))
+    args = Namespace(api=None, parallelism=8)
+    merge_flags(args, load_config(str(cfg)), ["api", "parallelism"])
+    assert args.api == "http://cfg:1"
+    assert args.parallelism == 8  # explicit flag wins
+    assert load_config(None) == {}
+
+
+def test_config_file_must_be_mapping(tmp_path):
+    from kubegpu_tpu.cmd.common import load_config
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("just-a-string")
+    with pytest.raises(ValueError, match="must be a mapping"):
+        load_config(str(bad))
