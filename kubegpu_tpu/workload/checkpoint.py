@@ -1,0 +1,77 @@
+"""Training-state checkpointing.
+
+Uses Orbax when importable (the standard JAX checkpointing stack, async-
+and shard-aware); otherwise a plain numpy fallback with identical call
+semantics, so the train loop never changes. The scheduler side needs no
+file checkpoints at all — the API server's annotations are its checkpoint
+(SURVEY.md §6) — this is for the *workload*, which the reference does not
+have.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten(tree):
+    leaves, treedef = jax.tree.flatten(tree)
+    return leaves, treedef
+
+
+def save_checkpoint(path: str, state, step: int) -> str:
+    """Write ``state`` (any pytree) at ``path``; returns the final path."""
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError:
+        return _save_numpy(path, state, step)
+    ckpt = ocp.StandardCheckpointer()
+    full = os.path.abspath(os.path.join(path, f"step_{step}"))
+    # Hand the jax.Array pytree to orbax directly: it saves shard-aware
+    # (multi-host safe) without gathering to one host's memory.
+    ckpt.save(full, state, force=True)
+    ckpt.wait_until_finished()
+    return full
+
+
+def _save_numpy(path: str, state, step: int) -> str:
+    full = os.path.join(path, f"step_{step}")
+    os.makedirs(full, exist_ok=True)
+    leaves, treedef = _flatten(state)
+    np.savez(os.path.join(full, "leaves.npz"),
+             **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+    with open(os.path.join(full, "meta.json"), "w") as f:
+        json.dump({"step": step, "n_leaves": len(leaves)}, f)
+    return full
+
+
+def restore_checkpoint(path: str, like):
+    """Restore the latest ``step_*`` under ``path`` into the structure of
+    ``like``; returns (state, step) or (None, -1) when absent."""
+    if not os.path.isdir(path):
+        return None, -1
+    steps = sorted(
+        (int(d.split("_", 1)[1]), d) for d in os.listdir(path)
+        if d.startswith("step_") and d.split("_", 1)[1].isdigit())
+    if not steps:
+        return None, -1
+    step, dirname = steps[-1]
+    full = os.path.join(path, dirname)
+
+    npz = os.path.join(full, "leaves.npz")
+    if os.path.exists(npz):
+        data = np.load(npz)
+        leaves, treedef = _flatten(like)
+        restored = [jnp.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))]
+        return jax.tree.unflatten(treedef, restored), step
+
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.StandardCheckpointer()
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype), like)
+    return ckpt.restore(full, abstract), step

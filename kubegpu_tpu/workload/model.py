@@ -36,6 +36,10 @@ class TransformerConfig:
     max_seq: int = 1024
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"
+    # Mixture-of-experts FFN: 0 = dense; >0 replaces the FFN with top-1
+    # routed experts sharded over the model axis (expert parallelism).
+    n_experts: int = 0
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -58,17 +62,25 @@ def init_params(rng, cfg: TransformerConfig) -> dict:
     for i in range(cfg.n_layers):
         k = jax.random.fold_in(k_layers, i)
         kq, kk, kv, ko, ku, kg, kd = jax.random.split(k, 7)
-        layers.append({
+        layer = {
             "ln1": jnp.ones((d,), jnp.float32),
             "wq": dense(kq, (d, h)),
             "wk": dense(kk, (d, h)),
             "wv": dense(kv, (d, h)),
             "wo": dense(ko, (h, d)),
             "ln2": jnp.ones((d,), jnp.float32),
-            "w_up": dense(ku, (d, f)),
-            "w_gate": dense(kg, (d, f)),
-            "w_down": dense(kd, (f, d)),
-        })
+        }
+        if cfg.n_experts > 0:
+            from kubegpu_tpu.workload.moe import init_moe_params
+
+            layer["moe"] = init_moe_params(ku, d, f, cfg.n_experts)
+        else:
+            layer.update({
+                "w_up": dense(ku, (d, f)),
+                "w_gate": dense(kg, (d, f)),
+                "w_down": dense(kd, (f, d)),
+            })
+        layers.append(layer)
     return {
         "embed": jax.random.normal(k_embed, (cfg.vocab, d), jnp.float32) * 0.02,
         "unembed": dense(k_unembed, (d, cfg.vocab)),
@@ -106,12 +118,13 @@ def _causal_attention(q, k, v, scale: float):
     return out.astype(q.dtype)
 
 
-def make_forward(cfg: TransformerConfig, mesh=None):
-    """Build ``forward(params, tokens) -> logits``.
+def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
+    """Build ``forward(params, tokens) -> (logits, aux_loss)``.
 
     With a mesh whose ``seq`` axis is >1, attention runs as ring attention
     over that axis; otherwise fused single-shard attention. Everything else
-    is GSPMD-sharded via constraints + param shardings.
+    is GSPMD-sharded via constraints + param shardings. ``aux_loss`` is the
+    MoE load-balancing term (0.0 for dense configs).
     """
     use_ring = mesh is not None and mesh.shape.get(spmd.AXIS_SEQ, 1) > 1
     scale = cfg.head_dim ** -0.5
@@ -134,6 +147,7 @@ def make_forward(cfg: TransformerConfig, mesh=None):
         x = params["embed"].astype(dt)[tokens]
         x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
         positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+        aux_total = jnp.zeros((), jnp.float32)
 
         for layer in params["layers"]:
             h = _rmsnorm(x, layer["ln1"])
@@ -150,27 +164,45 @@ def make_forward(cfg: TransformerConfig, mesh=None):
             x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
 
             h = _rmsnorm(x, layer["ln2"])
-            up = h @ layer["w_up"].astype(dt)
-            gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
-            x = x + (up * gate) @ layer["w_down"].astype(dt)
+            if "moe" in layer:
+                from kubegpu_tpu.workload.moe import moe_ffn
+
+                ffn_out, aux = moe_ffn(layer["moe"], h, dt)
+                x = x + ffn_out
+                aux_total = aux_total + aux
+            else:
+                up = h @ layer["w_up"].astype(dt)
+                gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+                x = x + (up * gate) @ layer["w_down"].astype(dt)
             x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
 
         x = _rmsnorm(x, params["final_norm"])
         logits = x @ params["unembed"].astype(dt)
-        return logits.astype(jnp.float32)
+        return logits.astype(jnp.float32), aux_total
+
+    return forward
+
+
+def make_forward(cfg: TransformerConfig, mesh=None):
+    """``forward(params, tokens) -> logits`` (aux loss discarded)."""
+    fwd = make_forward_with_aux(cfg, mesh)
+
+    def forward(params, tokens):
+        logits, _ = fwd(params, tokens)
+        return logits
 
     return forward
 
 
 def make_loss_fn(cfg: TransformerConfig, mesh=None):
-    """Next-token cross entropy over ``tokens [B, T+1]``."""
-    fwd = make_forward(cfg, mesh)
+    """Next-token cross entropy over ``tokens [B, T+1]`` (+ MoE aux)."""
+    fwd = make_forward_with_aux(cfg, mesh)
 
     def loss_fn(params, tokens):
-        logits = fwd(params, tokens[:, :-1])
+        logits, aux = fwd(params, tokens[:, :-1])
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return nll.mean()
+        return nll.mean() + cfg.moe_aux_weight * aux
 
     return loss_fn

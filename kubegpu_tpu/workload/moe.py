@@ -1,0 +1,75 @@
+"""Mixture-of-experts FFN with expert parallelism.
+
+Experts are sharded across the ``model`` mesh axis (expert parallelism
+rides the same tensor-parallel devices): expert weight tensors carry a
+leading expert dimension partitioned over ``model``, and GSPMD inserts the
+dispatch/combine collectives implied by the routing einsums.
+
+Routing is switch-style top-1 with a jitter-free softmax gate; compute is
+dense-over-experts (every expert runs on every token, selection by one-hot
+combine). That trades FLOPs for simplicity and static shapes — the
+capacity-factor dispatch kernel is a later optimization, not a semantic
+change.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, n_experts: int) -> dict:
+    k_router, k_up, k_gate, k_down = jax.random.split(rng, 4)
+    scale_in = d_model**-0.5
+    scale_out = d_ff**-0.5
+    return {
+        "router": jax.random.normal(k_router, (d_model, n_experts),
+                                    jnp.float32) * scale_in,
+        "w_up": jax.random.normal(k_up, (n_experts, d_model, d_ff),
+                                  jnp.float32) * scale_in,
+        "w_gate": jax.random.normal(k_gate, (n_experts, d_model, d_ff),
+                                    jnp.float32) * scale_in,
+        "w_down": jax.random.normal(k_down, (n_experts, d_ff, d_model),
+                                    jnp.float32) * scale_out,
+    }
+
+
+def moe_pspecs(model_axis: str) -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router": P(None, None),
+        "w_up": P(model_axis, None, None),    # experts sharded: EP
+        "w_gate": P(model_axis, None, None),
+        "w_down": P(model_axis, None, None),
+    }
+
+
+def moe_ffn(params: dict, x, compute_dtype) -> tuple:
+    """Top-1 routed SwiGLU experts. Returns (output, aux_loss).
+
+    ``aux_loss`` is the standard load-balancing loss (mean gate fraction x
+    mean route fraction x n_experts), encouraging uniform expert load.
+    """
+    gate_logits = x.astype(jnp.float32) @ params["router"]
+    gates = jax.nn.softmax(gate_logits, axis=-1)          # [B,T,E]
+    top1 = jnp.argmax(gates, axis=-1)                      # [B,T]
+    n_experts = gates.shape[-1]
+    one_hot = jax.nn.one_hot(top1, n_experts, dtype=gates.dtype)
+    top_gate = jnp.sum(gates * one_hot, axis=-1)           # [B,T]
+
+    # dense-over-experts compute; combine by the routing one-hot
+    up = jnp.einsum("btd,edf->btef", x, params["w_up"].astype(compute_dtype))
+    gate = jax.nn.silu(
+        jnp.einsum("btd,edf->btef", x, params["w_gate"].astype(compute_dtype)))
+    expert_out = jnp.einsum("btef,efd->bted", up * gate,
+                            params["w_down"].astype(compute_dtype))
+    out = jnp.einsum("bted,bte->btd", expert_out,
+                     one_hot.astype(compute_dtype))
+    out = out * top_gate[..., None].astype(compute_dtype)
+
+    # load-balancing aux loss (Switch Transformer eq. 4)
+    route_frac = one_hot.mean(axis=(0, 1))                 # [E]
+    gate_frac = gates.mean(axis=(0, 1))                    # [E]
+    aux = n_experts * jnp.sum(route_frac * gate_frac)
+    return out, aux

@@ -1,0 +1,100 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+Layers are split into S stages; each stage's parameters live on one rank of
+the ``stage`` mesh axis (params carry a leading stage dimension partitioned
+over it). Activations flow stage-to-stage via neighbor `lax.ppermute` — the
+collective-pipelining recipe: every rank runs the same program, stage 0
+injects a fresh microbatch per step, stage S-1 emits one, and the classic
+(S-1)-step bubble fills/drains at the ends.
+
+Composes with data parallelism (``data`` axis stays GSPMD-sharded outside);
+sequence parallelism inside a stage is future work — nesting manual
+collectives needs partial-manual shard_map.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AXIS_STAGE = "stage"
+
+
+def stack_stage_params(per_stage_params: list):
+    """Stack a list of per-stage param pytrees along a leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def make_pipelined_apply(stage_fn, mesh, n_microbatches: int,
+                         stage_axis: str = AXIS_STAGE):
+    """Build ``apply(stacked_params, x) -> y`` running the stage pipeline.
+
+    - ``stage_fn(stage_params, x_mb) -> y_mb`` must be shape-preserving
+      (transformer blocks: [mb, T, D] -> [mb, T, D]).
+    - ``stacked_params``: leading-stage-dim pytree, sharded P(stage, ...).
+    - ``x``: [n_microbatches, mb, ...] microbatched input, replicated over
+      the stage axis; output has the same shape, replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_stages = mesh.shape[stage_axis]
+
+    def per_rank(stacked_local, x):
+        # stacked_local leaves have leading dim 1 (this rank's stage slice)
+        params_local = jax.tree.map(lambda a: a[0], stacked_local)
+        stage = lax.axis_index(stage_axis)
+        total_steps = n_microbatches + n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        mb_shape = x.shape[1:]
+        out_buf = jnp.zeros((n_microbatches,) + mb_shape, x.dtype)
+        carry = jnp.zeros(mb_shape, x.dtype)
+
+        def step(state, t):
+            carry, out_buf = state
+            # stage 0 injects microbatch t (clamped; masked past the end)
+            inject = jnp.logical_and(stage == 0, t < n_microbatches)
+            idx = jnp.minimum(t, n_microbatches - 1)
+            fresh = lax.dynamic_index_in_dim(x, idx, axis=0, keepdims=False)
+            inp = jnp.where(inject, fresh, carry)
+
+            out = stage_fn(params_local, inp)
+
+            # the last stage emits microbatch t-(S-1)
+            emit_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            is_emit = jnp.logical_and(stage == n_stages - 1,
+                                      t >= n_stages - 1)
+            current = lax.dynamic_index_in_dim(out_buf, emit_idx, axis=0,
+                                               keepdims=False)
+            out_buf = lax.dynamic_update_index_in_dim(
+                out_buf, jnp.where(is_emit, out, current), emit_idx, axis=0)
+
+            # hand activations to the next stage (stage S-1 sends nowhere;
+            # stage 0 receives zeros)
+            carry = out if n_stages == 1 else lax.ppermute(
+                out, stage_axis, perm)
+            return (carry, out_buf), None
+
+        (carry, out_buf), _ = lax.scan(
+            step, (carry, out_buf), jnp.arange(total_steps))
+        # only the last stage holds real outputs; psum replicates them
+        mask = (stage == n_stages - 1).astype(x.dtype)
+        return lax.psum(out_buf * mask, stage_axis)
+
+    # P(stage_axis) is a prefix spec: it applies to every param leaf's
+    # leading stage dimension; inputs/outputs are stage-replicated.
+    return jax.shard_map(
+        per_rank, mesh=mesh,
+        in_specs=(P(stage_axis), P()),
+        out_specs=P(),
+        check_vma=False)
+
+
+def split_layers_into_stages(layers: list, n_stages: int) -> list:
+    """Partition a layer list into n_stages contiguous groups (balanced)."""
+    if len(layers) % n_stages != 0:
+        raise ValueError(f"{len(layers)} layers not divisible by "
+                         f"{n_stages} stages")
+    per = len(layers) // n_stages
+    return [layers[i * per:(i + 1) * per] for i in range(n_stages)]

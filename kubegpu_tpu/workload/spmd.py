@@ -96,13 +96,23 @@ def param_pspecs(cfg):
         "wv": P(None, AXIS_MODEL),
         "wo": P(AXIS_MODEL, None),
         "ln2": P(None),
-        "w_up": P(None, AXIS_MODEL),
-        "w_gate": P(None, AXIS_MODEL),
-        "w_down": P(AXIS_MODEL, None),
     }
+    if getattr(cfg, "n_experts", 0) > 0:
+        from kubegpu_tpu.workload.moe import moe_pspecs
+
+        layer["moe"] = moe_pspecs(AXIS_MODEL)
+    else:
+        layer.update({
+            "w_up": P(None, AXIS_MODEL),
+            "w_gate": P(None, AXIS_MODEL),
+            "w_down": P(AXIS_MODEL, None),
+        })
     return {
         "embed": P(None, None),
         "unembed": P(None, AXIS_MODEL),
         "final_norm": P(None),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": [
+            {k: (dict(v) if isinstance(v, dict) else v) for k, v in layer.items()}
+            for _ in range(cfg.n_layers)
+        ],
     }
