@@ -174,7 +174,7 @@ def config4():
             names.append(name)
     # utilization after churn
     snap = c.sched.cache.snapshot_node("host0")
-    used = sum(1 for k, v in snap[0].used.items()
+    used = sum(1 for k, v in snap.node_ex.used.items()
                if k.endswith("/chips") and v > 0)
     return lat, used / 16.0
 

@@ -24,11 +24,16 @@ from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
 LEASE_NAME = "kgtpu-scheduler"
 
 
-def build_scheduler(client, args) -> Scheduler:
+def build_scheduler(client, args, config: dict | None = None) -> Scheduler:
+    from kubegpu_tpu.scheduler.extender import load_extenders
+
+    config = config or {}
     ds = DevicesScheduler()
     ds.add_device(TPUScheduler())
     sched = Scheduler(client, ds, bind_async=bool(args.bind_async),
-                      parallelism=args.parallelism)
+                      parallelism=args.parallelism,
+                      extenders=load_extenders(config),
+                      priority_weights=config.get("priorityWeights"))
     sched.preemption_enabled = not args.disable_preemption
     return sched
 
@@ -45,8 +50,8 @@ def main(argv=None) -> int:
     parser.add_argument("--config", default=None,
                         help="JSON/YAML file; explicit flags win")
     args = parser.parse_args(argv)
-    common.merge_flags(args, common.load_config(args.config),
-                       ["api", "parallelism", "lease_ttl"])
+    config = common.load_config(args.config)
+    common.merge_flags(args, config, ["api", "parallelism", "lease_ttl"])
 
     client = HTTPAPIClient(args.api)
     holder = f"{os.uname().nodename}-{os.getpid()}"
@@ -59,7 +64,7 @@ def main(argv=None) -> int:
                         extra_status=lambda: True)
 
     if not args.leader_elect:
-        sched = build_scheduler(client, args)
+        sched = build_scheduler(client, args, config)
         sched.start()
         print(f"scheduler running against {args.api}", flush=True)
         stop.wait()
@@ -72,7 +77,7 @@ def main(argv=None) -> int:
     while not stop.is_set():
         acquired = client.acquire_lease(LEASE_NAME, holder, args.lease_ttl)
         if acquired and not leading:
-            sched = build_scheduler(client, args)
+            sched = build_scheduler(client, args, config)
             sched.start()
             leading = True
             print(f"{holder} became leader", flush=True)

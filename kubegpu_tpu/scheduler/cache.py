@@ -12,11 +12,14 @@ cache entirely from node/pod annotations (SURVEY.md §6 checkpoint/resume).
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 
 from kubegpu_tpu.core import codec
 from kubegpu_tpu.core.types import NodeInfo
+from kubegpu_tpu.scheduler.equivalence import EquivalenceCache
+from kubegpu_tpu.scheduler.predicates import pod_core_requests, pod_host_ports
 
 ASSUMED_POD_TTL_S = 30.0
 
@@ -33,6 +36,14 @@ class CachedNode:
         self.node_ex: NodeInfo = NodeInfo()
         self.pod_names: set = set()
         self.requested_core: dict = {}  # prechecked (cpu/memory) accounting
+        self.pod_ports: dict = {}       # pod name -> {(proto, hostIP, port)}
+        self.pod_labels: dict = {}      # pod name -> labels (for spreading)
+
+    def used_ports(self) -> set:
+        out: set = set()
+        for ports in self.pod_ports.values():
+            out |= ports
+        return out
 
     @property
     def name(self) -> str:
@@ -43,12 +54,29 @@ class CachedNode:
         return {k: codec.parse_quantity(v) for k, v in alloc.items()}
 
 
+class NodeSnapshot:
+    """Consistent point-in-time copy for lock-free fit/score evaluation.
+    Fully self-contained — no live back-references — so a concurrent
+    ``set_node``/``_charge`` cannot tear a fit decision mid-evaluation."""
+
+    def __init__(self, cached: CachedNode):
+        self.name = cached.name
+        self.node_ex = cached.node_ex.clone()
+        self.requested_core = dict(cached.requested_core)
+        self.used_ports = cached.used_ports()
+        self.pod_labels = {k: dict(v) for k, v in cached.pod_labels.items()}
+        self.pod_names = set(cached.pod_names)
+        self.kube_node = copy.deepcopy(cached.kube_node)
+        self.core_allocatable = cached.core_allocatable()
+
+
 class SchedulerCache:
     def __init__(self, device_scheduler):
         self.device_scheduler = device_scheduler
         self._lock = threading.RLock()
         self.nodes: dict = {}           # name -> CachedNode
         self._assumed: dict = {}        # pod name -> (node_name, deadline)
+        self.equivalence = EquivalenceCache()
 
     # ---- nodes (`node_info.go:456-492`) ------------------------------------
 
@@ -69,11 +97,13 @@ class SchedulerCache:
                 cached.kube_node = kube_node
             cached.node_ex = node_ex
             self.device_scheduler.add_node(name, node_ex)
+            self.equivalence.invalidate_node(name)
 
     def remove_node(self, name: str) -> None:
         with self._lock:
             if self.nodes.pop(name, None) is not None:
                 self.device_scheduler.remove_node(name)
+                self.equivalence.invalidate_node(name)
 
     def get_node(self, name: str) -> CachedNode | None:
         with self._lock:
@@ -109,12 +139,22 @@ class SchedulerCache:
             self.device_scheduler.take_pod_resources(pod_info, cached.node_ex)
         else:
             self.device_scheduler.return_pod_resources(pod_info, cached.node_ex)
+        # Same effective-request semantics as the PodFitsResources predicate
+        # (max(init) folded via max, not sum) so admission and accounting
+        # cannot disagree.
         sign = 1 if take else -1
-        for cont in list(pod_info.running_containers.values()) + \
-                list(pod_info.init_containers.values()):
-            for res, val in cont.kube_requests.items():
-                cached.requested_core[res] = \
-                    cached.requested_core.get(res, 0) + sign * val
+        for res, val in pod_core_requests(kube_pod).items():
+            cached.requested_core[res] = \
+                cached.requested_core.get(res, 0) + sign * val
+        name = (kube_pod.get("metadata") or {}).get("name")
+        if take:
+            cached.pod_ports[name] = pod_host_ports(kube_pod)
+            labels = (kube_pod.get("metadata") or {}).get("labels") or {}
+            cached.pod_labels[name] = dict(labels)
+        else:
+            cached.pod_ports.pop(name, None)
+            cached.pod_labels.pop(name, None)
+        self.equivalence.invalidate_node(node_name)
 
     def assume_pod(self, kube_pod: dict, node_name: str,
                    now: float | None = None) -> None:
@@ -131,13 +171,12 @@ class SchedulerCache:
             self._assumed[name] = (node_name, deadline, kube_pod)
 
     def snapshot_node(self, name: str):
-        """Consistent point-in-time copy for lock-free fit evaluation:
-        (node_ex clone, requested_core copy, CachedNode) or None."""
+        """``NodeSnapshot`` for lock-free fit/score evaluation, or None."""
         with self._lock:
             cached = self.nodes.get(name)
             if cached is None:
                 return None
-            return cached.node_ex.clone(), dict(cached.requested_core), cached
+            return NodeSnapshot(cached)
 
     def confirm_pod(self, pod_name: str) -> None:
         """Bind succeeded: the pod is no longer merely assumed."""

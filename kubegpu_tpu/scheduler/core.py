@@ -20,7 +20,9 @@ from concurrent.futures import ThreadPoolExecutor
 
 from kubegpu_tpu import metrics
 from kubegpu_tpu.core import codec
+from kubegpu_tpu.scheduler import predicates, priorities
 from kubegpu_tpu.scheduler.cache import SchedulerCache
+from kubegpu_tpu.scheduler.equivalence import equivalence_class
 from kubegpu_tpu.scheduler.queue import SchedulingQueue
 
 # Parallel fit evaluation width (reference: 16 workers,
@@ -35,13 +37,7 @@ class FitError(Exception):
         super().__init__(f"pod {pod_name} fits no node: {failures}")
 
 
-def _pod_core_requests(kube_pod: dict) -> dict:
-    out: dict = {}
-    spec = kube_pod.get("spec") or {}
-    for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
-        for res, val in ((c.get("resources") or {}).get("requests") or {}).items():
-            out[res] = out.get(res, 0) + codec.parse_quantity(val)
-    return out
+_pod_core_requests = predicates.pod_core_requests
 
 
 def _pod_priority(kube_pod: dict) -> int:
@@ -52,51 +48,119 @@ class GenericScheduler:
     """Fit/score/select/allocate (`core/generic_scheduler.go:130-188`)."""
 
     def __init__(self, cache: SchedulerCache, device_scheduler,
-                 parallelism: int = DEFAULT_PARALLELISM):
+                 parallelism: int = DEFAULT_PARALLELISM,
+                 extenders: list | None = None,
+                 priority_weights: dict | None = None):
         self.cache = cache
         self.device_scheduler = device_scheduler
         self.parallelism = max(1, parallelism)
+        self.extenders = extenders or []
+        self.priority_weights = priority_weights or priorities.DEFAULT_WEIGHTS
         self._last_node_index = 0
         self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
                                         thread_name_prefix="fit")
 
     # ---- predicates --------------------------------------------------------
 
-    @staticmethod
-    def _core_fits(kube_pod: dict, cached, requested_core: dict) -> tuple[bool, list]:
-        """The stock PodFitsResources predicate for prechecked resources."""
-        alloc = cached.core_allocatable()
-        reasons = []
-        for res, req in _pod_core_requests(kube_pod).items():
-            if res not in alloc:
-                continue  # unknown core resources are not our predicate
-            if req + requested_core.get(res, 0) > alloc[res]:
-                reasons.append(f"Insufficient {res}")
-        return not reasons, reasons
-
-    def _fits_on_node(self, kube_pod: dict, node_name: str):
-        # Evaluate against a point-in-time snapshot so concurrent watcher
-        # mutations of node usage cannot tear mid-fit.
+    def _fits_on_node(self, kube_pod: dict, node_name: str,
+                      eq_class: str | None = None):
+        """The full predicate chain against a point-in-time snapshot so
+        concurrent watcher mutations of node usage cannot tear mid-fit.
+        Order mirrors the reference providers: cheap node gates first, the
+        device predicate (`devicepredicate.go:11-26`) last."""
+        if eq_class is not None:
+            hit = self.cache.equivalence.lookup(node_name, eq_class)
+            if hit is not None:
+                return hit
+            # Read the generation BEFORE the snapshot: if the node changes
+            # while we compute, store() drops the now-stale result instead
+            # of poisoning the cache (the upstream equivalence-cache race).
+            gen = self.cache.equivalence.generation(node_name)
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
-        node_ex, requested_core, cached = snap
-        ok_core, core_reasons = self._core_fits(kube_pod, cached, requested_core)
-        if not ok_core:
-            return False, core_reasons, 0.0
-        pod_info = self.cache.pod_info_for_node(kube_pod, node_name)
+        result = self._run_predicates(kube_pod, snap)
+        if eq_class is not None:
+            self.cache.equivalence.store(node_name, eq_class, result, gen)
+        return result
+
+    def _run_predicates(self, kube_pod: dict, snap):
+        kube_node = snap.kube_node
+        chain = (
+            lambda: predicates.check_node_condition(kube_pod, kube_node),
+            lambda: predicates.pod_fits_host(kube_pod, kube_node),
+            lambda: predicates.pod_matches_node_selector(kube_pod, kube_node),
+            lambda: predicates.pod_tolerates_node_taints(kube_pod, kube_node),
+            lambda: predicates.pod_fits_host_ports(kube_pod, snap.used_ports),
+            lambda: predicates.pod_fits_resources(
+                kube_pod, snap.core_allocatable, snap.requested_core),
+        )
+        for pred in chain:
+            ok, reasons = pred()
+            if not ok:
+                return False, reasons, 0.0
+        pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
         fits, reasons, score = self.device_scheduler.pod_fits_resources(
-            pod_info, node_ex, False)
+            pod_info, snap.node_ex, False)
         return fits, [str(r) for r in reasons], score
 
     def find_nodes_that_fit(self, kube_pod: dict):
-        """Parallel filter over all nodes (`generic_scheduler.go:310-383`)."""
+        """Parallel filter over all nodes (`generic_scheduler.go:310-383`),
+        memoized per equivalence class, then extender callouts."""
         names = self.cache.node_names()
+        eq_class = equivalence_class(kube_pod)
         results = list(self._pool.map(
-            lambda n: (n, *self._fits_on_node(kube_pod, n)), names))
+            lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class)), names))
         feasible = {n: score for n, ok, _, score in results if ok}
         failures = {n: reasons for n, ok, reasons, _ in results if not ok}
+        for ext in self.extenders:
+            if not feasible:
+                break
+            survivors, failed = ext.filter(kube_pod, sorted(feasible))
+            for name, reason in failed.items():
+                if name in feasible:
+                    feasible.pop(name)
+                    failures[name] = [reason or "extender refused"]
+            for name in list(feasible):
+                if name not in survivors:
+                    feasible.pop(name)
+                    failures[name] = ["extender refused"]
         return feasible, failures
+
+    def prioritize_nodes(self, kube_pod: dict, feasible: dict) -> dict:
+        """Map-reduce the priority functions over feasible nodes
+        (`generic_scheduler.go:526-...`): stock priorities + the device
+        score from the fit pass + extender scores, weighted-summed."""
+        pod_requests = _pod_core_requests(kube_pod)
+        facts: dict = {}
+        for name in sorted(feasible):
+            snap = self.cache.snapshot_node(name)
+            if snap is not None:
+                facts[name] = priorities.NodeFacts(
+                    snap.kube_node, snap.core_allocatable,
+                    snap.requested_core, snap.pod_labels)
+        max_same = max(
+            (priorities._count_same_labeled(kube_pod, f)
+             for f in facts.values()), default=0)
+        combined: dict = {}
+        for name, f in facts.items():
+            per = {
+                "least_requested": priorities.least_requested(pod_requests, f),
+                "balanced_allocation":
+                    priorities.balanced_allocation(pod_requests, f),
+                "selector_spreading":
+                    priorities.selector_spreading(kube_pod, f, max_same),
+                "node_affinity": priorities.node_affinity(kube_pod, f),
+                "taint_toleration": priorities.taint_toleration(kube_pod, f),
+                "node_prefer_avoid_pods":
+                    priorities.node_prefer_avoid_pods(kube_pod, f),
+                "device_score": feasible[name] * priorities.MAX_PRIORITY,
+            }
+            combined[name] = priorities.combine(per, self.priority_weights)
+        for ext in self.extenders:
+            for name, score in ext.prioritize(kube_pod, sorted(combined)).items():
+                combined[name] = combined.get(name, 0.0) + score
+        return combined
 
     def select_host(self, scored: dict) -> str:
         """Max score; round-robin among ties for spreading
@@ -116,8 +180,15 @@ class GenericScheduler:
         if not feasible:
             trace.log_if_long()
             raise FitError(pod_name, failures)
-        host = (next(iter(feasible)) if len(feasible) == 1
-                else self.select_host(feasible))
+        if len(feasible) == 1:
+            host = next(iter(feasible))
+        else:
+            scored = self.prioritize_nodes(kube_pod, feasible)
+            trace.step("prioritized")
+            if not scored:  # every feasible node vanished mid-pass
+                trace.log_if_long()
+                raise FitError(pod_name, {n: ["node gone"] for n in feasible})
+            host = self.select_host(scored)
         trace.step("selected host")
         metrics.ALGORITHM_LATENCY.observe((time.perf_counter() - t0) * 1e6)
         trace.log_if_long()
@@ -130,7 +201,7 @@ class GenericScheduler:
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             raise FitError(kube_pod["metadata"]["name"], {node_name: ["node gone"]})
-        node_ex, _, _ = snap
+        node_ex = snap.node_ex
         pod_info = self.cache.pod_info_for_node(kube_pod, node_name)
         self.device_scheduler.pod_allocate(pod_info, node_ex)
         pod_info.node_name = node_name
@@ -158,12 +229,12 @@ class GenericScheduler:
     def _victims_on_node(self, kube_pod, snap, prio):
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
 
-        sim, core_free, cached = snap
+        sim, core_free = snap.node_ex, snap.requested_core
         api = getattr(self, "api", None)
         if api is None:
             return None
         candidates = []
-        for pod_name in sorted(cached.pod_names):
+        for pod_name in sorted(snap.pod_names):
             try:
                 p = api.get_pod(pod_name)
             except NotFound:
@@ -180,12 +251,12 @@ class GenericScheduler:
             for res, val in _pod_core_requests(victim).items():
                 core_free[res] = core_free.get(res, 0) - val
             victims.append(victim)
-            alloc = cached.core_allocatable()
+            alloc = snap.core_allocatable
             core_ok = all(
                 req + core_free.get(res, 0) <= alloc[res]
                 for res, req in _pod_core_requests(kube_pod).items()
                 if res in alloc)
-            pod_info = self.cache.pod_info_for_node(kube_pod, cached.name)
+            pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
             fits, _, _ = self.device_scheduler.pod_fits_resources(pod_info, sim, False)
             if core_ok and fits:
                 return victims
@@ -197,14 +268,18 @@ class Scheduler:
     (`kube-scheduler/pkg/scheduler.go:174-502`)."""
 
     def __init__(self, api, device_scheduler, bind_async: bool = False,
-                 parallelism: int = DEFAULT_PARALLELISM):
+                 parallelism: int = DEFAULT_PARALLELISM,
+                 extenders: list | None = None,
+                 priority_weights: dict | None = None):
         from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
 
         self.api = api
         self.device_scheduler = device_scheduler
         self.cache = SchedulerCache(device_scheduler)
         self.queue = SchedulingQueue()
-        self.generic = GenericScheduler(self.cache, device_scheduler, parallelism)
+        self.generic = GenericScheduler(self.cache, device_scheduler, parallelism,
+                                        extenders=extenders,
+                                        priority_weights=priority_weights)
         self.generic.api = api
         self.gang_buffer = GangBuffer()
         self.gang_planner = GangPlanner(self.cache)

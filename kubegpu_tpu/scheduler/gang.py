@@ -121,7 +121,7 @@ class GangPlanner:
         for node_name in self.cache.node_names():
             snap = self.cache.snapshot_node(node_name)
             if snap is not None:
-                node_infos[node_name] = snap[0]
+                node_infos[node_name] = snap.node_ex
         all_chips = collect_chips(node_infos)
         if not all_chips:
             return None

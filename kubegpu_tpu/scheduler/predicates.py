@@ -1,0 +1,197 @@
+"""Stock fit predicates.
+
+The reference scheduler fork ships the full upstream predicate suite
+(`kube-scheduler/pkg/algorithm/predicates/predicates.go`, ~1498 LoC) and
+inserts the device predicate alongside it
+(`algorithmprovider/defaults/defaults.go:82-84`). This module provides the
+non-device predicates the engine runs before ``PodFitsDevices``:
+
+- ``pod_fits_host``        — spec.nodeName pinning (PodFitsHost)
+- ``pod_matches_node_selector`` — nodeSelector labels + required node
+  affinity terms (PodMatchNodeSelector)
+- ``pod_fits_host_ports``  — hostPort conflicts (PodFitsHostPorts)
+- ``pod_tolerates_node_taints`` — NoSchedule/NoExecute taints vs
+  tolerations (PodToleratesNodeTaints)
+- ``check_node_condition`` — Ready / unschedulable / pressure gates
+  (CheckNodeCondition + Memory/DiskPressure predicates)
+- ``pod_fits_resources``   — prechecked cpu/memory accounting
+  (PodFitsResources; group resources are the device predicate's job,
+  cf. ``PrecheckedResource`` in `resource/resourcetranslate.go:97-99`)
+
+Each predicate returns ``(fits: bool, reasons: list[str])`` and is pure
+over the pod dict plus a point-in-time node snapshot, so the chain can run
+inside the parallel filter workers and its results can be memoized by the
+equivalence cache.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.core import codec
+
+
+# ---- helpers ---------------------------------------------------------------
+
+def pod_core_requests(kube_pod: dict) -> dict:
+    """Sum of container resource requests; init containers use max-not-sum
+    semantics like upstream (effective request = max(max(init), sum(run)))."""
+    running: dict = {}
+    init_max: dict = {}
+    spec = kube_pod.get("spec") or {}
+    for c in spec.get("containers") or []:
+        for res, val in ((c.get("resources") or {}).get("requests") or {}).items():
+            running[res] = running.get(res, 0) + codec.parse_quantity(val)
+    for c in spec.get("initContainers") or []:
+        for res, val in ((c.get("resources") or {}).get("requests") or {}).items():
+            init_max[res] = max(init_max.get(res, 0), codec.parse_quantity(val))
+    out = dict(running)
+    for res, val in init_max.items():
+        out[res] = max(out.get(res, 0), val)
+    return out
+
+
+def pod_host_ports(kube_pod: dict) -> set:
+    """(protocol, hostIP, hostPort) triples requested by the pod."""
+    out = set()
+    spec = kube_pod.get("spec") or {}
+    for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
+        for port in c.get("ports") or []:
+            hp = port.get("hostPort")
+            if hp:
+                out.add((port.get("protocol") or "TCP",
+                         port.get("hostIP") or "0.0.0.0", int(hp)))
+    return out
+
+
+def _ports_conflict(a: tuple, b: tuple) -> bool:
+    proto_a, ip_a, port_a = a
+    proto_b, ip_b, port_b = b
+    if proto_a != proto_b or port_a != port_b:
+        return False
+    # 0.0.0.0 conflicts with every hostIP on the same port/protocol
+    return ip_a == ip_b or ip_a == "0.0.0.0" or ip_b == "0.0.0.0"
+
+
+# ---- node selector / affinity ----------------------------------------------
+
+def _match_expression(labels: dict, expr: dict) -> bool:
+    key = expr.get("key")
+    op = expr.get("operator")
+    values = expr.get("values") or []
+    present = key in labels
+    val = labels.get(key)
+    if op == "In":
+        return present and val in values
+    if op == "NotIn":
+        return not present or val not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    if op in ("Gt", "Lt"):
+        if not present or not values:
+            return False
+        try:
+            lhs, rhs = int(val), int(values[0])
+        except (TypeError, ValueError):
+            return False
+        return lhs > rhs if op == "Gt" else lhs < rhs
+    return False
+
+
+def node_selector_term_matches(labels: dict, term: dict) -> bool:
+    """All matchExpressions of one nodeSelectorTerm must hold (AND)."""
+    exprs = term.get("matchExpressions") or []
+    return all(_match_expression(labels, e) for e in exprs)
+
+
+def required_affinity_matches(kube_pod: dict, node_labels: dict) -> bool:
+    affinity = ((kube_pod.get("spec") or {}).get("affinity") or {}) \
+        .get("nodeAffinity") or {}
+    required = affinity.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not required:
+        return True
+    terms = required.get("nodeSelectorTerms") or []
+    if not terms:
+        return True
+    # terms are ORed
+    return any(node_selector_term_matches(node_labels, t) for t in terms)
+
+
+# ---- the predicates ---------------------------------------------------------
+
+def pod_fits_host(kube_pod: dict, kube_node: dict) -> tuple:
+    wanted = (kube_pod.get("spec") or {}).get("nodeName")
+    if wanted and wanted != kube_node["metadata"]["name"]:
+        return False, [f"node(s) didn't match the requested hostname {wanted}"]
+    return True, []
+
+
+def pod_matches_node_selector(kube_pod: dict, kube_node: dict) -> tuple:
+    labels = (kube_node.get("metadata") or {}).get("labels") or {}
+    selector = (kube_pod.get("spec") or {}).get("nodeSelector") or {}
+    for key, val in selector.items():
+        if labels.get(key) != val:
+            return False, ["node(s) didn't match node selector"]
+    if not required_affinity_matches(kube_pod, labels):
+        return False, ["node(s) didn't match pod affinity rules"]
+    return True, []
+
+
+def pod_fits_host_ports(kube_pod: dict, used_ports: set) -> tuple:
+    wanted = pod_host_ports(kube_pod)
+    for w in sorted(wanted):
+        for u in used_ports:
+            if _ports_conflict(w, u):
+                return False, [f"node(s) didn't have free ports ({w[2]}/{w[0]})"]
+    return True, []
+
+
+def _toleration_tolerates(tol: dict, taint: dict) -> bool:
+    effect = tol.get("effect")
+    if effect and effect != taint.get("effect"):
+        return False
+    op = tol.get("operator") or "Equal"
+    if op == "Exists":
+        return not tol.get("key") or tol.get("key") == taint.get("key")
+    return (tol.get("key") == taint.get("key")
+            and tol.get("value") == taint.get("value"))
+
+
+def pod_tolerates_node_taints(kube_pod: dict, kube_node: dict) -> tuple:
+    taints = (kube_node.get("spec") or {}).get("taints") or []
+    tolerations = (kube_pod.get("spec") or {}).get("tolerations") or []
+    for taint in taints:
+        if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+            continue  # PreferNoSchedule is a priority, not a predicate
+        if not any(_toleration_tolerates(t, taint) for t in tolerations):
+            return False, [
+                f"node(s) had taint {{{taint.get('key')}: "
+                f"{taint.get('value')}}}, that the pod didn't tolerate"]
+    return True, []
+
+
+def check_node_condition(kube_pod: dict, kube_node: dict) -> tuple:
+    spec = kube_node.get("spec") or {}
+    if spec.get("unschedulable"):
+        return False, ["node(s) were unschedulable"]
+    reasons = []
+    for cond in (kube_node.get("status") or {}).get("conditions") or []:
+        ctype, status = cond.get("type"), cond.get("status")
+        if ctype == "Ready" and status != "True":
+            reasons.append("node(s) were not ready")
+        elif ctype == "MemoryPressure" and status == "True":
+            reasons.append("node(s) had memory pressure")
+        elif ctype == "DiskPressure" and status == "True":
+            reasons.append("node(s) had disk pressure")
+    return not reasons, reasons
+
+
+def pod_fits_resources(kube_pod: dict, core_allocatable: dict,
+                       requested_core: dict) -> tuple:
+    reasons = []
+    for res, req in pod_core_requests(kube_pod).items():
+        if res not in core_allocatable:
+            continue  # group/device resources: the device predicate's job
+        if req + requested_core.get(res, 0) > core_allocatable[res]:
+            reasons.append(f"Insufficient {res}")
+    return not reasons, reasons

@@ -1,0 +1,183 @@
+"""Stock priority functions.
+
+The reference fork ships the upstream priority suite
+(`kube-scheduler/pkg/algorithm/priorities/`, ~1100 LoC) and combines it
+with the device score produced during the fit pass
+(`core/generic_scheduler.go:170-171,526-...`). Each function here maps a
+feasible node to a score on the upstream 0..10 scale; ``combine`` does the
+weighted sum. All functions are pure over the pod dict plus per-node facts
+gathered from the cache snapshot, so the map-reduce can run in the same
+parallel workers as the filter.
+
+Implemented (reference file in parens):
+
+- ``least_requested``          (least_requested.go) — favor idle nodes
+- ``balanced_allocation``      (balanced_resource_allocation.go) — favor
+  nodes where cpu and memory utilization stay close to each other
+- ``selector_spreading``       (selector_spreading.go) — spread pods with
+  the same labels across nodes
+- ``node_affinity``            (node_affinity.go) — sum of matched
+  preferredDuringScheduling term weights
+- ``taint_toleration``         (taint_toleration.go) — fewer intolerable
+  PreferNoSchedule taints is better
+- ``node_prefer_avoid_pods``   (node_prefer_avoid_pods.go) — node
+  annotation veto for controller-owned pods
+"""
+
+from __future__ import annotations
+
+import json
+
+MAX_PRIORITY = 10.0
+
+# Upstream default weights (algorithmprovider/defaults/defaults.go): every
+# standard priority is weight 1; device score rides with the same weight as
+# a resource priority.
+DEFAULT_WEIGHTS = {
+    "least_requested": 1.0,
+    "balanced_allocation": 1.0,
+    "selector_spreading": 1.0,
+    "node_affinity": 1.0,
+    "taint_toleration": 1.0,
+    "node_prefer_avoid_pods": 1.0,
+    "device_score": 2.0,
+}
+
+
+class NodeFacts:
+    """Per-node inputs to the priority functions, extracted from one cache
+    snapshot so scoring never races the watcher."""
+
+    def __init__(self, kube_node: dict, core_allocatable: dict,
+                 requested_core: dict, pod_labels: dict):
+        self.kube_node = kube_node
+        self.core_allocatable = core_allocatable  # res -> int
+        self.requested_core = requested_core      # res -> int (incl. assumed)
+        self.pod_labels = pod_labels              # pod name -> labels dict
+
+    @property
+    def labels(self) -> dict:
+        return (self.kube_node.get("metadata") or {}).get("labels") or {}
+
+
+def _fraction(requested: float, capacity: float) -> float:
+    if capacity <= 0:
+        return 1.0
+    return min(max(requested / capacity, 0.0), 1.0)
+
+
+def least_requested(pod_requests: dict, facts: NodeFacts) -> float:
+    """((capacity - requested) / capacity) * 10, averaged over cpu+memory
+    (`least_requested.go`)."""
+    scores = []
+    for res in ("cpu", "memory"):
+        cap = facts.core_allocatable.get(res)
+        if not cap:
+            continue
+        used = facts.requested_core.get(res, 0) + pod_requests.get(res, 0)
+        scores.append((1.0 - _fraction(used, cap)) * MAX_PRIORITY)
+    return sum(scores) / len(scores) if scores else MAX_PRIORITY / 2
+
+
+def balanced_allocation(pod_requests: dict, facts: NodeFacts) -> float:
+    """10 - |cpuFraction - memoryFraction| * 10 (`balanced_resource_
+    allocation.go`): penalize lopsided utilization."""
+    fracs = []
+    for res in ("cpu", "memory"):
+        cap = facts.core_allocatable.get(res)
+        if not cap:
+            continue
+        used = facts.requested_core.get(res, 0) + pod_requests.get(res, 0)
+        fracs.append(_fraction(used, cap))
+    if len(fracs) < 2:
+        return MAX_PRIORITY / 2
+    return (1.0 - abs(fracs[0] - fracs[1])) * MAX_PRIORITY
+
+
+def selector_spreading(kube_pod: dict, facts: NodeFacts,
+                       max_same: int) -> float:
+    """Fewer same-labeled pods on the node → higher score, normalized by
+    the cluster-wide max (`selector_spreading.go`). The reference selects
+    by service/RC selector; the standalone engine uses label equality of
+    the pod's identifying labels."""
+    same = _count_same_labeled(kube_pod, facts)
+    if max_same <= 0:
+        return MAX_PRIORITY
+    return (1.0 - same / max_same) * MAX_PRIORITY
+
+
+def _count_same_labeled(kube_pod: dict, facts: NodeFacts) -> int:
+    labels = (kube_pod.get("metadata") or {}).get("labels") or {}
+    ident = {k: v for k, v in labels.items() if k != "name"}
+    if not ident:
+        return 0
+    n = 0
+    for other in facts.pod_labels.values():
+        if all(other.get(k) == v for k, v in ident.items()):
+            n += 1
+    return n
+
+
+def node_affinity(kube_pod: dict, facts: NodeFacts) -> float:
+    """Sum of matched preferredDuringSchedulingIgnoredDuringExecution
+    weights, normalized to 0..10 (`node_affinity.go`)."""
+    from kubegpu_tpu.scheduler.predicates import node_selector_term_matches
+
+    affinity = ((kube_pod.get("spec") or {}).get("affinity") or {}) \
+        .get("nodeAffinity") or {}
+    preferred = affinity.get(
+        "preferredDuringSchedulingIgnoredDuringExecution") or []
+    if not preferred:
+        return 0.0
+    total = sum(int(t.get("weight") or 0) for t in preferred)
+    if total <= 0:
+        return 0.0
+    matched = sum(
+        int(t.get("weight") or 0) for t in preferred
+        if node_selector_term_matches(facts.labels, t.get("preference") or {}))
+    return matched / total * MAX_PRIORITY
+
+
+def taint_toleration(kube_pod: dict, facts: NodeFacts) -> float:
+    """10 minus a point per intolerable PreferNoSchedule taint
+    (`taint_toleration.go`, simplified from the normalize pass)."""
+    from kubegpu_tpu.scheduler.predicates import _toleration_tolerates
+
+    taints = (facts.kube_node.get("spec") or {}).get("taints") or []
+    tolerations = (kube_pod.get("spec") or {}).get("tolerations") or []
+    intolerable = sum(
+        1 for taint in taints
+        if taint.get("effect") == "PreferNoSchedule"
+        and not any(_toleration_tolerates(t, taint) for t in tolerations))
+    return max(MAX_PRIORITY - intolerable, 0.0)
+
+
+def node_prefer_avoid_pods(kube_pod: dict, facts: NodeFacts) -> float:
+    """Node annotation `scheduler.alpha.kubernetes.io/preferAvoidPods`
+    vetoes controller-owned pods (`node_prefer_avoid_pods.go`): 0 when the
+    pod's controller is listed, 10 otherwise."""
+    ann = ((facts.kube_node.get("metadata") or {}).get("annotations") or {}) \
+        .get("scheduler.alpha.kubernetes.io/preferAvoidPods")
+    if not ann:
+        return MAX_PRIORITY
+    owner = next(iter((kube_pod.get("metadata") or {})
+                      .get("ownerReferences") or []), None)
+    if owner is None:
+        return MAX_PRIORITY
+    try:
+        avoid = json.loads(ann)
+    except (TypeError, ValueError):
+        return MAX_PRIORITY
+    for entry in avoid.get("preferAvoidPods") or []:
+        sig = (entry.get("podSignature") or {}).get("podController") or {}
+        if (sig.get("kind") == owner.get("kind")
+                and sig.get("name") == owner.get("name")):
+            return 0.0
+    return MAX_PRIORITY
+
+
+def combine(per_function: dict, weights: dict | None = None) -> float:
+    """Weighted sum over priority scores (`generic_scheduler.go:526-...`)."""
+    weights = weights or DEFAULT_WEIGHTS
+    return sum(per_function.get(name, 0.0) * w
+               for name, w in weights.items())

@@ -102,7 +102,7 @@ def test_gang_all_or_nothing_when_no_room():
         assert api.get_pod(n)["spec"].get("nodeName") is None
     # no chips leaked
     snap = sched.cache.snapshot_node("host0")
-    assert all(v == 0 for v in snap[0].used.values())
+    assert all(v == 0 for v in snap.node_ex.used.values())
 
 
 def test_gang_retries_after_capacity_frees():
@@ -154,7 +154,7 @@ def test_gang_bind_failure_is_atomic():
     assert api.get_pod("g-0")["spec"].get("nodeName") is None
     for host in hosts:
         snap = sched.cache.snapshot_node(host)
-        assert all(v == 0 for v in snap[0].used.values()), host
+        assert all(v == 0 for v in snap.node_ex.used.values()), host
 
 
 def test_gang_respects_hbm_floor():
@@ -180,7 +180,7 @@ def test_gang_respects_hbm_floor():
         assert api.get_pod(n)["spec"].get("nodeName") is None, n
     for host in hosts:
         snap = sched.cache.snapshot_node(host)
-        assert all(v == 0 for v in snap[0].used.values()), host
+        assert all(v == 0 for v in snap.node_ex.used.values()), host
 
     # a feasible HBM floor still binds
     api.create_pod(hbm_gang_pod("ok-0", 6, V5P_HBM))
@@ -210,7 +210,7 @@ def test_gang_pod_multi_container_chips_split():
     assert len(chips_a) == 1 and len(chips_b) == 1
     assert chips_a.isdisjoint(chips_b)
     snap = sched.cache.snapshot_node("host0")
-    assert all(v <= 1 for v in snap[0].used.values())
+    assert all(v <= 1 for v in snap.node_ex.used.values())
 
 
 def test_gang_uses_torus_wrap_links():

@@ -1,0 +1,475 @@
+"""Stock predicate/priority suite, equivalence cache, and extender tests.
+
+Mirrors the reference's table-driven upstream tests
+(`kube-scheduler/pkg/algorithm/predicates/predicates_test.go`,
+`priorities/*_test.go`, `core/equivalence_cache.go`, `core/extender_test.go`)
+at the scale this engine carries them.
+"""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.scheduler import predicates, priorities
+from kubegpu_tpu.scheduler.equivalence import EquivalenceCache, equivalence_class
+from kubegpu_tpu.scheduler.extender import HTTPExtender
+
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+
+
+# ---- predicates ------------------------------------------------------------
+
+
+def _pod(spec=None, labels=None):
+    return {"metadata": {"name": "p", "labels": labels or {}},
+            "spec": spec or {}}
+
+
+def _node(name="n0", labels=None, taints=None, conditions=None,
+          unschedulable=False):
+    node = {"metadata": {"name": name, "labels": labels or {}},
+            "spec": {}, "status": {}}
+    if taints:
+        node["spec"]["taints"] = taints
+    if unschedulable:
+        node["spec"]["unschedulable"] = True
+    if conditions:
+        node["status"]["conditions"] = conditions
+    return node
+
+
+def test_pod_fits_host():
+    ok, _ = predicates.pod_fits_host(_pod({"nodeName": "n0"}), _node("n0"))
+    assert ok
+    ok, reasons = predicates.pod_fits_host(_pod({"nodeName": "other"}), _node("n0"))
+    assert not ok and "hostname" in reasons[0]
+    ok, _ = predicates.pod_fits_host(_pod({}), _node("n0"))
+    assert ok
+
+
+@pytest.mark.parametrize("selector,labels,fits", [
+    ({"zone": "a"}, {"zone": "a"}, True),
+    ({"zone": "a"}, {"zone": "b"}, False),
+    ({"zone": "a"}, {}, False),
+    ({}, {}, True),
+])
+def test_node_selector(selector, labels, fits):
+    ok, _ = predicates.pod_matches_node_selector(
+        _pod({"nodeSelector": selector}), _node(labels=labels))
+    assert ok == fits
+
+
+@pytest.mark.parametrize("op,values,labels,fits", [
+    ("In", ["a", "b"], {"zone": "a"}, True),
+    ("In", ["a", "b"], {"zone": "c"}, False),
+    ("NotIn", ["a"], {"zone": "b"}, True),
+    ("NotIn", ["a"], {"zone": "a"}, False),
+    ("Exists", [], {"zone": "x"}, True),
+    ("Exists", [], {}, False),
+    ("DoesNotExist", [], {}, True),
+    ("DoesNotExist", [], {"zone": "x"}, False),
+    ("Gt", ["5"], {"zone": "7"}, True),
+    ("Gt", ["5"], {"zone": "3"}, False),
+    ("Lt", ["5"], {"zone": "3"}, True),
+])
+def test_required_node_affinity_operators(op, values, labels, fits):
+    pod = _pod({"affinity": {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [
+                {"key": "zone", "operator": op, "values": values}]}]}}}})
+    ok, _ = predicates.pod_matches_node_selector(pod, _node(labels=labels))
+    assert ok == fits
+
+
+def test_affinity_terms_are_ored():
+    pod = _pod({"affinity": {"nodeAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["a"]}]},
+                {"matchExpressions": [
+                    {"key": "zone", "operator": "In", "values": ["b"]}]},
+            ]}}}})
+    ok, _ = predicates.pod_matches_node_selector(pod, _node(labels={"zone": "b"}))
+    assert ok
+
+
+def test_host_ports_conflicts():
+    pod = _pod({"containers": [
+        {"ports": [{"hostPort": 80}, {"hostPort": 443}]}]})
+    ok, _ = predicates.pod_fits_host_ports(pod, set())
+    assert ok
+    ok, reasons = predicates.pod_fits_host_ports(
+        pod, {("TCP", "0.0.0.0", 80)})
+    assert not ok and "80" in reasons[0]
+    # same port, different protocol: no conflict
+    ok, _ = predicates.pod_fits_host_ports(pod, {("UDP", "0.0.0.0", 80)})
+    assert ok
+    # wildcard IP conflicts with a specific IP
+    ok, _ = predicates.pod_fits_host_ports(pod, {("TCP", "10.0.0.1", 80)})
+    assert not ok
+
+
+@pytest.mark.parametrize("tolerations,fits", [
+    ([], False),
+    ([{"key": "tpu", "operator": "Equal", "value": "dedicated",
+       "effect": "NoSchedule"}], True),
+    ([{"key": "tpu", "operator": "Exists"}], True),
+    ([{"operator": "Exists"}], True),  # empty key + Exists tolerates all
+    ([{"key": "other", "operator": "Exists"}], False),
+])
+def test_taints_and_tolerations(tolerations, fits):
+    node = _node(taints=[{"key": "tpu", "value": "dedicated",
+                          "effect": "NoSchedule"}])
+    ok, _ = predicates.pod_tolerates_node_taints(
+        _pod({"tolerations": tolerations}), node)
+    assert ok == fits
+
+
+def test_prefer_no_schedule_taint_is_not_a_predicate():
+    node = _node(taints=[{"key": "tpu", "value": "x",
+                          "effect": "PreferNoSchedule"}])
+    ok, _ = predicates.pod_tolerates_node_taints(_pod({}), node)
+    assert ok
+
+
+def test_node_conditions():
+    ok, _ = predicates.check_node_condition(_pod(), _node())
+    assert ok
+    ok, r = predicates.check_node_condition(
+        _pod(), _node(conditions=[{"type": "Ready", "status": "False"}]))
+    assert not ok and "not ready" in r[0]
+    ok, r = predicates.check_node_condition(
+        _pod(), _node(conditions=[{"type": "MemoryPressure", "status": "True"}]))
+    assert not ok
+    ok, r = predicates.check_node_condition(_pod(), _node(unschedulable=True))
+    assert not ok and "unschedulable" in r[0]
+
+
+def test_core_requests_init_max_not_sum():
+    pod = {"spec": {
+        "containers": [
+            {"resources": {"requests": {"cpu": "2"}}},
+            {"resources": {"requests": {"cpu": "1"}}}],
+        "initContainers": [{"resources": {"requests": {"cpu": "5"}}}],
+    }}
+    # effective cpu = max(sum(running)=3, max(init)=5) = 5
+    assert predicates.pod_core_requests(pod)["cpu"] == 5
+
+
+# ---- priorities ------------------------------------------------------------
+
+
+def _facts(cpu_cap=10, mem_cap=100, cpu_used=0, mem_used=0,
+           labels=None, taints=None, pod_labels=None, annotations=None):
+    node = {"metadata": {"name": "n", "labels": labels or {},
+                         "annotations": annotations or {}},
+            "spec": {"taints": taints or []}, "status": {}}
+    return priorities.NodeFacts(
+        node, {"cpu": cpu_cap, "memory": mem_cap},
+        {"cpu": cpu_used, "memory": mem_used}, pod_labels or {})
+
+
+def test_least_requested_prefers_idle():
+    idle = priorities.least_requested({"cpu": 1, "memory": 10}, _facts())
+    busy = priorities.least_requested(
+        {"cpu": 1, "memory": 10}, _facts(cpu_used=8, mem_used=80))
+    assert idle > busy
+    assert 0.0 <= busy <= idle <= priorities.MAX_PRIORITY
+
+
+def test_balanced_allocation_penalizes_lopsided():
+    balanced = priorities.balanced_allocation(
+        {"cpu": 5, "memory": 50}, _facts())   # 50% vs 50%
+    lopsided = priorities.balanced_allocation(
+        {"cpu": 9, "memory": 10}, _facts())   # 90% vs 10%
+    assert balanced == pytest.approx(priorities.MAX_PRIORITY)
+    assert lopsided < balanced
+
+
+def test_selector_spreading():
+    pod = {"metadata": {"name": "web-2", "labels": {"app": "web"}}, "spec": {}}
+    crowded = _facts(pod_labels={"web-0": {"app": "web"},
+                                 "web-1": {"app": "web"}})
+    empty = _facts(pod_labels={"db-0": {"app": "db"}})
+    max_same = 2
+    assert priorities.selector_spreading(pod, empty, max_same) > \
+        priorities.selector_spreading(pod, crowded, max_same)
+
+
+def test_preferred_node_affinity_weights():
+    pod = {"metadata": {"name": "p"}, "spec": {"affinity": {"nodeAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 80, "preference": {"matchExpressions": [
+                {"key": "zone", "operator": "In", "values": ["a"]}]}},
+            {"weight": 20, "preference": {"matchExpressions": [
+                {"key": "disk", "operator": "In", "values": ["ssd"]}]}},
+        ]}}}}
+    full = priorities.node_affinity(pod, _facts(labels={"zone": "a", "disk": "ssd"}))
+    partial = priorities.node_affinity(pod, _facts(labels={"zone": "a"}))
+    none = priorities.node_affinity(pod, _facts(labels={}))
+    assert full == pytest.approx(10.0)
+    assert partial == pytest.approx(8.0)
+    assert none == 0.0
+
+
+def test_taint_toleration_priority():
+    taints = [{"key": "t1", "effect": "PreferNoSchedule"},
+              {"key": "t2", "effect": "PreferNoSchedule"}]
+    pod_plain = {"metadata": {"name": "p"}, "spec": {}}
+    pod_tol = {"metadata": {"name": "p"}, "spec": {"tolerations": [
+        {"key": "t1", "operator": "Exists"},
+        {"key": "t2", "operator": "Exists"}]}}
+    assert priorities.taint_toleration(pod_plain, _facts(taints=taints)) == 8.0
+    assert priorities.taint_toleration(pod_tol, _facts(taints=taints)) == 10.0
+
+
+def test_node_prefer_avoid_pods():
+    avoid = json.dumps({"preferAvoidPods": [
+        {"podSignature": {"podController": {"kind": "ReplicaSet",
+                                            "name": "web"}}}]})
+    facts = _facts(annotations={
+        "scheduler.alpha.kubernetes.io/preferAvoidPods": avoid})
+    owned = {"metadata": {"name": "p", "ownerReferences": [
+        {"kind": "ReplicaSet", "name": "web", "uid": "u1"}]}, "spec": {}}
+    other = {"metadata": {"name": "p", "ownerReferences": [
+        {"kind": "ReplicaSet", "name": "db", "uid": "u2"}]}, "spec": {}}
+    assert priorities.node_prefer_avoid_pods(owned, facts) == 0.0
+    assert priorities.node_prefer_avoid_pods(other, facts) == 10.0
+
+
+# ---- equivalence cache ------------------------------------------------------
+
+
+def test_equivalence_class_identity():
+    a = tpu_pod("a", 2)
+    b = tpu_pod("b", 2)
+    c = tpu_pod("c", 3)
+    assert equivalence_class(a) == equivalence_class(b)
+    assert equivalence_class(a) != equivalence_class(c)
+
+
+def test_equivalence_class_owner_wins():
+    a = tpu_pod("a", 2)
+    a["metadata"]["ownerReferences"] = [{"kind": "Job", "name": "j", "uid": "U"}]
+    b = tpu_pod("b", 3)  # different requests but same controller
+    b["metadata"]["ownerReferences"] = [{"kind": "Job", "name": "j", "uid": "U"}]
+    assert equivalence_class(a) == equivalence_class(b) == "owner:U"
+
+
+def test_equivalence_cache_hit_and_invalidate():
+    eq = EquivalenceCache()
+    eq.store("n0", "cls", (True, [], 0.5))
+    assert eq.lookup("n0", "cls") == (True, [], 0.5)
+    assert eq.hits == 1
+    eq.invalidate_node("n0")
+    assert eq.lookup("n0", "cls") is None
+
+
+def test_scheduler_uses_equivalence_cache():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=8))
+    api.create_node(flat_tpu_node("host1", chips=8))
+    sched = make_scheduler(api)
+    for i in range(4):
+        api.create_pod(tpu_pod(f"p{i}", 1))
+    sched.run_until_idle()
+    assert all((api.get_pod(f"p{i}").get("spec") or {}).get("nodeName")
+               for i in range(4))
+    # identical pods against 2 nodes: the memoized fit pass must have hit
+    assert sched.cache.equivalence.hits > 0
+
+
+# ---- engine integration -----------------------------------------------------
+
+
+def test_scheduler_respects_node_selector():
+    api = InMemoryAPIServer()
+    n0 = flat_tpu_node("host0", chips=4)
+    n1 = flat_tpu_node("host1", chips=4)
+    n1["metadata"]["labels"] = {"pool": "tpu-a"}
+    api.create_node(n0)
+    api.create_node(n1)
+    sched = make_scheduler(api)
+    pod = tpu_pod("picky", 2)
+    pod["spec"]["nodeSelector"] = {"pool": "tpu-a"}
+    api.create_pod(pod)
+    sched.run_until_idle()
+    assert api.get_pod("picky")["spec"]["nodeName"] == "host1"
+
+
+def test_scheduler_respects_taints():
+    api = InMemoryAPIServer()
+    n0 = flat_tpu_node("host0", chips=4)
+    n0["spec"] = {"taints": [{"key": "dedicated", "value": "infra",
+                              "effect": "NoSchedule"}]}
+    n1 = flat_tpu_node("host1", chips=4)
+    api.create_node(n0)
+    api.create_node(n1)
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("plain", 1))
+    sched.run_until_idle()
+    assert api.get_pod("plain")["spec"]["nodeName"] == "host1"
+
+
+def test_scheduler_respects_host_ports():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=8, cpu="64"))
+    api.create_node(flat_tpu_node("host1", chips=8, cpu="64"))
+    sched = make_scheduler(api)
+    for name in ("srv-a", "srv-b"):
+        pod = tpu_pod(name, 1)
+        pod["spec"]["containers"][0]["ports"] = [{"hostPort": 9000}]
+        api.create_pod(pod)
+    sched.run_until_idle()
+    hosts = {api.get_pod(n)["spec"]["nodeName"] for n in ("srv-a", "srv-b")}
+    assert len(hosts) == 2  # port conflict forces different hosts
+
+
+def test_scheduler_spreads_same_labeled_pods():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=8, cpu="64"))
+    api.create_node(flat_tpu_node("host1", chips=8, cpu="64"))
+    sched = make_scheduler(api)
+    for i in range(4):
+        pod = tpu_pod(f"web-{i}", 1)
+        pod["metadata"]["labels"] = {"app": "web"}
+        api.create_pod(pod)
+    sched.run_until_idle()
+    hosts = [api.get_pod(f"web-{i}")["spec"]["nodeName"] for i in range(4)]
+    assert sorted(hosts.count(h) for h in set(hosts)) == [2, 2]
+
+
+# ---- extender ---------------------------------------------------------------
+
+
+class _ExtenderHandler(http.server.BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        if self.path.endswith("/filter"):
+            survivors = [n for n in body["nodeNames"] if n != "host0"]
+            out = {"nodeNames": survivors,
+                   "failedNodes": {"host0": "extender says no"}}
+        else:
+            out = [{"host": n, "score": 10 if n == "host1" else 0}
+                   for n in body["nodeNames"]]
+        blob = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def extender_server():
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _ExtenderHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_extender_filter_and_prioritize(extender_server):
+    ext = HTTPExtender(extender_server, filter_verb="filter",
+                       prioritize_verb="prioritize", weight=2.0)
+    survivors, failed = ext.filter({"metadata": {"name": "p"}},
+                                   ["host0", "host1"])
+    assert survivors == ["host1"] and "host0" in failed
+    scores = ext.prioritize({"metadata": {"name": "p"}}, ["host1"])
+    assert scores == {"host1": 20.0}
+
+
+def test_extender_in_engine(extender_server):
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=8))
+    api.create_node(flat_tpu_node("host1", chips=8))
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    ext = HTTPExtender(extender_server, filter_verb="filter")
+    sched = Scheduler(api, ds, extenders=[ext])
+    api.create_pod(tpu_pod("p", 1))
+    sched.run_until_idle()
+    assert api.get_pod("p")["spec"]["nodeName"] == "host1"
+
+
+def test_ignorable_extender_failure_is_soft():
+    ext = HTTPExtender("http://127.0.0.1:1", filter_verb="filter",
+                       ignorable=True, timeout_s=0.2)
+    survivors, failed = ext.filter({"metadata": {"name": "p"}}, ["a", "b"])
+    assert survivors == ["a", "b"] and failed == {}
+
+
+# ---- review-fix regressions -------------------------------------------------
+
+
+def test_charge_matches_predicate_semantics():
+    """Init-container max-not-sum: admission and cache accounting agree, so
+    two pods whose effective request fits both land."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=8, cpu="8"))
+    sched = make_scheduler(api)
+    for name in ("a", "b"):
+        pod = tpu_pod(name, 1, cpu="4")
+        pod["spec"]["initContainers"] = [
+            {"name": "init", "resources": {"requests": {"cpu": "4"}}}]
+        api.create_pod(pod)
+    sched.run_until_idle()
+    # effective cpu per pod = max(4, 4) = 4; both fit on cpu=8
+    assert api.get_pod("a")["spec"].get("nodeName") == "host0"
+    assert api.get_pod("b")["spec"].get("nodeName") == "host0"
+
+
+def test_port_refcount_survives_one_removal():
+    from kubegpu_tpu.scheduler.cache import SchedulerCache
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    cache = SchedulerCache(ds)
+    cache.set_node(flat_tpu_node("host0", chips=8))
+
+    def port_pod(name):
+        pod = tpu_pod(name, 1)
+        pod["spec"]["containers"][0]["ports"] = [{"hostPort": 9100}]
+        return pod
+
+    # two externally-bound pods share the triple (predicates bypassed)
+    cache.add_pod(port_pod("x"), "host0")
+    cache.add_pod(port_pod("y"), "host0")
+    cache.remove_pod(port_pod("x"), "host0")
+    snap = cache.snapshot_node("host0")
+    assert ("TCP", "0.0.0.0", 9100) in snap.used_ports  # y still holds it
+    cache.remove_pod(port_pod("y"), "host0")
+    assert not cache.snapshot_node("host0").used_ports
+
+
+def test_equivalence_store_dropped_on_stale_generation():
+    eq = EquivalenceCache()
+    gen = eq.generation("n0")
+    eq.invalidate_node("n0")  # concurrent charge happened mid-computation
+    eq.store("n0", "cls", (True, [], 1.0), gen)
+    assert eq.lookup("n0", "cls") is None  # stale result was not stored
+    gen = eq.generation("n0")
+    eq.store("n0", "cls", (True, [], 1.0), gen)
+    assert eq.lookup("n0", "cls") == (True, [], 1.0)
+
+
+def test_equivalence_cache_bounded():
+    from kubegpu_tpu.scheduler.equivalence import MAX_CLASSES_PER_NODE
+
+    eq = EquivalenceCache()
+    for i in range(MAX_CLASSES_PER_NODE + 10):
+        eq.store("n0", f"cls{i}", (True, [], 0.0))
+    assert len(eq._by_node["n0"]) <= MAX_CLASSES_PER_NODE
