@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the workload layer's hot ops."""
+
+from kubegpu_tpu.workload.kernels.flash import flash_attention
+
+__all__ = ["flash_attention"]

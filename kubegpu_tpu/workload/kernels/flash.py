@@ -1,0 +1,382 @@
+"""Fused causal (flash) attention as a Pallas TPU kernel.
+
+The workload layer's hottest op. XLA's fused attention is good; this kernel
+keeps the whole online-softmax loop in VMEM with f32 accumulators and never
+materializes the [T, T] score matrix in HBM — the standard flash recurrence
+tiled to the MXU:
+
+- grid ``(B, H, q_blocks, k_blocks)``; the last grid dimension runs
+  sequentially on a TensorCore, so per-q-block accumulators (``acc``, ``m``,
+  ``l``) live in VMEM scratch across k-steps and the output is written once
+  on the final k-step;
+- fully-masked causal blocks are skipped (`pl.when`), halving work for the
+  causal case;
+- backward is the standard two-kernel flash backward (dq swept over k blocks,
+  dk/dv swept over q blocks) off saved ``(o, lse)`` residuals — no [T, T]
+  matrix in the backward either;
+- ``q_offset``/``kv_offset`` place the local blocks at *global* sequence
+  positions so the same kernel serves ring attention's rotating K/V blocks
+  (`kubegpu_tpu.workload.ring`), where offsets are traced values derived
+  from `lax.axis_index`.
+
+The reference schedules accelerator jobs but has no compute path at all
+(SURVEY.md §0); this kernel exists because the TPU build ships the workload
+layer too. Numerics match `model._causal_attention` to float tolerance
+(tests/test_kernels.py, interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128  # TPU lane width; m/l scratch carries the row stat in every lane
+
+
+@dataclass(frozen=True)
+class _Cfg:
+    """Static kernel configuration (hashable: rides in nondiff_argnums)."""
+
+    scale: float
+    causal: bool
+    block_q: int
+    block_k: int
+    interpret: bool
+
+
+def _pick_block(t: int, cap: int = 128) -> int:
+    for b in (cap, 64, 32, 16, 8):
+        if b <= t and t % b == 0:
+            return b
+    return t
+
+
+def _pos(off_ref, which: int, block_i: int, block: int, shape, axis: int):
+    """Global positions for a q (axis 0) / kv (axis 1) block as a 2-D iota."""
+    base = off_ref[0, which] + block_i * block
+    return base + lax.broadcasted_iota(jnp.int32, shape, axis)
+
+
+def _block_visible(cfg: _Cfg, off_ref, qi, ki):
+    """False iff the causal mask hides the whole (qi, ki) tile."""
+    if not cfg.causal:
+        return True
+    q_max = off_ref[0, 0] + (qi + 1) * cfg.block_q - 1
+    kv_min = off_ref[0, 1] + ki * cfg.block_k
+    return q_max >= kv_min
+
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, cfg: _Cfg, num_k: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(_block_visible(cfg, off_ref, qi, ki))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * cfg.scale
+        if cfg.causal:
+            shp = (cfg.block_q, cfg.block_k)
+            mask = (_pos(off_ref, 0, qi, cfg.block_q, shp, 0)
+                    >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev,
+                            jnp.broadcast_to(jnp.max(s, 1, keepdims=True),
+                                             m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_ref[...] = l_ref[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p, 1, keepdims=True), m_prev.shape)
+        m_ref[...] = m_new
+        pv = lax.dot_general(p, v_ref[0, 0].astype(jnp.float32),
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        l = l_ref[...][:, :1]
+        o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # lse = m + log(l), lane-broadcast (TPU wants a 128-lane minor dim);
+        # -inf rows (nothing visible) stay hugely negative.
+        lse_ref[0, 0] = m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-30))
+
+
+def _fwd(cfg: _Cfg, offsets, q, k, v):
+    """q,k,v: [B,H,T,D] → (o [B,H,Tq,D], lse [B,H,Tq,LANES] lane-broadcast)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    num_q, num_k = tq // cfg.block_q, tk // cfg.block_k
+    grid = (b, h, num_q, num_k)
+
+    def qmap(bi, hi, qi, ki):
+        return (bi, hi, qi, 0)
+
+    def kmap(bi, hi, qi, ki):
+        return (bi, hi, ki, 0)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, cfg=cfg, num_k=num_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, cfg.block_q, d), qmap),
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap),
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, cfg.block_q, d), qmap),
+            pl.BlockSpec((1, 1, cfg.block_q, LANES), qmap),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_q, d), jnp.float32),
+            pltpu.VMEM((cfg.block_q, LANES), jnp.float32),
+            pltpu.VMEM((cfg.block_q, LANES), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(offsets, q, k, v)
+    return o, lse
+
+
+def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, cfg: _Cfg, num_k: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(_block_visible(cfg, off_ref, qi, ki))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * cfg.scale
+        if cfg.causal:
+            shp = (cfg.block_q, cfg.block_k)
+            mask = (_pos(off_ref, 0, qi, cfg.block_q, shp, 0)
+                    >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        do = do_ref[0, 0].astype(jnp.float32)
+        dp = lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        dq_acc[...] += cfg.scale * lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, cfg: _Cfg, num_q: int):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(_block_visible(cfg, off_ref, qi, ki))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * cfg.scale
+        if cfg.causal:
+            shp = (cfg.block_q, cfg.block_k)
+            mask = (_pos(off_ref, 0, qi, cfg.block_q, shp, 0)
+                    >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0][:, :1])
+        do = do_ref[0, 0].astype(jnp.float32)
+        dv_acc[...] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0][:, :1])
+        dk_acc[...] += cfg.scale * lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(cfg: _Cfg, offsets, q, k, v, o, lse, do, dlse):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    num_q, num_k = tq // cfg.block_q, tk // cfg.block_k
+
+    # delta_i = rowsum(dO_i * O_i): tiny elementwise pass, XLA fuses it;
+    # lane-broadcast like lse so the kernels read a (block_q, LANES) tile.
+    # An lse cotangent folds in exactly here: dS = P∘(dP - delta) + dlse∘P
+    # = P∘(dP - (delta - dlse)) — ring attention's partial-merge weights
+    # differentiate through lse, so this term is load-bearing there.
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True), (b, h, tq, LANES))
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+
+    def qmap(bi, hi, qi, ki):
+        return (bi, hi, qi, 0)
+
+    def kmap(bi, hi, qi, ki):
+        return (bi, hi, ki, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, cfg=cfg, num_k=num_k),
+        grid=(b, h, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, cfg.block_q, d), qmap),
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap),
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap),
+            pl.BlockSpec((1, 1, cfg.block_q, d), qmap),
+            pl.BlockSpec((1, 1, cfg.block_q, LANES), qmap),
+            pl.BlockSpec((1, 1, cfg.block_q, LANES), qmap),
+        ],
+        out_specs=pl.BlockSpec((1, 1, cfg.block_q, d), qmap),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((cfg.block_q, d), jnp.float32)],
+        interpret=cfg.interpret,
+    )(offsets, q, k, v, do, lse, delta)
+
+    # dk/dv: sweep q blocks in the sequential (last) grid dimension.
+    def kmap2(bi, hi, ki, qi):
+        return (bi, hi, ki, 0)
+
+    def qmap2(bi, hi, ki, qi):
+        return (bi, hi, qi, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, cfg=cfg, num_q=num_q),
+        grid=(b, h, num_k, num_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, cfg.block_q, d), qmap2),
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap2),
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap2),
+            pl.BlockSpec((1, 1, cfg.block_q, d), qmap2),
+            pl.BlockSpec((1, 1, cfg.block_q, LANES), qmap2),
+            pl.BlockSpec((1, 1, cfg.block_q, LANES), qmap2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap2),
+            pl.BlockSpec((1, 1, cfg.block_k, d), kmap2),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+            pltpu.VMEM((cfg.block_k, d), jnp.float32),
+        ],
+        interpret=cfg.interpret,
+    )(offsets, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _Cfg, offsets, q, k, v):
+    return _fwd(cfg, offsets, q, k, v)
+
+
+def _flash_fwd(cfg: _Cfg, offsets, q, k, v):
+    o, lse = _fwd(cfg, offsets, q, k, v)
+    return (o, lse), (offsets, q, k, v, o, lse)
+
+
+def _flash_bwd(cfg: _Cfg, res, cts):
+    offsets, q, k, v, o, lse = res
+    do, dlse = cts
+    dq, dk, dv = _bwd(cfg, offsets, q, k, v, o, lse, do, dlse)
+    d_off = np.zeros(offsets.shape, jax.dtypes.float0)  # int primal
+    return d_off, dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_with_lse(q, k, v, scale, *, q_offset=0, kv_offset=0,
+                             causal=True, block_q=None, block_k=None,
+                             interpret=False):
+    """Flash attention returning ``(out, lse)``.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]. ``lse`` is [B, H, Tq] — the
+    log-sum-exp of each row's visible scores, which makes partial results
+    from disjoint K/V shards mergeable (`merge_partials`), the hook ring
+    attention uses. Offsets may be traced ints (global positions =
+    offset + local index).
+    """
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    cfg = _Cfg(scale=float(scale), causal=bool(causal),
+               block_q=block_q or _pick_block(tq),
+               block_k=block_k or _pick_block(tk),
+               interpret=bool(interpret))
+    if tq % cfg.block_q or tk % cfg.block_k:
+        raise ValueError(f"seq lens ({tq}, {tk}) not divisible by blocks "
+                         f"({cfg.block_q}, {cfg.block_k})")
+    offsets = jnp.stack(
+        [jnp.asarray(q_offset, jnp.int32),
+         jnp.asarray(kv_offset, jnp.int32)]).reshape(1, 2)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o, lse = _flash(cfg, offsets, qt, kt, vt)
+    return o.transpose(0, 2, 1, 3), lse[..., 0]
+
+
+def flash_attention(q, k, v, scale, **kw):
+    """Flash attention: [B, T, H, D] in, [B, T, H, D] out."""
+    return flash_attention_with_lse(q, k, v, scale, **kw)[0]
+
+
+def merge_partials(o1, lse1, o2, lse2):
+    """Combine attention over two disjoint K/V sets from their (o, lse)
+    partials: o = softmax-weighted mix, lse = log(exp(lse1)+exp(lse2)).
+    Associative — ring attention folds rotating blocks with it.
+    o: [B, T, H, D]; lse: [B, H, T]."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    lse = m + jnp.log(w1 + w2)
+    # [B,H,T] → [B,T,H,1] to weight [B,T,H,D]
+    def wgt(w):
+        return w.transpose(0, 2, 1)[..., None]
+
+    denom = wgt(w1 + w2)
+    o = (o1.astype(jnp.float32) * wgt(w1)
+         + o2.astype(jnp.float32) * wgt(w2)) / jnp.maximum(denom, 1e-30)
+    return o.astype(o1.dtype), lse

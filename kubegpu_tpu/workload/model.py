@@ -36,6 +36,10 @@ class TransformerConfig:
     max_seq: int = 1024
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"
+    # Attention implementation: "xla" = einsum+softmax (XLA fuses it),
+    # "flash" = Pallas flash kernel (kernels.flash), "auto" = flash on TPU
+    # backends when the sequence tiles cleanly, else xla.
+    attn_impl: str = "auto"
     # Mixture-of-experts FFN: 0 = dense; >0 replaces the FFN with top-1
     # routed experts sharded over the model axis (expert parallelism).
     n_experts: int = 0
@@ -118,6 +122,18 @@ def _causal_attention(q, k, v, scale: float):
     return out.astype(q.dtype)
 
 
+def _resolve_attn_impl(cfg: TransformerConfig, seq_len: int) -> str:
+    """Pick the attention implementation for a given local sequence length.
+
+    "auto" uses the Pallas flash kernel only on a TPU default backend and
+    only when the sequence tiles onto the MXU (multiple of 128); the CPU
+    interpret path exists for tests but is not worth it for real runs."""
+    if cfg.attn_impl != "auto":
+        return cfg.attn_impl
+    on_tpu = jax.default_backend() == "tpu"
+    return "flash" if on_tpu and seq_len % 128 == 0 else "xla"
+
+
 def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
     """Build ``forward(params, tokens) -> (logits, aux_loss)``.
 
@@ -127,11 +143,23 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
     MoE load-balancing term (0.0 for dense configs).
     """
     use_ring = mesh is not None and mesh.shape.get(spmd.AXIS_SEQ, 1) > 1
+    seq_shards = mesh.shape.get(spmd.AXIS_SEQ, 1) if mesh is not None else 1
     scale = cfg.head_dim ** -0.5
-    ring_fn = None
-    if use_ring:
-        ring_fn = make_sharded_ring_attention(
-            mesh, spmd.AXIS_DATA, spmd.AXIS_SEQ, spmd.AXIS_MODEL, scale)
+
+    def attention_fn(t: int):
+        """Resolve the attend callable once the sequence length is known."""
+        impl = _resolve_attn_impl(cfg, t // seq_shards)
+        interpret = impl == "flash" and jax.default_backend() == "cpu"
+        if use_ring:
+            return make_sharded_ring_attention(
+                mesh, spmd.AXIS_DATA, spmd.AXIS_SEQ, spmd.AXIS_MODEL, scale,
+                use_flash=impl == "flash", interpret=interpret)
+        if impl == "flash":
+            from kubegpu_tpu.workload.kernels.flash import flash_attention
+
+            return lambda q, k, v: flash_attention(
+                q, k, v, scale, interpret=interpret)
+        return lambda q, k, v: _causal_attention(q, k, v, scale)
 
     def constrain(x, *spec):
         if mesh is None:
@@ -148,6 +176,7 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
         x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
         positions = jnp.broadcast_to(jnp.arange(t), (b, t))
         aux_total = jnp.zeros((), jnp.float32)
+        attend = attention_fn(t)
 
         for layer in params["layers"]:
             h = _rmsnorm(x, layer["ln1"])
@@ -156,10 +185,7 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
             v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
-            if use_ring:
-                attn = ring_fn(q, k, v)
-            else:
-                attn = _causal_attention(q, k, v, scale)
+            attn = attend(q, k, v)
             x = x + attn.reshape(b, t, -1) @ layer["wo"].astype(dt)
             x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
 

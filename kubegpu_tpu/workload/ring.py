@@ -74,15 +74,62 @@ def ring_attention(q, k, v, axis_name: str, scale: float):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def ring_flash_attention(q, k, v, axis_name: str, scale: float,
+                         interpret: bool = False):
+    """Ring attention whose per-step block attend is the Pallas flash
+    kernel (`kernels.flash`): each rotating K/V block is attended with
+    global-position causal masking (offsets = shard indices × block len),
+    and the normalized partials merge by lse arithmetic. Same recurrence
+    as `ring_attention`, with the inner loop on the MXU via Pallas."""
+    from kubegpu_tpu.workload.kernels.flash import (
+        flash_attention_with_lse, merge_partials)
+
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def attend(acc, k_blk, v_blk, r):
+        o, lse = acc
+        src = (my_index - r) % axis_size
+        o_r, lse_r = flash_attention_with_lse(
+            q, k_blk, v_blk, scale, q_offset=my_index * t_local,
+            kv_offset=src * t_local, causal=True, interpret=interpret)
+        return merge_partials(o, lse, o_r, lse_r)
+
+    def step(carry, r):
+        o, lse, k_blk, v_blk = carry
+        o, lse = attend((o, lse), k_blk, v_blk, r)
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (o, lse, k_nxt, v_nxt), None
+
+    b, t, h, d = q.shape
+    # float32 accumulator across steps (merge_partials keeps the carry's
+    # dtype) — matches ring_attention's f32 carry; cast once at the end.
+    o0 = jnp.zeros((b, t, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, t), NEG_INF, jnp.float32)
+    (o, lse, k_last, v_last), _ = lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(max(0, axis_size - 1)))
+    o, _ = attend((o, lse), k_last, v_last, axis_size - 1)
+    return o.astype(q.dtype)
+
+
 def make_sharded_ring_attention(mesh, data_axis: str, seq_axis: str,
-                                model_axis: str, scale: float):
+                                model_axis: str, scale: float,
+                                use_flash: bool = False,
+                                interpret: bool = False):
     """shard_map wrapper: GSPMD handles the rest of the model; attention
-    drops to per-shard code so the ring's ppermutes are explicit."""
+    drops to per-shard code so the ring's ppermutes are explicit.
+    ``use_flash`` swaps the per-step attend onto the Pallas kernel."""
     from jax.sharding import PartitionSpec as P
 
     spec = P(data_axis, seq_axis, model_axis, None)
 
     def fn(q, k, v):
+        if use_flash:
+            return ring_flash_attention(q, k, v, seq_axis, scale,
+                                        interpret=interpret)
         return ring_attention(q, k, v, seq_axis, scale)
 
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
