@@ -12,7 +12,6 @@ cache entirely from node/pod annotations (SURVEY.md §6 checkpoint/resume).
 
 from __future__ import annotations
 
-import copy
 import threading
 import time
 
@@ -66,8 +65,36 @@ class NodeSnapshot:
         self.used_ports = cached.used_ports()
         self.pod_labels = {k: dict(v) for k, v in cached.pod_labels.items()}
         self.pod_names = set(cached.pod_names)
-        self.kube_node = copy.deepcopy(cached.kube_node)
+        self.kube_node = _slim_node_copy(cached.kube_node)
         self.core_allocatable = cached.core_allocatable()
+
+
+def _slim_node_copy(kube_node: dict) -> dict:
+    """Copy only what predicates/priorities read (labels, annotations,
+    taints, unschedulable, conditions, allocatable). The snapshot runs on
+    the per-pod-per-node hot path under the cache lock, so deep-copying
+    the whole node object — device-inventory annotation blob included —
+    would serialize the parallel fit workers; string values are shared,
+    containers are copied one level deep, which keeps the snapshot torn-
+    read-free (watchers replace the node dict wholesale, never mutate)."""
+    meta = kube_node.get("metadata") or {}
+    spec = kube_node.get("spec") or {}
+    status = kube_node.get("status") or {}
+    return {
+        "metadata": {
+            "name": meta.get("name"),
+            "labels": dict(meta.get("labels") or {}),
+            "annotations": dict(meta.get("annotations") or {}),
+        },
+        "spec": {
+            "taints": [dict(t) for t in (spec.get("taints") or [])],
+            "unschedulable": spec.get("unschedulable"),
+        },
+        "status": {
+            "conditions": [dict(c) for c in (status.get("conditions") or [])],
+            "allocatable": dict(status.get("allocatable") or {}),
+        },
+    }
 
 
 class SchedulerCache:

@@ -63,11 +63,14 @@ class GenericScheduler:
     # ---- predicates --------------------------------------------------------
 
     def _fits_on_node(self, kube_pod: dict, node_name: str,
-                      eq_class: str | None = None):
+                      eq_class: str | None = None,
+                      out_snaps: dict | None = None):
         """The full predicate chain against a point-in-time snapshot so
         concurrent watcher mutations of node usage cannot tear mid-fit.
         Order mirrors the reference providers: cheap node gates first, the
-        device predicate (`devicepredicate.go:11-26`) last."""
+        device predicate (`devicepredicate.go:11-26`) last. A snapshot
+        taken here is stashed in ``out_snaps`` so the scoring pass can
+        reuse it instead of re-snapshotting."""
         if eq_class is not None:
             hit = self.cache.equivalence.lookup(node_name, eq_class)
             if hit is not None:
@@ -79,6 +82,8 @@ class GenericScheduler:
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
+        if out_snaps is not None:
+            out_snaps[node_name] = snap
         result = self._run_predicates(kube_pod, snap)
         if eq_class is not None:
             self.cache.equivalence.store(node_name, eq_class, result, gen)
@@ -109,8 +114,10 @@ class GenericScheduler:
         memoized per equivalence class, then extender callouts."""
         names = self.cache.node_names()
         eq_class = equivalence_class(kube_pod)
+        snaps: dict = {}
         results = list(self._pool.map(
-            lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class)), names))
+            lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps)),
+            names))
         feasible = {n: score for n, ok, _, score in results if ok}
         failures = {n: reasons for n, ok, reasons, _ in results if not ok}
         for ext in self.extenders:
@@ -125,16 +132,20 @@ class GenericScheduler:
                 if name not in survivors:
                     feasible.pop(name)
                     failures[name] = ["extender refused"]
-        return feasible, failures
+        return feasible, failures, snaps
 
-    def prioritize_nodes(self, kube_pod: dict, feasible: dict) -> dict:
+    def prioritize_nodes(self, kube_pod: dict, feasible: dict,
+                         snaps: dict | None = None) -> dict:
         """Map-reduce the priority functions over feasible nodes
         (`generic_scheduler.go:526-...`): stock priorities + the device
-        score from the fit pass + extender scores, weighted-summed."""
+        score from the fit pass + extender scores, weighted-summed.
+        ``snaps`` reuses snapshots the fit pass already took; nodes the
+        equivalence cache short-circuited are snapshotted here."""
         pod_requests = _pod_core_requests(kube_pod)
+        snaps = snaps or {}
         facts: dict = {}
         for name in sorted(feasible):
-            snap = self.cache.snapshot_node(name)
+            snap = snaps.get(name) or self.cache.snapshot_node(name)
             if snap is not None:
                 facts[name] = priorities.NodeFacts(
                     snap.kube_node, snap.core_allocatable,
@@ -175,7 +186,7 @@ class GenericScheduler:
         pod_name = kube_pod["metadata"]["name"]
         trace = metrics.Trace(f"schedule {pod_name}")
         t0 = time.perf_counter()
-        feasible, failures = self.find_nodes_that_fit(kube_pod)
+        feasible, failures, snaps = self.find_nodes_that_fit(kube_pod)
         trace.step("computed predicates")
         if not feasible:
             trace.log_if_long()
@@ -183,7 +194,7 @@ class GenericScheduler:
         if len(feasible) == 1:
             host = next(iter(feasible))
         else:
-            scored = self.prioritize_nodes(kube_pod, feasible)
+            scored = self.prioritize_nodes(kube_pod, feasible, snaps)
             trace.step("prioritized")
             if not scored:  # every feasible node vanished mid-pass
                 trace.log_if_long()

@@ -88,10 +88,14 @@ class HTTPExtender:
         try:
             out = self._post(self.prioritize_verb,
                              {"pod": kube_pod, "nodeNames": node_names})
+            # Shape the reply inside the try: a malformed response (an
+            # error object, non-dict entries) is as non-fatal as a refused
+            # connection — scoring hiccups must never block placement.
+            return {entry["host"]: float(entry.get("score", 0)) * self.weight
+                    for entry in out if isinstance(entry, dict)
+                    and entry.get("host") in set(node_names)}
         except Exception:
             return {}  # prioritize errors are non-fatal upstream
-        return {entry["host"]: float(entry.get("score", 0)) * self.weight
-                for entry in out if entry.get("host") in set(node_names)}
 
 
 def load_extenders(config: dict) -> list:
