@@ -1,0 +1,321 @@
+"""Kubernetes API client: the same surface as `InMemoryAPIServer` /
+`APIClient`, spoken against a **real** Kubernetes API server.
+
+The reference's components talk to the cluster through client-go —
+`kubeinterface.PatchNodeMetadata` issues a strategic-merge patch on the
+Node (`kubeinterface/kubeinterface.go:145-158`), `UpdatePodMetadata`
+updates pod annotations before binding (`:160-193`), and the scheduler
+binds via the pods/binding subresource (`kube-scheduler/pkg/
+scheduler.go:405-417`). This module is that adapter for the TPU build,
+stdlib-only (urllib + ssl): every component (advertiser, scheduler,
+runtime hook) takes an ``api`` object, so swapping the in-memory /
+HTTP-control-plane server for a real cluster is just constructing
+``KubeAPIClient(KubeConfig.load(...))``.
+
+Wire grammar (the real one):
+
+- nodes:      ``/api/v1/nodes[/{name}]``
+- pods:       ``/api/v1/namespaces/{ns}/pods[/{name}]``
+- bind:       ``POST .../pods/{name}/binding`` with a v1 Binding
+- annotations: ``PATCH`` with ``application/strategic-merge-patch+json``
+- watches:    ``?watch=true&resourceVersion=N`` chunked JSON-lines
+
+Auth: bearer token or client-cert kubeconfig contexts, plus in-cluster
+(serviceaccount token + CA). Tests drive this against a mock API server
+speaking the identical grammar (tests/test_kubeclient.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+from kubegpu_tpu.cluster.apiserver import Conflict, NotFound
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+STRATEGIC_MERGE = "application/strategic-merge-patch+json"
+
+
+@dataclass
+class KubeConfig:
+    """Connection settings for one cluster/user pair."""
+
+    server: str
+    token: str | None = None
+    ca_file: str | None = None
+    client_cert: str | None = None
+    client_key: str | None = None
+    insecure: bool = False
+    namespace: str = "default"
+    extra_headers: dict = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str | None = None, context: str | None = None):
+        """Load from a kubeconfig file (``path`` or $KUBECONFIG or
+        ~/.kube/config), or fall back to in-cluster settings."""
+        path = path or os.environ.get("KUBECONFIG") or \
+            os.path.expanduser("~/.kube/config")
+        if os.path.exists(path):
+            return cls.from_kubeconfig(path, context)
+        return cls.in_cluster()
+
+    @classmethod
+    def from_kubeconfig(cls, path: str, context: str | None = None):
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+
+        def by_name(items, name):
+            for it in items or []:
+                if it.get("name") == name:
+                    return it.get(next(k for k in it if k != "name"), {})
+            raise ValueError(f"kubeconfig: no entry named {name!r}")
+
+        ctx_name = context or doc.get("current-context")
+        ctx = by_name(doc.get("contexts"), ctx_name)
+        cluster = by_name(doc.get("clusters"), ctx["cluster"])
+        user = by_name(doc.get("users"), ctx["user"]) if ctx.get("user") else {}
+        return cls(
+            server=cluster["server"].rstrip("/"),
+            token=user.get("token"),
+            ca_file=cluster.get("certificate-authority"),
+            client_cert=user.get("client-certificate"),
+            client_key=user.get("client-key"),
+            insecure=bool(cluster.get("insecure-skip-tls-verify")),
+            namespace=ctx.get("namespace", "default"),
+        )
+
+    @classmethod
+    def in_cluster(cls):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError("not running in a cluster (no kubeconfig "
+                               "file and KUBERNETES_SERVICE_HOST unset)")
+        token = None
+        token_path = os.path.join(SA_DIR, "token")
+        if os.path.exists(token_path):
+            with open(token_path) as f:
+                token = f.read().strip()
+        ns = "default"
+        ns_path = os.path.join(SA_DIR, "namespace")
+        if os.path.exists(ns_path):
+            with open(ns_path) as f:
+                ns = f.read().strip() or "default"
+        ca = os.path.join(SA_DIR, "ca.crt")
+        return cls(server=f"https://{host}:{port}", token=token,
+                   ca_file=ca if os.path.exists(ca) else None, namespace=ns)
+
+
+class KubeAPIClient:
+    """`InMemoryAPIServer`-shaped facade over the real Kubernetes REST API.
+
+    ``add_watcher`` starts informer threads (one per resource kind) that
+    stream ``?watch=true`` events and replay them as the in-process
+    ``(kind, event, obj)`` callbacks the scheduler/advertiser expect.
+    """
+
+    def __init__(self, config: KubeConfig, timeout: float = 30.0):
+        self.config = config
+        self.timeout = timeout
+        self.namespace = config.namespace
+        self._watchers: list = []
+        self._watch_threads: list = []
+        self._stop = threading.Event()
+        self._ssl = self._make_ssl_context()
+
+    def _make_ssl_context(self):
+        if not self.config.server.startswith("https"):
+            return None
+        if self.config.insecure:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        else:
+            ctx = ssl.create_default_context(cafile=self.config.ca_file)
+        if self.config.client_cert:
+            ctx.load_cert_chain(self.config.client_cert,
+                                self.config.client_key)
+        return ctx
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _headers(self, content_type: str = "application/json") -> dict:
+        h = {"Content-Type": content_type, "Accept": "application/json"}
+        if self.config.token:
+            h["Authorization"] = f"Bearer {self.config.token}"
+        h.update(self.config.extra_headers)
+        return h
+
+    def _req(self, method: str, path: str, body=None,
+             content_type: str = "application/json", timeout=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.config.server + path, data=data, method=method,
+            headers=self._headers(content_type))
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout,
+                    context=self._ssl) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            payload = e.read().decode()
+            if e.code == 404:
+                raise NotFound(payload)
+            if e.code == 409:
+                raise Conflict(payload)
+            raise RuntimeError(f"{method} {path} -> HTTP {e.code}: {payload}")
+
+    def _pod_path(self, name: str = "", sub: str = "") -> str:
+        base = f"/api/v1/namespaces/{self.namespace}/pods"
+        if name:
+            base += f"/{urllib.parse.quote(name)}"
+        if sub:
+            base += f"/{sub}"
+        return base
+
+    # -- nodes --------------------------------------------------------------
+
+    def create_node(self, node: dict) -> dict:
+        return self._req("POST", "/api/v1/nodes", node)
+
+    def get_node(self, name: str) -> dict:
+        return self._req("GET", f"/api/v1/nodes/{urllib.parse.quote(name)}")
+
+    def list_nodes(self) -> list:
+        return self._req("GET", "/api/v1/nodes").get("items") or []
+
+    def patch_node_metadata(self, name: str, metadata_patch: dict) -> dict:
+        """Strategic-merge patch of node metadata — the advertiser's write
+        path (`kubeinterface.go:145-158`)."""
+        return self._req(
+            "PATCH", f"/api/v1/nodes/{urllib.parse.quote(name)}",
+            {"metadata": metadata_patch}, content_type=STRATEGIC_MERGE)
+
+    def delete_node(self, name: str) -> None:
+        self._req("DELETE", f"/api/v1/nodes/{urllib.parse.quote(name)}")
+
+    # -- pods ---------------------------------------------------------------
+
+    def create_pod(self, pod: dict) -> dict:
+        return self._req("POST", self._pod_path(), pod)
+
+    def get_pod(self, name: str) -> dict:
+        return self._req("GET", self._pod_path(name))
+
+    def list_pods(self, node_name: str | None = None) -> list:
+        path = self._pod_path()
+        if node_name:
+            sel = urllib.parse.quote(f"spec.nodeName={node_name}")
+            path += f"?fieldSelector={sel}"
+        return self._req("GET", path).get("items") or []
+
+    def update_pod_annotations(self, name: str, annotations: dict) -> dict:
+        """Annotation-only strategic-merge patch — `UpdatePodMetadata`'s
+        contract (`kubeinterface.go:175-193`): never touches spec/status."""
+        return self._req(
+            "PATCH", self._pod_path(name),
+            {"metadata": {"annotations": annotations}},
+            content_type=STRATEGIC_MERGE)
+
+    def bind_pod(self, name: str, node_name: str) -> None:
+        """POST the v1 Binding subresource (`scheduler.go:405-417`)."""
+        self._req("POST", self._pod_path(name, "binding"), {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": name, "namespace": self.namespace},
+            "target": {"apiVersion": "v1", "kind": "Node",
+                       "name": node_name},
+        })
+
+    def bind_many(self, bindings: dict, annotations: dict) -> None:
+        """Gang commit against a real API server. Kubernetes has no atomic
+        multi-bind; this is annotate-everything-then-bind-everything, and a
+        partial failure raises with the already-bound members listed so the
+        caller can reconcile (the in-memory server's bind_many is the
+        atomic analogue used for single-process runs)."""
+        for name, ann in annotations.items():
+            self.update_pod_annotations(name, ann)
+        bound = []
+        try:
+            for name, node_name in sorted(bindings.items()):
+                self.bind_pod(name, node_name)
+                bound.append(name)
+        except Exception as e:
+            raise RuntimeError(
+                f"gang bind partially failed after binding {bound}: {e}"
+            ) from e
+
+    def delete_pod(self, name: str) -> None:
+        self._req("DELETE", self._pod_path(name))
+
+    # -- watches ------------------------------------------------------------
+
+    def add_watcher(self, fn) -> None:
+        """Register ``fn(kind, event, obj)``; the first registration spawns
+        watch threads for nodes and pods."""
+        self._watchers.append(fn)
+        if not self._watch_threads:
+            for kind, path in (
+                    ("node", "/api/v1/nodes"),
+                    ("pod", self._pod_path())):
+                t = threading.Thread(
+                    target=self._watch_loop, args=(kind, path), daemon=True,
+                    name=f"kubewatch-{kind}")
+                t.start()
+                self._watch_threads.append(t)
+
+    def _watch_loop(self, kind: str, path: str) -> None:
+        version = ""
+        while not self._stop.is_set():
+            try:
+                # (Re)list to get a resourceVersion, then stream from it.
+                if not version:
+                    listing = self._req("GET", path)
+                    version = (listing.get("metadata") or {}).get(
+                        "resourceVersion") or "0"
+                    for obj in listing.get("items") or []:
+                        self._dispatch(kind, "added", obj)
+                q = urllib.parse.urlencode(
+                    {"watch": "true", "resourceVersion": version})
+                req = urllib.request.Request(
+                    f"{self.config.server}{path}?{q}",
+                    headers=self._headers())
+                with urllib.request.urlopen(
+                        req, timeout=None, context=self._ssl) as resp:
+                    for line in resp:
+                        if self._stop.is_set():
+                            return
+                        line = line.strip()
+                        if not line:
+                            continue
+                        evt = json.loads(line.decode())
+                        obj = evt.get("object") or {}
+                        version = (obj.get("metadata") or {}).get(
+                            "resourceVersion") or version
+                        self._dispatch(
+                            kind, evt.get("type", "").lower(), obj)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                version = ""  # relist after a dropped watch
+                self._stop.wait(1.0)
+
+    def _dispatch(self, kind: str, event: str, obj: dict) -> None:
+        if event not in ("added", "modified", "deleted"):
+            return  # BOOKMARK / ERROR frames
+        for fn in list(self._watchers):
+            try:
+                fn(kind, event, obj)
+            except Exception:
+                pass  # a bad watcher must not kill the informer
+
+    def close(self) -> None:
+        self._stop.set()

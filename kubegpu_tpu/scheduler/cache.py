@@ -103,6 +103,7 @@ class SchedulerCache:
         self._lock = threading.RLock()
         self.nodes: dict = {}           # name -> CachedNode
         self._assumed: dict = {}        # pod name -> (node_name, deadline)
+        self._charged: set = set()      # pod names currently accounted
         self.equivalence = EquivalenceCache()
 
     # ---- nodes (`node_info.go:456-492`) ------------------------------------
@@ -128,7 +129,14 @@ class SchedulerCache:
 
     def remove_node(self, name: str) -> None:
         with self._lock:
-            if self.nodes.pop(name, None) is not None:
+            cached = self.nodes.pop(name, None)
+            if cached is not None:
+                # The node's usage died with its CachedNode; un-mark its
+                # pods so a node flap (delete + re-add + watch replay of
+                # the bound pods as ADDED) re-charges them against the
+                # fresh node instead of hitting the idempotency gate.
+                for pod_name in cached.pod_names:
+                    self._charged.discard(pod_name)
                 self.device_scheduler.remove_node(name)
                 self.equivalence.invalidate_node(name)
 
@@ -153,8 +161,22 @@ class SchedulerCache:
     # ---- pod lifecycle (`node_info.go:336-398`, `cache.go:40-81`) ----------
 
     def _charge(self, kube_pod: dict, node_name: str, take: bool) -> None:
+        # Idempotent per pod: an informer replaying a bound pod that
+        # _sync_existing already listed (or a duplicate delete) must not
+        # double-charge/double-return device usage — a real k8s watch
+        # always replays current objects as ADDED on (re)connect.
+        name = (kube_pod.get("metadata") or {}).get("name")
+        if take and name in self._charged:
+            return
+        if not take and name not in self._charged:
+            return
         cached = self.nodes.get(node_name)
         if cached is None:
+            # Node vanished: its usage is gone wholesale, but the pod must
+            # not stay marked charged or a later same-named pod would
+            # never be accounted anywhere.
+            if not take:
+                self._charged.discard(name)
             return
         try:
             pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
@@ -173,14 +195,15 @@ class SchedulerCache:
         for res, val in pod_core_requests(kube_pod).items():
             cached.requested_core[res] = \
                 cached.requested_core.get(res, 0) + sign * val
-        name = (kube_pod.get("metadata") or {}).get("name")
         if take:
             cached.pod_ports[name] = pod_host_ports(kube_pod)
             labels = (kube_pod.get("metadata") or {}).get("labels") or {}
             cached.pod_labels[name] = dict(labels)
+            self._charged.add(name)
         else:
             cached.pod_ports.pop(name, None)
             cached.pod_labels.pop(name, None)
+            self._charged.discard(name)
         self.equivalence.invalidate_node(node_name)
 
     def assume_pod(self, kube_pod: dict, node_name: str,

@@ -82,9 +82,11 @@ class GenericScheduler:
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
-        if out_snaps is not None:
-            out_snaps[node_name] = snap
         result = self._run_predicates(kube_pod, snap)
+        if out_snaps is not None and result[0]:
+            # Only feasible nodes are scored; don't pin snapshots of the
+            # (typically many) infeasible ones for the whole pass.
+            out_snaps[node_name] = snap
         if eq_class is not None:
             self.cache.equivalence.store(node_name, eq_class, result, gen)
         return result

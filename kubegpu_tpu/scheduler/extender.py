@@ -91,9 +91,10 @@ class HTTPExtender:
             # Shape the reply inside the try: a malformed response (an
             # error object, non-dict entries) is as non-fatal as a refused
             # connection — scoring hiccups must never block placement.
+            allowed = set(node_names)
             return {entry["host"]: float(entry.get("score", 0)) * self.weight
                     for entry in out if isinstance(entry, dict)
-                    and entry.get("host") in set(node_names)}
+                    and entry.get("host") in allowed}
         except Exception:
             return {}  # prioritize errors are non-fatal upstream
 

@@ -155,6 +155,43 @@ def test_cache_node_repatch_preserves_used():
     assert cache.get_node("host0").node_ex.used[f"{G}/tpu/dev0/chips"] == 1
 
 
+def test_cache_add_pod_idempotent_against_watch_replay():
+    """A real k8s informer replays bound pods as ADDED on (re)connect;
+    charging must happen exactly once."""
+    cache, _ = make_cache()
+    cache.set_node(flat_tpu_node())
+    pod = bound_pod_with_alloc("p", "dev0")
+    cache.add_pod(pod, "host0")
+    cache.add_pod(pod, "host0")  # replay
+    node = cache.get_node("host0")
+    assert node.node_ex.used[f"{G}/tpu/dev0/chips"] == 1
+    assert node.requested_core.get("cpu") == 1
+    cache.remove_pod(pod, "host0")
+    cache.remove_pod(pod, "host0")  # duplicate delete
+    assert node.node_ex.used[f"{G}/tpu/dev0/chips"] == 0
+    assert node.requested_core.get("cpu") == 0
+
+
+def test_cache_node_flap_recharges_replayed_pods():
+    """Node deleted + re-added (watch reconnect): the replayed bound pod
+    must be charged against the fresh node, not skipped by the
+    idempotency gate."""
+    cache, _ = make_cache()
+    cache.set_node(flat_tpu_node())
+    pod = bound_pod_with_alloc("p", "dev0")
+    cache.add_pod(pod, "host0")
+    cache.remove_node("host0")
+    cache.set_node(flat_tpu_node())
+    cache.add_pod(pod, "host0")  # informer replay after re-add
+    assert cache.get_node("host0").node_ex.used[f"{G}/tpu/dev0/chips"] == 1
+    # pod deleted while its node was gone: the mark must not stick forever
+    cache.remove_node("host0")
+    cache.remove_pod(pod, "host0")
+    cache.set_node(flat_tpu_node())
+    cache.add_pod(pod, "host0")
+    assert cache.get_node("host0").node_ex.used[f"{G}/tpu/dev0/chips"] == 1
+
+
 def test_cache_corrupt_pod_annotation_is_fatal():
     cache, _ = make_cache()
     cache.set_node(flat_tpu_node())
