@@ -26,14 +26,27 @@ LEASE_NAME = "kgtpu-scheduler"
 
 def build_scheduler(client, args, config: dict | None = None) -> Scheduler:
     from kubegpu_tpu.scheduler.extender import load_extenders
+    from kubegpu_tpu.scheduler.factory import algorithm_from_policy
 
     config = config or {}
     ds = DevicesScheduler()
     ds.add_device(TPUScheduler())
+    # A Policy document (`kube-scheduler/pkg/api/types.go`) recomposes the
+    # predicate/priority set by name; inline under "policy" or in its own
+    # file via "policyFile". Extenders declared inside the policy merge
+    # with top-level ones (upstream puts them in the policy).
+    policy = config.get("policy")
+    if policy is None and config.get("policyFile"):
+        policy = common.load_config(config["policyFile"])
+    algorithm = algorithm_from_policy(policy) if policy else None
+    extenders = load_extenders(config)
+    if policy and policy.get("extenders"):
+        extenders += load_extenders({"extenders": policy["extenders"]})
     sched = Scheduler(client, ds, bind_async=bool(args.bind_async),
                       parallelism=args.parallelism,
-                      extenders=load_extenders(config),
-                      priority_weights=config.get("priorityWeights"))
+                      extenders=extenders,
+                      priority_weights=config.get("priorityWeights"),
+                      algorithm=algorithm)
     sched.preemption_enabled = not args.disable_preemption
     return sched
 

@@ -17,8 +17,10 @@ import time
 
 from kubegpu_tpu.core import codec
 from kubegpu_tpu.core.types import NodeInfo
+from kubegpu_tpu.scheduler import interpod
 from kubegpu_tpu.scheduler.equivalence import EquivalenceCache
-from kubegpu_tpu.scheduler.predicates import pod_core_requests, pod_host_ports
+from kubegpu_tpu.scheduler.predicates import (pod_core_requests,
+                                              pod_host_ports, pod_volumes)
 
 ASSUMED_POD_TTL_S = 30.0
 
@@ -37,6 +39,9 @@ class CachedNode:
         self.requested_core: dict = {}  # prechecked (cpu/memory) accounting
         self.pod_ports: dict = {}       # pod name -> {(proto, hostIP, port)}
         self.pod_labels: dict = {}      # pod name -> labels (for spreading)
+        self.pod_volumes: dict = {}     # pod name -> volume dicts (disk conflicts)
+        self.pod_affinity: dict = {}    # pod name -> spec.affinity (interpod)
+        self.pod_namespaces: dict = {}  # pod name -> namespace
 
     def used_ports(self) -> set:
         out: set = set()
@@ -64,6 +69,7 @@ class NodeSnapshot:
         self.requested_core = dict(cached.requested_core)
         self.used_ports = cached.used_ports()
         self.pod_labels = {k: dict(v) for k, v in cached.pod_labels.items()}
+        self.pod_volumes = dict(cached.pod_volumes)  # lists replaced, not mutated
         self.pod_names = set(cached.pod_names)
         self.kube_node = _slim_node_copy(cached.kube_node)
         self.core_allocatable = cached.core_allocatable()
@@ -104,6 +110,7 @@ class SchedulerCache:
         self.nodes: dict = {}           # name -> CachedNode
         self._assumed: dict = {}        # pod name -> (node_name, deadline)
         self._charged: set = set()      # pod names currently accounted
+        self._affinity_pods = 0         # placed pods carrying pod(Anti)Affinity
         self.equivalence = EquivalenceCache()
 
     # ---- nodes (`node_info.go:456-492`) ------------------------------------
@@ -119,13 +126,23 @@ class SchedulerCache:
                 kube_node.get("metadata") or {}, existing_ex)
             node_ex.name = name
             if cached is None:
+                old_labels = None
                 cached = CachedNode(kube_node)
                 self.nodes[name] = cached
             else:
+                old_labels = (cached.kube_node.get("metadata") or {}) \
+                    .get("labels") or {}
                 cached.kube_node = kube_node
             cached.node_ex = node_ex
             self.device_scheduler.add_node(name, node_ex)
-            self.equivalence.invalidate_node(name)
+            new_labels = (kube_node.get("metadata") or {}).get("labels") or {}
+            if self._affinity_pods and old_labels is not None \
+                    and old_labels != new_labels:
+                # topology-domain labels moved: affinity verdicts on OTHER
+                # nodes sharing the domain are stale too
+                self.equivalence.invalidate_all()
+            else:
+                self.equivalence.invalidate_node(name)
 
     def remove_node(self, name: str) -> None:
         with self._lock:
@@ -137,8 +154,12 @@ class SchedulerCache:
                 # fresh node instead of hitting the idempotency gate.
                 for pod_name in cached.pod_names:
                     self._charged.discard(pod_name)
+                self._affinity_pods -= len(cached.pod_affinity)
                 self.device_scheduler.remove_node(name)
-                self.equivalence.invalidate_node(name)
+                if cached.pod_affinity:
+                    self.equivalence.invalidate_all()
+                else:
+                    self.equivalence.invalidate_node(name)
 
     def get_node(self, name: str) -> CachedNode | None:
         with self._lock:
@@ -195,16 +216,37 @@ class SchedulerCache:
         for res, val in pod_core_requests(kube_pod).items():
             cached.requested_core[res] = \
                 cached.requested_core.get(res, 0) + sign * val
+        meta = kube_pod.get("metadata") or {}
+        affinity = ((kube_pod.get("spec") or {}).get("affinity") or {})
+        pod_level = {k: affinity[k] for k in ("podAffinity", "podAntiAffinity")
+                     if affinity.get(k)}
         if take:
             cached.pod_ports[name] = pod_host_ports(kube_pod)
-            labels = (kube_pod.get("metadata") or {}).get("labels") or {}
-            cached.pod_labels[name] = dict(labels)
+            cached.pod_labels[name] = dict(meta.get("labels") or {})
+            vols = pod_volumes(kube_pod)
+            if vols:
+                cached.pod_volumes[name] = vols
+            if pod_level:
+                cached.pod_affinity[name] = pod_level
+                self._affinity_pods += 1
+            cached.pod_namespaces[name] = meta.get("namespace") or "default"
             self._charged.add(name)
         else:
             cached.pod_ports.pop(name, None)
             cached.pod_labels.pop(name, None)
+            cached.pod_volumes.pop(name, None)
+            if cached.pod_affinity.pop(name, None) is not None:
+                self._affinity_pods -= 1
+            cached.pod_namespaces.pop(name, None)
             self._charged.discard(name)
-        self.equivalence.invalidate_node(node_name)
+        if pod_level:
+            # A pod with inter-pod (anti-)affinity changes predicate
+            # results on every node sharing a topology domain — per-node
+            # invalidation is not enough (the upstream equivalence-cache
+            # affinity bug class).
+            self.equivalence.invalidate_all()
+        else:
+            self.equivalence.invalidate_node(node_name)
 
     def assume_pod(self, kube_pod: dict, node_name: str,
                    now: float | None = None) -> None:
@@ -227,6 +269,31 @@ class SchedulerCache:
             if cached is None:
                 return None
             return NodeSnapshot(cached)
+
+    def has_affinity_pods(self) -> bool:
+        """Fast gate: any placed pod carrying pod(Anti)Affinity? Lets the
+        filter skip building cluster-wide metadata for the common case
+        (the reference gates the same way in its metadata producer)."""
+        with self._lock:
+            return self._affinity_pods > 0
+
+    def interpod_snapshot(self) -> interpod.InterPodMetadata:
+        """Cluster-wide affinity inputs under ONE lock acquisition — the
+        `predicates/metadata.go` analogue, consumed by `interpod.py`."""
+        with self._lock:
+            node_labels = {}
+            pods = []
+            for name, cached in self.nodes.items():
+                node_labels[name] = dict(
+                    (cached.kube_node.get("metadata") or {}).get("labels") or {})
+                for pod_name in cached.pod_names:
+                    pods.append(interpod.ExistingPod(
+                        pod_name,
+                        cached.pod_namespaces.get(pod_name),
+                        dict(cached.pod_labels.get(pod_name) or {}),
+                        name,
+                        cached.pod_affinity.get(pod_name)))
+            return interpod.InterPodMetadata(node_labels, pods)
 
     def confirm_pod(self, pod_name: str) -> None:
         """Bind succeeded: the pod is no longer merely assumed."""

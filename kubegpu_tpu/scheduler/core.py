@@ -20,7 +20,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 from kubegpu_tpu import metrics
 from kubegpu_tpu.core import codec
-from kubegpu_tpu.scheduler import predicates, priorities
+from kubegpu_tpu.scheduler import factory, interpod, predicates, priorities
 from kubegpu_tpu.scheduler.cache import SchedulerCache
 from kubegpu_tpu.scheduler.equivalence import equivalence_class
 from kubegpu_tpu.scheduler.queue import SchedulingQueue
@@ -50,21 +50,37 @@ class GenericScheduler:
     def __init__(self, cache: SchedulerCache, device_scheduler,
                  parallelism: int = DEFAULT_PARALLELISM,
                  extenders: list | None = None,
-                 priority_weights: dict | None = None):
+                 priority_weights: dict | None = None,
+                 algorithm: factory.AlgorithmConfig | None = None):
         self.cache = cache
         self.device_scheduler = device_scheduler
         self.parallelism = max(1, parallelism)
         self.extenders = extenders or []
-        self.priority_weights = priority_weights or priorities.DEFAULT_WEIGHTS
+        # Predicate/priority composition: an explicit AlgorithmConfig (from
+        # a Policy file via `factory.algorithm_from_policy`) wins; else the
+        # default provider with optional per-priority weight overrides.
+        self.algorithm = algorithm or factory.default_algorithm(priority_weights)
         self._last_node_index = 0
         self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
                                         thread_name_prefix="fit")
 
     # ---- predicates --------------------------------------------------------
 
+    _AUTO_META = object()  # sentinel: compute inter-pod metadata if needed
+
+    def _interpod_meta(self, kube_pod: dict):
+        """Cluster-wide inter-pod-affinity metadata, or None when neither
+        the incoming pod nor any placed pod declares any — the gate that
+        keeps affinity free for the common case (`metadata.go` analogue)."""
+        if interpod.pod_declares_interpod_affinity(kube_pod) or \
+                self.cache.has_affinity_pods():
+            return self.cache.interpod_snapshot()
+        return None
+
     def _fits_on_node(self, kube_pod: dict, node_name: str,
                       eq_class: str | None = None,
-                      out_snaps: dict | None = None):
+                      out_snaps: dict | None = None,
+                      meta=_AUTO_META):
         """The full predicate chain against a point-in-time snapshot so
         concurrent watcher mutations of node usage cannot tear mid-fit.
         Order mirrors the reference providers: cheap node gates first, the
@@ -79,10 +95,12 @@ class GenericScheduler:
             # while we compute, store() drops the now-stale result instead
             # of poisoning the cache (the upstream equivalence-cache race).
             gen = self.cache.equivalence.generation(node_name)
+        if meta is self._AUTO_META:
+            meta = self._interpod_meta(kube_pod)
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
-        result = self._run_predicates(kube_pod, snap)
+        result = self._run_predicates(kube_pod, snap, meta)
         if out_snaps is not None and result[0]:
             # Only feasible nodes are scored; don't pin snapshots of the
             # (typically many) infeasible ones for the whole pass.
@@ -91,19 +109,10 @@ class GenericScheduler:
             self.cache.equivalence.store(node_name, eq_class, result, gen)
         return result
 
-    def _run_predicates(self, kube_pod: dict, snap):
-        kube_node = snap.kube_node
-        chain = (
-            lambda: predicates.check_node_condition(kube_pod, kube_node),
-            lambda: predicates.pod_fits_host(kube_pod, kube_node),
-            lambda: predicates.pod_matches_node_selector(kube_pod, kube_node),
-            lambda: predicates.pod_tolerates_node_taints(kube_pod, kube_node),
-            lambda: predicates.pod_fits_host_ports(kube_pod, snap.used_ports),
-            lambda: predicates.pod_fits_resources(
-                kube_pod, snap.core_allocatable, snap.requested_core),
-        )
-        for pred in chain:
-            ok, reasons = pred()
+    def _run_predicates(self, kube_pod: dict, snap, meta=None):
+        ctx = factory.PredicateContext(kube_pod, snap, meta)
+        for _name, pred in self.algorithm.predicates:
+            ok, reasons = pred(ctx)
             if not ok:
                 return False, reasons, 0.0
         pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
@@ -113,12 +122,21 @@ class GenericScheduler:
 
     def find_nodes_that_fit(self, kube_pod: dict):
         """Parallel filter over all nodes (`generic_scheduler.go:310-383`),
-        memoized per equivalence class, then extender callouts."""
+        memoized per equivalence class, then extender callouts. The
+        inter-pod metadata is built ONCE here and shared by every worker."""
         names = self.cache.node_names()
-        eq_class = equivalence_class(kube_pod)
+        # A pod declaring inter-pod (anti-)affinity must NOT be memoized:
+        # its verdict depends on every other pod's labels, so any plain pod
+        # landing anywhere could invalidate it — per-node invalidation
+        # can't express that, and whole-cluster flushes on every charge
+        # would kill the cache for everyone else.
+        eq_class = None if interpod.pod_declares_interpod_affinity(kube_pod) \
+            else equivalence_class(kube_pod)
+        meta = self._interpod_meta(kube_pod)
         snaps: dict = {}
         results = list(self._pool.map(
-            lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps)),
+            lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps,
+                                              meta)),
             names))
         feasible = {n: score for n, ok, _, score in results if ok}
         failures = {n: reasons for n, ok, reasons, _ in results if not ok}
@@ -134,11 +152,11 @@ class GenericScheduler:
                 if name not in survivors:
                     feasible.pop(name)
                     failures[name] = ["extender refused"]
-        return feasible, failures, snaps
+        return feasible, failures, snaps, meta
 
     def prioritize_nodes(self, kube_pod: dict, feasible: dict,
-                         snaps: dict | None = None) -> dict:
-        """Map-reduce the priority functions over feasible nodes
+                         snaps: dict | None = None, meta=_AUTO_META) -> dict:
+        """Map-reduce the configured priority functions over feasible nodes
         (`generic_scheduler.go:526-...`): stock priorities + the device
         score from the fit pass + extender scores, weighted-summed.
         ``snaps`` reuses snapshots the fit pass already took; nodes the
@@ -152,24 +170,15 @@ class GenericScheduler:
                 facts[name] = priorities.NodeFacts(
                     snap.kube_node, snap.core_allocatable,
                     snap.requested_core, snap.pod_labels)
-        max_same = max(
-            (priorities._count_same_labeled(kube_pod, f)
-             for f in facts.values()), default=0)
-        combined: dict = {}
-        for name, f in facts.items():
-            per = {
-                "least_requested": priorities.least_requested(pod_requests, f),
-                "balanced_allocation":
-                    priorities.balanced_allocation(pod_requests, f),
-                "selector_spreading":
-                    priorities.selector_spreading(kube_pod, f, max_same),
-                "node_affinity": priorities.node_affinity(kube_pod, f),
-                "taint_toleration": priorities.taint_toleration(kube_pod, f),
-                "node_prefer_avoid_pods":
-                    priorities.node_prefer_avoid_pods(kube_pod, f),
-                "device_score": feasible[name] * priorities.MAX_PRIORITY,
-            }
-            combined[name] = priorities.combine(per, self.priority_weights)
+        if meta is self._AUTO_META:
+            meta = self._interpod_meta(kube_pod)
+        ctx = factory.PriorityContext(
+            meta, self.algorithm.hard_pod_affinity_weight)
+        combined = {name: feasible[name] * priorities.MAX_PRIORITY
+                    * self.algorithm.device_weight for name in facts}
+        for _name, weight, batch in self.algorithm.priorities:
+            for name, score in batch(kube_pod, pod_requests, facts, ctx).items():
+                combined[name] = combined.get(name, 0.0) + weight * score
         for ext in self.extenders:
             for name, score in ext.prioritize(kube_pod, sorted(combined)).items():
                 combined[name] = combined.get(name, 0.0) + score
@@ -188,7 +197,7 @@ class GenericScheduler:
         pod_name = kube_pod["metadata"]["name"]
         trace = metrics.Trace(f"schedule {pod_name}")
         t0 = time.perf_counter()
-        feasible, failures, snaps = self.find_nodes_that_fit(kube_pod)
+        feasible, failures, snaps, meta = self.find_nodes_that_fit(kube_pod)
         trace.step("computed predicates")
         if not feasible:
             trace.log_if_long()
@@ -196,7 +205,7 @@ class GenericScheduler:
         if len(feasible) == 1:
             host = next(iter(feasible))
         else:
-            scored = self.prioritize_nodes(kube_pod, feasible, snaps)
+            scored = self.prioritize_nodes(kube_pod, feasible, snaps, meta)
             trace.step("prioritized")
             if not scored:  # every feasible node vanished mid-pass
                 trace.log_if_long()
@@ -283,7 +292,8 @@ class Scheduler:
     def __init__(self, api, device_scheduler, bind_async: bool = False,
                  parallelism: int = DEFAULT_PARALLELISM,
                  extenders: list | None = None,
-                 priority_weights: dict | None = None):
+                 priority_weights: dict | None = None,
+                 algorithm: factory.AlgorithmConfig | None = None):
         from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
 
         self.api = api
@@ -292,7 +302,8 @@ class Scheduler:
         self.queue = SchedulingQueue()
         self.generic = GenericScheduler(self.cache, device_scheduler, parallelism,
                                         extenders=extenders,
-                                        priority_weights=priority_weights)
+                                        priority_weights=priority_weights,
+                                        algorithm=algorithm)
         self.generic.api = api
         self.gang_buffer = GangBuffer()
         self.gang_planner = GangPlanner(self.cache)
@@ -412,8 +423,11 @@ class Scheduler:
             node_name, chips = assignment[name]
             pinned = self.gang_planner.pin_pod(member, node_name, chips)
             pinned_members.append((name, node_name, pinned))
+        meta = self.generic._interpod_meta(pinned_members[0][2]) \
+            if pinned_members else None
         for name, node_name, pinned in pinned_members:
-            fits, _, _ = self.generic._fits_on_node(pinned, node_name)
+            fits, _, _ = self.generic._fits_on_node(pinned, node_name,
+                                                    meta=meta)
             if not fits:
                 metrics.SCHEDULE_FAILURES.inc()
                 self.queue.add_unschedulable(kube_pod)

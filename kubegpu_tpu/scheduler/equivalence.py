@@ -34,6 +34,10 @@ def equivalence_class(kube_pod: dict) -> str:
     ident = {
         "spec": kube_pod.get("spec") or {},
         "labels": meta.get("labels") or {},
+        # namespace-sensitive predicates (inter-pod affinity terms default
+        # to the pod's own namespace) must not share verdicts across
+        # namespaces
+        "namespace": meta.get("namespace") or "default",
     }
     ann = (meta.get("annotations") or {}).get(POD_ANNOTATION_KEY)
     if ann:

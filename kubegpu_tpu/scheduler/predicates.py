@@ -12,11 +12,24 @@ non-device predicates the engine runs before ``PodFitsDevices``:
 - ``pod_fits_host_ports``  — hostPort conflicts (PodFitsHostPorts)
 - ``pod_tolerates_node_taints`` — NoSchedule/NoExecute taints vs
   tolerations (PodToleratesNodeTaints)
-- ``check_node_condition`` — Ready / unschedulable / pressure gates
-  (CheckNodeCondition + Memory/DiskPressure predicates)
+- ``check_node_condition`` — Ready / unschedulable gates
+  (CheckNodeCondition; the QoS-aware pressure predicates live in
+  ``factory.py``)
 - ``pod_fits_resources``   — prechecked cpu/memory accounting
   (PodFitsResources; group resources are the device predicate's job,
   cf. ``PrecheckedResource`` in `resource/resourcetranslate.go:97-99`)
+- ``no_disk_conflict``     — exclusive-volume double-mount conflicts
+  (NoDiskConflict: GCE PD / AWS EBS / RBD / ISCSI semantics)
+- ``max_attachable_volume_count`` — per-node attachable-volume caps
+  (MaxEBSVolumeCount / MaxGCEPDVolumeCount analogues)
+- ``no_volume_zone_conflict`` — zone-labeled volumes must land in-zone
+  (NoVolumeZoneConflict, over inline volume zone labels instead of a
+  PV lister)
+- ``general_predicates``   — the resources+host+ports+selector composite
+  (GeneralPredicates)
+
+Inter-pod affinity lives in ``interpod.py`` (needs cluster-wide
+metadata, not just one node's snapshot).
 
 Each predicate returns ``(fits: bool, reasons: list[str])`` and is pure
 over the pod dict plus a point-in-time node snapshot, so the chain can run
@@ -171,18 +184,16 @@ def pod_tolerates_node_taints(kube_pod: dict, kube_node: dict) -> tuple:
 
 
 def check_node_condition(kube_pod: dict, kube_node: dict) -> tuple:
+    """Ready + unschedulable gates (upstream CheckNodeCondition). Memory/
+    disk pressure are their own predicates with QoS-aware semantics —
+    `factory.py` CheckNodeMemoryPressure/CheckNodeDiskPressure."""
     spec = kube_node.get("spec") or {}
     if spec.get("unschedulable"):
         return False, ["node(s) were unschedulable"]
     reasons = []
     for cond in (kube_node.get("status") or {}).get("conditions") or []:
-        ctype, status = cond.get("type"), cond.get("status")
-        if ctype == "Ready" and status != "True":
+        if cond.get("type") == "Ready" and cond.get("status") != "True":
             reasons.append("node(s) were not ready")
-        elif ctype == "MemoryPressure" and status == "True":
-            reasons.append("node(s) had memory pressure")
-        elif ctype == "DiskPressure" and status == "True":
-            reasons.append("node(s) had disk pressure")
     return not reasons, reasons
 
 
@@ -194,4 +205,121 @@ def pod_fits_resources(kube_pod: dict, core_allocatable: dict,
             continue  # group/device resources: the device predicate's job
         if req + requested_core.get(res, 0) > core_allocatable[res]:
             reasons.append(f"Insufficient {res}")
+    return not reasons, reasons
+
+
+# ---- volumes ----------------------------------------------------------------
+
+# Exclusive volume sources and their identity/read-only extraction, per the
+# reference's NoDiskConflict (`predicates.go` isVolumeConflict): GCE PDs
+# conflict unless every mount is read-only; EBS, RBD and ISCSI volumes
+# conflict on any double mount.
+_VOLUME_IDENTITY = {
+    "gcePersistentDisk": lambda src: ("gce", src.get("pdName")),
+    "awsElasticBlockStore": lambda src: ("ebs", src.get("volumeID")),
+    "rbd": lambda src: ("rbd", ",".join(sorted(src.get("monitors") or [])),
+                        src.get("pool") or "rbd", src.get("image")),
+    "iscsi": lambda src: ("iscsi", src.get("targetPortal"), src.get("iqn"),
+                          src.get("lun")),
+}
+_READONLY_OK = {"gcePersistentDisk"}
+
+
+def pod_volumes(kube_pod: dict) -> list:
+    """The pod's volume dicts (spec.volumes)."""
+    return (kube_pod.get("spec") or {}).get("volumes") or []
+
+
+def _exclusive_volume_keys(volumes: list):
+    """Yield (identity, read_only) for conflict-capable volumes."""
+    for vol in volumes:
+        for kind, ident_fn in _VOLUME_IDENTITY.items():
+            src = vol.get(kind)
+            if src is not None:
+                yield (kind, *filter(None, ident_fn(src))), \
+                    bool(src.get("readOnly")), kind
+
+
+def no_disk_conflict(kube_pod: dict, node_pod_volumes: dict) -> tuple:
+    """``node_pod_volumes``: existing pod name -> its volume list."""
+    existing = {}
+    for vols in node_pod_volumes.values():
+        for ident, read_only, kind in _exclusive_volume_keys(vols):
+            existing[ident] = existing.get(ident, True) and read_only
+    for ident, read_only, kind in _exclusive_volume_keys(pod_volumes(kube_pod)):
+        if ident not in existing:
+            continue
+        if kind in _READONLY_OK and read_only and existing[ident]:
+            continue  # GCE PDs tolerate all-read-only sharing
+        return False, [f"node(s) had no available disk ({ident[0]} volume "
+                       "already mounted)"]
+    return True, []
+
+
+# Upstream defaults: 39 for EBS (DefaultMaxEBSVolumes), 16 for GCE PD.
+MAX_ATTACHABLE = {"awsElasticBlockStore": 39, "gcePersistentDisk": 16}
+
+
+def max_attachable_volume_count(kube_pod: dict, node_pod_volumes: dict,
+                                limits: dict | None = None) -> tuple:
+    """Cap distinct attachable volumes per node per cloud-disk kind
+    (MaxEBSVolumeCount / MaxGCEPDVolumeCount)."""
+    limits = limits or MAX_ATTACHABLE
+    attached: dict = {kind: set() for kind in limits}
+    for vols in node_pod_volumes.values():
+        for vol in vols:
+            for kind in limits:
+                src = vol.get(kind)
+                if src is not None:
+                    ident = _VOLUME_IDENTITY[kind](src)
+                    attached[kind].add(ident)
+    for vol in pod_volumes(kube_pod):
+        for kind in limits:
+            src = vol.get(kind)
+            if src is not None:
+                attached[kind].add(_VOLUME_IDENTITY[kind](src))
+    for kind, cap in limits.items():
+        if len(attached[kind]) > cap:
+            return False, [f"node(s) exceed max volume count ({kind})"]
+    return True, []
+
+
+_ZONE_LABELS = ("failure-domain.beta.kubernetes.io/zone",
+                "failure-domain.beta.kubernetes.io/region",
+                "topology.kubernetes.io/zone",
+                "topology.kubernetes.io/region")
+
+
+def no_volume_zone_conflict(kube_pod: dict, kube_node: dict) -> tuple:
+    """Zone-labeled volumes must match the node's zone labels
+    (NoVolumeZoneConflict). The reference resolves zones through a PV
+    lister; standalone, the zone rides on the volume dict itself as
+    ``labels`` (same failure-domain keys)."""
+    node_labels = (kube_node.get("metadata") or {}).get("labels") or {}
+    for vol in pod_volumes(kube_pod):
+        vol_labels = vol.get("labels") or {}
+        for key in _ZONE_LABELS:
+            want = vol_labels.get(key)
+            if want is None:
+                continue
+            have = node_labels.get(key)
+            # zone label value may be a comma-separated set (upstream
+            # multi-zone volumes)
+            if have is None or have not in str(want).split(","):
+                return False, ["node(s) had no available volume zone"]
+    return True, []
+
+
+def general_predicates(kube_pod: dict, kube_node: dict, used_ports: set,
+                       core_allocatable: dict, requested_core: dict) -> tuple:
+    """The GeneralPredicates composite: resources + host + ports +
+    selector in one registered name."""
+    reasons: list = []
+    for ok, why in (
+            pod_fits_resources(kube_pod, core_allocatable, requested_core),
+            pod_fits_host(kube_pod, kube_node),
+            pod_fits_host_ports(kube_pod, used_ports),
+            pod_matches_node_selector(kube_pod, kube_node)):
+        if not ok:
+            reasons.extend(why)
     return not reasons, reasons

@@ -22,6 +22,19 @@ Implemented (reference file in parens):
   PreferNoSchedule taints is better
 - ``node_prefer_avoid_pods``   (node_prefer_avoid_pods.go) — node
   annotation veto for controller-owned pods
+- ``most_requested``           (most_requested.go) — bin-packing twin of
+  least_requested
+- ``image_locality``           (image_locality.go) — favor nodes already
+  holding the pod's container images
+- ``resource_limits``          (resource_limits.go) — node satisfies the
+  pod's resource *limits*
+- ``node_label``               (node_label.go) — policy-configured label
+  presence/absence preference
+- ``equal_priority``           (core.EqualPriorityMap) — flat score
+
+Inter-pod affinity priority lives in ``interpod.py`` (cluster-wide
+metadata). ``combine`` does the weighted sum over whatever subset the
+factory configured.
 """
 
 from __future__ import annotations
@@ -174,6 +187,82 @@ def node_prefer_avoid_pods(kube_pod: dict, facts: NodeFacts) -> float:
                 and sig.get("name") == owner.get("name")):
             return 0.0
     return MAX_PRIORITY
+
+
+def most_requested(pod_requests: dict, facts: NodeFacts) -> float:
+    """(requested / capacity) * 10 averaged over cpu+memory
+    (`most_requested.go`) — bin-packing: fill hot nodes first."""
+    scores = []
+    for res in ("cpu", "memory"):
+        cap = facts.core_allocatable.get(res)
+        if not cap:
+            continue
+        used = facts.requested_core.get(res, 0) + pod_requests.get(res, 0)
+        scores.append(_fraction(used, cap) * MAX_PRIORITY)
+    return sum(scores) / len(scores) if scores else MAX_PRIORITY / 2
+
+
+# Upstream image-locality thresholds (`image_locality.go`): below 23MB of
+# already-present image data the node scores 0, above 1000MB it scores 10.
+_IMAGE_MIN_BYTES = 23 * 1024 * 1024
+_IMAGE_MAX_BYTES = 1000 * 1024 * 1024
+
+
+def image_locality(kube_pod: dict, facts: NodeFacts) -> float:
+    """Sum the sizes of the pod's container images already present on the
+    node (node.status.images) and scale between the thresholds."""
+    wanted = set()
+    spec = kube_pod.get("spec") or {}
+    for c in (spec.get("containers") or []) + (spec.get("initContainers") or []):
+        if c.get("image"):
+            wanted.add(c["image"])
+    if not wanted:
+        return 0.0
+    present = 0
+    for img in (facts.kube_node.get("status") or {}).get("images") or []:
+        if wanted & set(img.get("names") or []):
+            present += int(img.get("sizeBytes") or 0)
+    if present < _IMAGE_MIN_BYTES:
+        return 0.0
+    if present > _IMAGE_MAX_BYTES:
+        return MAX_PRIORITY
+    return (present - _IMAGE_MIN_BYTES) / \
+        (_IMAGE_MAX_BYTES - _IMAGE_MIN_BYTES) * MAX_PRIORITY
+
+
+def _pod_core_limits(kube_pod: dict) -> dict:
+    from kubegpu_tpu.core import codec
+    out: dict = {}
+    spec = kube_pod.get("spec") or {}
+    for c in spec.get("containers") or []:
+        for res, val in ((c.get("resources") or {}).get("limits") or {}).items():
+            out[res] = out.get(res, 0) + codec.parse_quantity(val)
+    for c in spec.get("initContainers") or []:
+        for res, val in ((c.get("resources") or {}).get("limits") or {}).items():
+            out[res] = max(out.get(res, 0), codec.parse_quantity(val))
+    return out
+
+
+def resource_limits(kube_pod: dict, facts: NodeFacts) -> float:
+    """1 when the node's allocatable covers the pod's cpu+memory *limits*,
+    else 0 (`resource_limits.go` — a nudge, deliberately not 0..10)."""
+    limits = _pod_core_limits(kube_pod)
+    for res in ("cpu", "memory"):
+        want = limits.get(res)
+        if want and want > facts.core_allocatable.get(res, 0):
+            return 0.0
+    return 1.0 if any(limits.get(r) for r in ("cpu", "memory")) else 0.0
+
+
+def node_label(facts: NodeFacts, label: str, presence: bool = True) -> float:
+    """Policy-configured label preference (`node_label.go`): 10 when the
+    label's presence matches the desired ``presence``, else 0."""
+    return MAX_PRIORITY if (label in facts.labels) == presence else 0.0
+
+
+def equal_priority(kube_pod: dict, facts: NodeFacts) -> float:
+    """EqualPriorityMap: every node scores 1."""
+    return 1.0
 
 
 def combine(per_function: dict, weights: dict | None = None) -> float:

@@ -1,0 +1,431 @@
+"""Inter-pod affinity, volume predicates, extra priorities, and the
+factory/policy layer.
+
+Mirrors the reference's upstream tables (`predicates_test.go` affinity
+cases, `interpod_affinity_test.go`, `image_locality_test.go`,
+`most_requested_test.go`, `node_label_test.go`) and the Policy config
+surface (`kube-scheduler/pkg/api/types.go`,
+`algorithmprovider/defaults/defaults.go`).
+"""
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.scheduler import factory, interpod, predicates, priorities
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+
+
+# ---- interpod predicate (unit) ---------------------------------------------
+
+def meta_with(pods, node_labels=None):
+    return interpod.InterPodMetadata(
+        node_labels or {"n0": {"zone": "a"}, "n1": {"zone": "a"},
+                        "n2": {"zone": "b"}},
+        [interpod.ExistingPod(*p) for p in pods])
+
+
+def pod_with_affinity(name="p", labels=None, affinity=None, namespace=None):
+    meta = {"name": name, "labels": labels or {}}
+    if namespace:
+        meta["namespace"] = namespace
+    return {"metadata": meta, "spec": {"affinity": affinity or {}}}
+
+
+def required_term(match_labels, topology_key="zone", namespaces=None):
+    term = {"labelSelector": {"matchLabels": match_labels},
+            "topologyKey": topology_key}
+    if namespaces:
+        term["namespaces"] = namespaces
+    return term
+
+
+def test_required_affinity_colocates():
+    # web pod must share a zone with a placed db pod (db on n0, zone a)
+    meta = meta_with([("db", "default", {"app": "db"}, "n0", None)])
+    pod = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                        [required_term({"app": "db"})]}})
+    ok, _ = interpod.match_interpod_affinity(pod, "n1", meta)  # zone a
+    assert ok
+    ok, reasons = interpod.match_interpod_affinity(pod, "n2", meta)  # zone b
+    assert not ok and "affinity" in reasons[0]
+
+
+def test_required_anti_affinity_spreads():
+    meta = meta_with([("web1", "default", {"app": "web"}, "n0", None)])
+    pod = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                            [required_term({"app": "web"})]}})
+    ok, _ = interpod.match_interpod_affinity(pod, "n1", meta)  # same zone
+    assert not ok
+    ok, _ = interpod.match_interpod_affinity(pod, "n2", meta)  # other zone
+    assert ok
+
+
+def test_existing_pod_anti_affinity_symmetry():
+    """An existing pod's required anti-affinity vetoes the incoming pod
+    even when the incoming pod declares nothing."""
+    existing_affinity = {
+        "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                            [required_term({"app": "web"})]}}
+    meta = meta_with([("lonely", "default", {"app": "db"}, "n0",
+                       existing_affinity)])
+    pod = pod_with_affinity(labels={"app": "web"})
+    ok, reasons = interpod.match_interpod_affinity(pod, "n1", meta)
+    assert not ok and "existing pod anti-affinity" in reasons[0]
+    ok, _ = interpod.match_interpod_affinity(pod, "n2", meta)
+    assert ok
+    # a pod the selector doesn't match is unaffected
+    other = pod_with_affinity(labels={"app": "cache"})
+    ok, _ = interpod.match_interpod_affinity(other, "n1", meta)
+    assert ok
+
+
+def test_first_pod_of_self_affine_group_lands():
+    """Upstream escape hatch: a required affinity term nothing matches is
+    satisfied when the pod matches its own selector."""
+    meta = meta_with([])
+    pod = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                        [required_term({"app": "web"})]}})
+    ok, _ = interpod.match_interpod_affinity(pod, "n0", meta)
+    assert ok
+    # but a term the pod itself doesn't match still fails
+    pod2 = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                        [required_term({"app": "db"})]}})
+    ok, _ = interpod.match_interpod_affinity(pod2, "n0", meta)
+    assert not ok
+
+
+def test_affinity_namespace_scoping():
+    meta = meta_with([("db", "prod", {"app": "db"}, "n0", None)])
+    # default namespace: the prod db doesn't count
+    pod = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                        [required_term({"app": "db"})]}})
+    ok, _ = interpod.match_interpod_affinity(pod, "n0", meta)
+    assert not ok
+    # explicit namespaces on the term match it
+    pod = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                        [required_term({"app": "db"}, namespaces=["prod"])]}})
+    ok, _ = interpod.match_interpod_affinity(pod, "n0", meta)
+    assert ok
+
+
+def test_match_expressions_selector():
+    meta = meta_with([("db", "default", {"tier": "gold"}, "n0", None)])
+    term = {"labelSelector": {"matchExpressions": [
+        {"key": "tier", "operator": "In", "values": ["gold", "silver"]}]},
+        "topologyKey": "zone"}
+    pod = pod_with_affinity(labels={}, affinity={
+        "podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+                        [term]}})
+    ok, _ = interpod.match_interpod_affinity(pod, "n1", meta)
+    assert ok
+
+
+# ---- interpod priority (unit) ----------------------------------------------
+
+def test_preferred_affinity_scores_and_reduce():
+    meta = meta_with([("db", "default", {"app": "db"}, "n0", None)])
+    pod = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 100,
+             "podAffinityTerm": required_term({"app": "db"})}]}})
+    raw = interpod.interpod_affinity_scores(pod, ["n0", "n1", "n2"], meta)
+    assert raw["n0"] == raw["n1"] == 100.0 and raw["n2"] == 0.0
+    scaled = interpod.reduce_to_priority_scale(raw)
+    assert scaled["n0"] == 10.0 and scaled["n2"] == 0.0
+
+
+def test_preferred_anti_affinity_negative():
+    meta = meta_with([("web1", "default", {"app": "web"}, "n0", None)])
+    pod = pod_with_affinity(labels={"app": "web"}, affinity={
+        "podAntiAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 50,
+             "podAffinityTerm": required_term({"app": "web"})}]}})
+    raw = interpod.interpod_affinity_scores(pod, ["n0", "n1", "n2"], meta)
+    assert raw["n0"] == raw["n1"] == -50.0 and raw["n2"] == 0.0
+    scaled = interpod.reduce_to_priority_scale(raw)
+    assert scaled["n2"] == 10.0 and scaled["n0"] == 0.0
+
+
+def test_hard_affinity_symmetric_weight():
+    """An existing pod with REQUIRED affinity toward the incoming pod
+    credits its topology domain with the configured hard weight."""
+    existing = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+        [required_term({"app": "web"})]}}
+    meta = meta_with([("db", "default", {"app": "db"}, "n0", existing)])
+    pod = pod_with_affinity(labels={"app": "web"})
+    raw = interpod.interpod_affinity_scores(pod, ["n0", "n2"], meta,
+                                            hard_weight=5)
+    assert raw["n0"] == 5.0 and raw["n2"] == 0.0
+
+
+# ---- volume predicates ------------------------------------------------------
+
+def gce_vol(pd, read_only=False):
+    return {"name": pd, "gcePersistentDisk": {"pdName": pd,
+                                              "readOnly": read_only}}
+
+
+def ebs_vol(vid):
+    return {"name": vid, "awsElasticBlockStore": {"volumeID": vid}}
+
+
+def test_no_disk_conflict_gce_rw():
+    pod = {"spec": {"volumes": [gce_vol("disk1")]}}
+    ok, _ = predicates.no_disk_conflict(pod, {})
+    assert ok
+    ok, reasons = predicates.no_disk_conflict(
+        pod, {"other": [gce_vol("disk1")]})
+    assert not ok and "disk" in reasons[0]
+    # different disk is fine
+    ok, _ = predicates.no_disk_conflict(pod, {"other": [gce_vol("disk2")]})
+    assert ok
+
+
+def test_no_disk_conflict_gce_all_readonly_ok():
+    pod = {"spec": {"volumes": [gce_vol("disk1", read_only=True)]}}
+    ok, _ = predicates.no_disk_conflict(
+        pod, {"other": [gce_vol("disk1", read_only=True)]})
+    assert ok
+    # one writer breaks it
+    ok, _ = predicates.no_disk_conflict(
+        pod, {"other": [gce_vol("disk1", read_only=False)]})
+    assert not ok
+
+
+def test_no_disk_conflict_ebs_always():
+    pod = {"spec": {"volumes": [ebs_vol("vol-1")]}}
+    ok, _ = predicates.no_disk_conflict(pod, {"other": [ebs_vol("vol-1")]})
+    assert not ok
+
+
+def test_max_attachable_volume_count():
+    pod = {"spec": {"volumes": [ebs_vol("vol-new")]}}
+    existing = {"p{}".format(i): [ebs_vol(f"vol-{i}")] for i in range(39)}
+    ok, reasons = predicates.max_attachable_volume_count(pod, existing)
+    assert not ok and "max volume count" in reasons[0]
+    # an already-attached volume doesn't count twice
+    pod_same = {"spec": {"volumes": [ebs_vol("vol-0")]}}
+    ok, _ = predicates.max_attachable_volume_count(pod_same, existing)
+    assert ok
+
+
+def test_no_volume_zone_conflict():
+    vol = {"name": "pd", "gcePersistentDisk": {"pdName": "d"},
+           "labels": {"failure-domain.beta.kubernetes.io/zone": "us-c1-a"}}
+    pod = {"spec": {"volumes": [vol]}}
+    in_zone = {"metadata": {"labels":
+                            {"failure-domain.beta.kubernetes.io/zone": "us-c1-a"}}}
+    out_zone = {"metadata": {"labels":
+                             {"failure-domain.beta.kubernetes.io/zone": "us-c1-b"}}}
+    assert predicates.no_volume_zone_conflict(pod, in_zone)[0]
+    ok, reasons = predicates.no_volume_zone_conflict(pod, out_zone)
+    assert not ok and "zone" in reasons[0]
+
+
+def test_general_predicates_composite():
+    node = {"metadata": {"name": "n0", "labels": {}}, "spec": {}, "status": {}}
+    pod = {"metadata": {"name": "p"},
+           "spec": {"nodeName": "other", "nodeSelector": {"gpu": "yes"}}}
+    ok, reasons = predicates.general_predicates(pod, node, set(), {}, {})
+    assert not ok and len(reasons) == 2  # hostname AND selector both reported
+
+
+# ---- new priorities ---------------------------------------------------------
+
+def facts(allocatable=None, requested=None, node=None):
+    return priorities.NodeFacts(node or {"metadata": {"labels": {}}},
+                                allocatable or {"cpu": 10, "memory": 100},
+                                requested or {}, {})
+
+
+def test_most_requested_mirrors_least():
+    f = facts(requested={"cpu": 5, "memory": 50})
+    assert priorities.most_requested({}, f) == pytest.approx(5.0)
+    assert priorities.least_requested({}, f) == pytest.approx(5.0)
+    f_hot = facts(requested={"cpu": 9, "memory": 90})
+    assert priorities.most_requested({}, f_hot) > priorities.most_requested({}, f)
+
+
+def test_image_locality_thresholds():
+    mb = 1024 * 1024
+    node = {"metadata": {"labels": {}},
+            "status": {"images": [
+                {"names": ["repo/model:v1"], "sizeBytes": 500 * mb},
+                {"names": ["repo/tiny:v1"], "sizeBytes": 10 * mb}]}}
+    pod_big = {"spec": {"containers": [{"image": "repo/model:v1"}]}}
+    pod_tiny = {"spec": {"containers": [{"image": "repo/tiny:v1"}]}}
+    pod_absent = {"spec": {"containers": [{"image": "repo/other:v2"}]}}
+    f = facts(node=node)
+    assert 0.0 < priorities.image_locality(pod_big, f) < 10.0
+    assert priorities.image_locality(pod_tiny, f) == 0.0   # under 23MB
+    assert priorities.image_locality(pod_absent, f) == 0.0
+
+
+def test_resource_limits_priority():
+    f = facts(allocatable={"cpu": 4, "memory": 100})
+    fits = {"spec": {"containers": [{"resources": {"limits": {"cpu": "2"}}}]}}
+    too_big = {"spec": {"containers": [{"resources": {"limits": {"cpu": "8"}}}]}}
+    none = {"spec": {"containers": [{}]}}
+    assert priorities.resource_limits(fits, f) == 1.0
+    assert priorities.resource_limits(too_big, f) == 0.0
+    assert priorities.resource_limits(none, f) == 0.0
+
+
+def test_node_label_priority():
+    f = facts(node={"metadata": {"labels": {"ssd": "true"}}})
+    assert priorities.node_label(f, "ssd", presence=True) == 10.0
+    assert priorities.node_label(f, "ssd", presence=False) == 0.0
+    assert priorities.node_label(f, "hdd", presence=False) == 10.0
+
+
+# ---- factory / policy -------------------------------------------------------
+
+def test_default_algorithm_shape():
+    algo = factory.default_algorithm()
+    pred_names = [n for n, _ in algo.predicates]
+    assert "MatchInterPodAffinity" in pred_names
+    assert "NoDiskConflict" in pred_names
+    assert pred_names[0] == "CheckNodeCondition"  # cheap gates first
+    prio_names = [n for n, _, _ in algo.priorities]
+    assert "LeastRequestedPriority" in prio_names
+    assert algo.device_weight == factory.DEFAULT_DEVICE_WEIGHT
+
+
+def test_priority_weights_replace_the_set():
+    """priorityWeights config keeps its pre-factory REPLACE semantics:
+    only the named priorities run, device_score must be re-listed."""
+    algo = factory.default_algorithm({"least_requested": 3.0,
+                                      "device_score": 5.0,
+                                      "MostRequestedPriority": 2.0})
+    weights = {n: w for n, w, _ in algo.priorities}
+    assert weights == {"LeastRequestedPriority": 3.0,
+                       "MostRequestedPriority": 2.0}
+    assert algo.device_weight == 5.0
+    # an unlisted device_score means the device score doesn't contribute
+    algo2 = factory.default_algorithm({"least_requested": 1.0})
+    assert algo2.device_weight == 0.0
+
+
+def test_policy_composition_and_errors():
+    policy = {
+        "kind": "Policy",
+        "predicates": [
+            {"name": "PodFitsResources"},
+            {"name": "CheckNodeLabelPresence",
+             "argument": {"labelsPresence": {"labels": ["tpu"],
+                                             "presence": True}}},
+        ],
+        "priorities": [{"name": "NodeLabelPriority", "weight": 4,
+                        "argument": {"labelPreference": {"label": "fast",
+                                                         "presence": True}}}],
+        "hardPodAffinitySymmetricWeight": 7,
+    }
+    algo = factory.algorithm_from_policy(policy)
+    assert [n for n, _ in algo.predicates] == ["PodFitsResources",
+                                               "CheckNodeLabelPresence"]
+    assert algo.priorities[0][:2] == ("NodeLabelPriority", 4.0)
+    assert algo.hard_pod_affinity_weight == 7
+    with pytest.raises(factory.PolicyError):
+        factory.algorithm_from_policy({"predicates": [{"name": "Bogus"}]})
+    with pytest.raises(factory.PolicyError):
+        factory.algorithm_from_policy({"kind": "NotAPolicy"})
+
+
+def test_policy_empty_lists_fall_back_to_defaults():
+    algo = factory.algorithm_from_policy({"kind": "Policy"})
+    assert [n for n, _ in algo.predicates] == \
+        list(factory.DEFAULT_PREDICATE_NAMES)
+
+
+# ---- end-to-end through the engine ------------------------------------------
+
+def _cluster(n_nodes=3, zones=("a", "a", "b")):
+    api = InMemoryAPIServer()
+    for i in range(n_nodes):
+        node = flat_tpu_node(f"host{i}")
+        node["metadata"]["labels"] = {"zone": zones[i],
+                                      "kubernetes.io/hostname": f"host{i}"}
+        api.create_node(node)
+    return api
+
+
+def test_e2e_required_anti_affinity_spreads_replicas():
+    api = _cluster(zones=("a", "b", "c"))
+    sched = make_scheduler(api)
+    anti = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+        [required_term({"app": "web"}, topology_key="zone")]}}
+    for i in range(3):
+        pod = tpu_pod(f"web{i}", 1)
+        pod["metadata"]["labels"] = {"app": "web"}
+        pod["spec"]["affinity"] = anti
+        api.create_pod(pod)
+    sched.run_until_idle()
+    hosts = {api.get_pod(f"web{i}")["spec"].get("nodeName") for i in range(3)}
+    assert len(hosts) == 3 and None not in hosts  # one replica per zone
+
+    # a 4th replica has nowhere left to go
+    pod = tpu_pod("web3", 1)
+    pod["metadata"]["labels"] = {"app": "web"}
+    pod["spec"]["affinity"] = anti
+    api.create_pod(pod)
+    sched.run_until_idle()
+    assert not api.get_pod("web3")["spec"].get("nodeName")
+
+
+def test_e2e_required_affinity_colocates_with_db():
+    api = _cluster(zones=("a", "a", "b"))
+    sched = make_scheduler(api)
+    db = tpu_pod("db", 1)
+    db["metadata"]["labels"] = {"app": "db"}
+    db["spec"]["nodeName"] = ""  # scheduled normally
+    api.create_pod(db)
+    sched.run_until_idle()
+    db_zone = api.get_node(
+        api.get_pod("db")["spec"]["nodeName"])["metadata"]["labels"]["zone"]
+
+    web = tpu_pod("web", 1)
+    web["metadata"]["labels"] = {"app": "web"}
+    web["spec"]["affinity"] = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+        [required_term({"app": "db"}, topology_key="zone")]}}
+    api.create_pod(web)
+    sched.run_until_idle()
+    web_node = api.get_pod("web")["spec"].get("nodeName")
+    assert web_node
+    assert api.get_node(web_node)["metadata"]["labels"]["zone"] == db_zone
+
+
+def test_e2e_policy_driven_scheduler():
+    """A Scheduler built from a Policy document schedules with the
+    recomposed algorithm (label-presence predicate filters nodes)."""
+    api = _cluster()
+    api.patch_node_metadata("host1", {"labels": {"dedicated": "tpu"}})
+    algo = factory.algorithm_from_policy({
+        "kind": "Policy",
+        "predicates": [
+            {"name": "CheckNodeCondition"},
+            {"name": "GeneralPredicates"},
+            {"name": "CheckNodeLabelPresence",
+             "argument": {"labelsPresence": {"labels": ["dedicated"],
+                                             "presence": True}}},
+        ],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+    })
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched = Scheduler(api, ds, algorithm=algo)
+    api.create_pod(tpu_pod("p0", 2))
+    sched.run_until_idle()
+    assert api.get_pod("p0")["spec"]["nodeName"] == "host1"

@@ -142,11 +142,38 @@ def test_node_conditions():
     ok, r = predicates.check_node_condition(
         _pod(), _node(conditions=[{"type": "Ready", "status": "False"}]))
     assert not ok and "not ready" in r[0]
-    ok, r = predicates.check_node_condition(
-        _pod(), _node(conditions=[{"type": "MemoryPressure", "status": "True"}]))
-    assert not ok
     ok, r = predicates.check_node_condition(_pod(), _node(unschedulable=True))
     assert not ok and "unschedulable" in r[0]
+
+
+def test_pressure_predicates_qos_aware():
+    """Upstream semantics: MemoryPressure keeps off only BestEffort pods;
+    DiskPressure keeps off everyone."""
+    from kubegpu_tpu.scheduler import factory
+    from kubegpu_tpu.scheduler.cache import NodeSnapshot
+
+    class _Snap:
+        pass
+
+    def snap_with(condition):
+        s = _Snap()
+        s.kube_node = _node(conditions=[{"type": condition, "status": "True"}])
+        return s
+
+    best_effort = _pod({"containers": [{"name": "c"}]})
+    burstable = _pod({"containers": [
+        {"name": "c", "resources": {"requests": {"cpu": "1"}}}]})
+
+    mem = factory.FIT_PREDICATES["CheckNodeMemoryPressure"](None)
+    ok, _ = mem(factory.PredicateContext(best_effort, snap_with("MemoryPressure")))
+    assert not ok
+    ok, _ = mem(factory.PredicateContext(burstable, snap_with("MemoryPressure")))
+    assert ok
+
+    disk = factory.FIT_PREDICATES["CheckNodeDiskPressure"](None)
+    for pod in (best_effort, burstable):
+        ok, _ = disk(factory.PredicateContext(pod, snap_with("DiskPressure")))
+        assert not ok
 
 
 def test_core_requests_init_max_not_sum():
