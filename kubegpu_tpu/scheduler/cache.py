@@ -99,6 +99,8 @@ def _slim_node_copy(kube_node: dict) -> dict:
         "status": {
             "conditions": [dict(c) for c in (status.get("conditions") or [])],
             "allocatable": dict(status.get("allocatable") or {}),
+            # image-locality priority reads present-image sizes
+            "images": [dict(i) for i in (status.get("images") or [])],
         },
     }
 
@@ -110,7 +112,8 @@ class SchedulerCache:
         self.nodes: dict = {}           # name -> CachedNode
         self._assumed: dict = {}        # pod name -> (node_name, deadline)
         self._charged: set = set()      # pod names currently accounted
-        self._affinity_pods = 0         # placed pods carrying pod(Anti)Affinity
+        self._affinity_pods = 0         # placed pods carrying ANY pod(Anti)Affinity
+        self._required_anti_pods = 0    # subset with REQUIRED anti-affinity
         self.equivalence = EquivalenceCache()
 
     # ---- nodes (`node_info.go:456-492`) ------------------------------------
@@ -136,10 +139,11 @@ class SchedulerCache:
             cached.node_ex = node_ex
             self.device_scheduler.add_node(name, node_ex)
             new_labels = (kube_node.get("metadata") or {}).get("labels") or {}
-            if self._affinity_pods and old_labels is not None \
+            if self._required_anti_pods and old_labels is not None \
                     and old_labels != new_labels:
-                # topology-domain labels moved: affinity verdicts on OTHER
-                # nodes sharing the domain are stale too
+                # topology-domain labels moved: the symmetry veto from
+                # placed required-anti-affinity pods may flip memoized
+                # verdicts on OTHER nodes sharing the domain
                 self.equivalence.invalidate_all()
             else:
                 self.equivalence.invalidate_node(name)
@@ -155,8 +159,12 @@ class SchedulerCache:
                 for pod_name in cached.pod_names:
                     self._charged.discard(pod_name)
                 self._affinity_pods -= len(cached.pod_affinity)
+                departed_anti = sum(
+                    interpod.has_required_anti_terms(aff)
+                    for aff in cached.pod_affinity.values())
+                self._required_anti_pods -= departed_anti
                 self.device_scheduler.remove_node(name)
-                if cached.pod_affinity:
+                if departed_anti:
                     self.equivalence.invalidate_all()
                 else:
                     self.equivalence.invalidate_node(name)
@@ -220,6 +228,7 @@ class SchedulerCache:
         affinity = ((kube_pod.get("spec") or {}).get("affinity") or {})
         pod_level = {k: affinity[k] for k in ("podAffinity", "podAntiAffinity")
                      if affinity.get(k)}
+        required_anti = interpod.has_required_anti_terms(pod_level)
         if take:
             cached.pod_ports[name] = pod_host_ports(kube_pod)
             cached.pod_labels[name] = dict(meta.get("labels") or {})
@@ -229,6 +238,7 @@ class SchedulerCache:
             if pod_level:
                 cached.pod_affinity[name] = pod_level
                 self._affinity_pods += 1
+                self._required_anti_pods += required_anti
             cached.pod_namespaces[name] = meta.get("namespace") or "default"
             self._charged.add(name)
         else:
@@ -237,13 +247,15 @@ class SchedulerCache:
             cached.pod_volumes.pop(name, None)
             if cached.pod_affinity.pop(name, None) is not None:
                 self._affinity_pods -= 1
+                self._required_anti_pods -= required_anti
             cached.pod_namespaces.pop(name, None)
             self._charged.discard(name)
-        if pod_level:
-            # A pod with inter-pod (anti-)affinity changes predicate
-            # results on every node sharing a topology domain — per-node
+        if required_anti:
+            # A pod with REQUIRED anti-affinity changes predicate results
+            # on every node sharing a topology domain — per-node
             # invalidation is not enough (the upstream equivalence-cache
-            # affinity bug class).
+            # affinity bug class). Preferred-only terms never flip a
+            # predicate verdict, so they don't pay this flush.
             self.equivalence.invalidate_all()
         else:
             self.equivalence.invalidate_node(node_name)

@@ -125,12 +125,13 @@ class GenericScheduler:
         memoized per equivalence class, then extender callouts. The
         inter-pod metadata is built ONCE here and shared by every worker."""
         names = self.cache.node_names()
-        # A pod declaring inter-pod (anti-)affinity must NOT be memoized:
-        # its verdict depends on every other pod's labels, so any plain pod
-        # landing anywhere could invalidate it — per-node invalidation
-        # can't express that, and whole-cluster flushes on every charge
-        # would kill the cache for everyone else.
-        eq_class = None if interpod.pod_declares_interpod_affinity(kube_pod) \
+        # A pod declaring REQUIRED inter-pod (anti-)affinity must NOT be
+        # memoized: its verdict depends on every other pod's labels, so any
+        # plain pod landing anywhere could invalidate it — per-node
+        # invalidation can't express that, and whole-cluster flushes on
+        # every charge would kill the cache for everyone else. Preferred-
+        # only terms don't affect predicates, so those pods stay memoized.
+        eq_class = None if interpod.pod_requires_interpod_affinity(kube_pod) \
             else equivalence_class(kube_pod)
         meta = self._interpod_meta(kube_pod)
         snaps: dict = {}
@@ -230,13 +231,20 @@ class GenericScheduler:
         codec.pod_info_to_annotation(kube_pod.setdefault("metadata", {}), pod_info)
         return kube_pod
 
-    # ---- preemption (`generic_scheduler.go:226-290`, simplified) ----------
+    # ---- preemption (`generic_scheduler.go:226-290`) ----------------------
 
     def preempt(self, kube_pod: dict):
-        """Find the node where evicting the fewest lowest-priority pods
-        makes room. Returns (node_name, victim pod dicts) or None."""
+        """Find the best node to preempt on. Victim selection per the
+        reference: remove ALL lower-priority pods, verify fit, then
+        reprieve victims highest-priority-first while the preemptor still
+        fits — so a cheap low-priority pod survives when evicting one big
+        pod sufficed. Node selection (pickOneNodeForPreemption): lowest
+        highest-victim-priority, then lowest priority sum, then fewest
+        victims, then lexical node name for determinism. Returns
+        (node_name, victim pod dicts) or None."""
         prio = _pod_priority(kube_pod)
         best = None
+        best_key = None
         for node_name in self.cache.node_names():
             snap = self.cache.snapshot_node(node_name)
             if snap is None:
@@ -244,9 +252,24 @@ class GenericScheduler:
             victims = self._victims_on_node(kube_pod, snap, prio)
             if victims is None:
                 continue
-            if best is None or len(victims) < len(best[1]):
-                best = (node_name, victims)
+            key = (max(_pod_priority(v) for v in victims),
+                   sum(_pod_priority(v) for v in victims),
+                   len(victims), node_name)
+            if best_key is None or key < best_key:
+                best, best_key = (node_name, victims), key
         return best
+
+    def _fits_after_evictions(self, kube_pod, snap, sim, core_free):
+        alloc = snap.core_allocatable
+        core_ok = all(
+            req + core_free.get(res, 0) <= alloc[res]
+            for res, req in _pod_core_requests(kube_pod).items()
+            if res in alloc)
+        if not core_ok:
+            return False
+        pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
+        fits, _, _ = self.device_scheduler.pod_fits_resources(pod_info, sim, False)
+        return fits
 
     def _victims_on_node(self, kube_pod, snap, prio):
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
@@ -265,24 +288,35 @@ class GenericScheduler:
                 candidates.append(p)
         if not candidates:
             return None
-        candidates.sort(key=_pod_priority)
-        victims = []
+
+        def charge(pod, sign):
+            """sign=-1 evicts (frees), +1 re-admits."""
+            info = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
+            if sign < 0:
+                self.device_scheduler.return_pod_resources(info, sim)
+            else:
+                self.device_scheduler.take_pod_resources(info, sim)
+            for res, val in _pod_core_requests(pod).items():
+                core_free[res] = core_free.get(res, 0) + sign * val
+
+        # Phase 1: evict every candidate; if the preemptor still doesn't
+        # fit, this node can't be helped by preemption.
         for victim in candidates:
-            v_info = codec.kube_pod_to_pod_info(victim, invalidate_existing=False)
-            self.device_scheduler.return_pod_resources(v_info, sim)
-            for res, val in _pod_core_requests(victim).items():
-                core_free[res] = core_free.get(res, 0) - val
-            victims.append(victim)
-            alloc = snap.core_allocatable
-            core_ok = all(
-                req + core_free.get(res, 0) <= alloc[res]
-                for res, req in _pod_core_requests(kube_pod).items()
-                if res in alloc)
-            pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
-            fits, _, _ = self.device_scheduler.pod_fits_resources(pod_info, sim, False)
-            if core_ok and fits:
-                return victims
-        return None
+            charge(victim, -1)
+        if not self._fits_after_evictions(kube_pod, snap, sim, core_free):
+            return None
+        # Phase 2: reprieve — re-admit in descending priority (then name
+        # for determinism); keep each pod that doesn't break the fit.
+        candidates.sort(key=lambda p: (-_pod_priority(p),
+                                       p["metadata"]["name"]))
+        victims = []
+        for pod in candidates:
+            charge(pod, +1)
+            if self._fits_after_evictions(kube_pod, snap, sim, core_free):
+                continue  # reprieved
+            charge(pod, -1)
+            victims.append(pod)
+        return victims or None
 
 
 class Scheduler:
@@ -423,8 +457,12 @@ class Scheduler:
             node_name, chips = assignment[name]
             pinned = self.gang_planner.pin_pod(member, node_name, chips)
             pinned_members.append((name, node_name, pinned))
-        meta = self.generic._interpod_meta(pinned_members[0][2]) \
-            if pinned_members else None
+        # Build the cluster metadata once if ANY member declares affinity
+        # (members may differ) or any placed pod carries it.
+        need_meta = self.cache.has_affinity_pods() or any(
+            interpod.pod_declares_interpod_affinity(p)
+            for _, _, p in pinned_members)
+        meta = self.cache.interpod_snapshot() if need_meta else None
         for name, node_name, pinned in pinned_members:
             fits, _, _ = self.generic._fits_on_node(pinned, node_name,
                                                     meta=meta)

@@ -110,9 +110,10 @@ def _pod_namespace(kube_pod: dict) -> str:
     return (kube_pod.get("metadata") or {}).get("namespace") or "default"
 
 
-def has_required_terms(affinity: dict | None) -> bool:
+def has_any_terms(affinity: dict | None) -> bool:
     """True when a pod's affinity spec carries any pod(Anti)Affinity
-    content the symmetric checks must see."""
+    content (required OR preferred) — the metadata-building gate: the
+    priority reads preferred terms too."""
     if not affinity:
         return False
     for kind in ("podAffinity", "podAntiAffinity"):
@@ -123,6 +124,17 @@ def has_required_terms(affinity: dict | None) -> bool:
     return False
 
 
+def has_required_anti_terms(affinity: dict | None) -> bool:
+    """True when the spec carries REQUIRED podAntiAffinity terms — the only
+    placed-pod content that can flip another pod's predicate verdict (the
+    symmetry veto), hence the only content that must flush memoized
+    verdicts cluster-wide."""
+    if not affinity:
+        return False
+    section = affinity.get("podAntiAffinity") or {}
+    return bool(section.get("requiredDuringSchedulingIgnoredDuringExecution"))
+
+
 # ---- the predicate ----------------------------------------------------------
 
 def match_interpod_affinity(kube_pod: dict, node_name: str,
@@ -131,14 +143,15 @@ def match_interpod_affinity(kube_pod: dict, node_name: str,
     namespace = _pod_namespace(kube_pod)
     pod_labels = (kube_pod.get("metadata") or {}).get("labels") or {}
     candidate_labels = meta.node_labels.get(node_name) or {}
+    # the incoming pod viewed as a match target — invariant across the
+    # loops below, built once
+    self_pod = ExistingPod(None, namespace, pod_labels, node_name, None)
 
     # (a) existing pods' required anti-affinity vs the incoming pod
     for other in meta.pods:
         for term in pod_affinity_terms(other.affinity, "podAntiAffinity",
                                        required=True):
-            if not term_matches_pod(term, other.namespace,
-                                    ExistingPod(None, namespace, pod_labels,
-                                                node_name, None)):
+            if not term_matches_pod(term, other.namespace, self_pod):
                 continue
             key = term.get("topologyKey")
             if not key:
@@ -168,7 +181,6 @@ def match_interpod_affinity(kube_pod: dict, node_name: str,
             continue
         # first-pod-of-group escape hatch (upstream): nothing in the
         # cluster matches, but the pod matches its own term
-        self_pod = ExistingPod(None, namespace, pod_labels, node_name, None)
         if not matches_anywhere and \
                 term_matches_pod(term, namespace, self_pod) and \
                 key in candidate_labels:
@@ -271,5 +283,18 @@ def reduce_to_priority_scale(raw: dict) -> dict:
 
 
 def pod_declares_interpod_affinity(kube_pod: dict) -> bool:
+    """Any terms at all — gates metadata building (predicate + priority)."""
     affinity = ((kube_pod.get("spec") or {}).get("affinity") or {})
-    return has_required_terms(affinity)
+    return has_any_terms(affinity)
+
+
+def pod_requires_interpod_affinity(kube_pod: dict) -> bool:
+    """REQUIRED terms only — gates equivalence-cache bypass: preferred
+    terms never change a predicate verdict, so preferred-only pods can
+    stay memoized."""
+    affinity = ((kube_pod.get("spec") or {}).get("affinity") or {})
+    for kind in ("podAffinity", "podAntiAffinity"):
+        section = affinity.get(kind) or {}
+        if section.get("requiredDuringSchedulingIgnoredDuringExecution"):
+            return True
+    return False

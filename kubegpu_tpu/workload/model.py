@@ -7,8 +7,10 @@ Design choices map straight to the hardware (see the repo prompt and
   stable accumulation;
 - RoPE with *global* positions computed under GSPMD, so sequence-parallel
   shards agree without communication;
-- attention is either fused causal attention (single shard) or ring
-  attention over the ``seq`` mesh axis (`kubegpu_tpu.workload.ring`);
+- attention is fused causal attention on a single shard, or — over the
+  ``seq`` mesh axis — either ring attention (`kubegpu_tpu.workload.ring`)
+  or Ulysses all-to-all sequence parallelism
+  (`kubegpu_tpu.workload.ulysses`), chosen by ``seq_impl``;
 - SwiGLU FFN, RMSNorm (no mean subtraction — cheaper on VPU);
 - static shapes everywhere; layers run under `lax.scan`-free Python loop
   (n_layers is small and static) so XLA sees straight-line fusible HLO.
@@ -40,6 +42,10 @@ class TransformerConfig:
     # "flash" = Pallas flash kernel (kernels.flash), "auto" = flash on TPU
     # backends when the sequence tiles cleanly, else xla.
     attn_impl: str = "auto"
+    # Sequence-parallel strategy when the mesh's seq axis is >1:
+    # "ring" = K/V ppermute ring (`ring.py`), "ulysses" = all-to-all
+    # head/sequence reshard (`ulysses.py`). Both are exact.
+    seq_impl: str = "ring"
     # Mixture-of-experts FFN: 0 = dense; >0 replaces the FFN with top-1
     # routed experts sharded over the model axis (expert parallelism).
     n_experts: int = 0
@@ -148,8 +154,18 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
 
     def attention_fn(t: int):
         """Resolve the attend callable once the sequence length is known."""
-        impl = _resolve_attn_impl(cfg, t // seq_shards)
+        # Ulysses attends the FULL sequence locally after the all-to-all,
+        # so the flash-tiling decision sees t, not t // seq_shards.
+        local_t = t if cfg.seq_impl == "ulysses" else t // seq_shards
+        impl = _resolve_attn_impl(cfg, local_t)
         interpret = impl == "flash" and jax.default_backend() == "cpu"
+        if use_ring and cfg.seq_impl == "ulysses":
+            from kubegpu_tpu.workload.ulysses import (
+                make_sharded_ulysses_attention)
+
+            return make_sharded_ulysses_attention(
+                mesh, spmd.AXIS_DATA, spmd.AXIS_SEQ, spmd.AXIS_MODEL, scale,
+                use_flash=impl == "flash", interpret=interpret)
         if use_ring:
             return make_sharded_ring_attention(
                 mesh, spmd.AXIS_DATA, spmd.AXIS_SEQ, spmd.AXIS_MODEL, scale,

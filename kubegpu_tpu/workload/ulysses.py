@@ -1,0 +1,88 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The second canonical long-context strategy next to `ring.py` (DeepSpeed-
+Ulysses pattern, public recipe): instead of rotating K/V blocks around the
+sequence axis, one `all_to_all` converts the sequence-sharded layout
+``[B, T/sp, H, D]`` into a head-sharded layout ``[B, T, H/sp, D]``, local
+attention runs over the FULL sequence for the shard's head subset (so the
+Pallas flash kernel applies unchanged), and a second all_to_all restores
+sequence sharding.
+
+Trade-off vs the ring: two all-to-alls of activation size (bisection-
+bandwidth bound, still ICI when the scheduler hands out a contiguous
+sub-mesh) instead of ``sp`` neighbor ppermutes of K/V size, and no
+per-step softmax merging — better for large head counts / short-ish
+sequences, while the ring wins when T is huge and K/V blocks are small.
+The framework offers both; `model.py` picks via config.
+
+Requires the local head count to divide by the sequence-axis size.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def _scatter_heads(x, axis_name: str):
+    """[B, T_local, H, D] -> [B, T_global, H/sp, D]: split the head dim
+    across the axis, gather the sequence dim. Shard order along the axis
+    matches global block order, so concatenation restores the true
+    sequence."""
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _gather_heads(x, axis_name: str):
+    """Inverse: [B, T_global, H/sp, D] -> [B, T_local, H, D]."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, axis_name: str, scale: float,
+                      use_flash: bool = False, interpret: bool = False):
+    """Exact causal attention over the ``axis_name``-sharded sequence.
+
+    q, k, v: per-shard blocks ``[B, T_local, H, D]`` (already RoPE'd with
+    global positions). Returns ``[B, T_local, H, D]``. Matches single-
+    shard causal attention bit-for-bit up to float tolerance.
+    """
+    sp = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % sp != 0:
+        raise ValueError(
+            f"ulysses sequence parallelism needs heads%sp==0, got "
+            f"{h} local heads over sp={sp}; use ring attention instead")
+    qg = _scatter_heads(q, axis_name)
+    kg = _scatter_heads(k, axis_name)
+    vg = _scatter_heads(v, axis_name)
+    if use_flash:
+        from kubegpu_tpu.workload.kernels.flash import flash_attention
+
+        out = flash_attention(qg, kg, vg, scale, interpret=interpret)
+    else:
+        # the single-shard fused attention is the ONE implementation both
+        # seq_impl strategies must match; lazy import avoids a cycle
+        # (model imports this module lazily too)
+        from kubegpu_tpu.workload.model import _causal_attention
+
+        out = _causal_attention(qg, kg, vg, scale)
+    return _gather_heads(out, axis_name)
+
+
+def make_sharded_ulysses_attention(mesh, data_axis: str, seq_axis: str,
+                                   model_axis: str, scale: float,
+                                   use_flash: bool = False,
+                                   interpret: bool = False):
+    """shard_map wrapper mirroring `ring.make_sharded_ring_attention`:
+    same in/out specs, so `model.py` can swap strategies freely."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(data_axis, seq_axis, model_axis, None)
+
+    def fn(q, k, v):
+        return ulysses_attention(q, k, v, seq_axis, scale,
+                                 use_flash=use_flash, interpret=interpret)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)

@@ -159,6 +159,24 @@ def test_preemption_e2e():
     assert not any(p["metadata"]["name"].startswith("low") for p in api.list_pods())
 
 
+def test_preemption_reprieves_cheap_pod():
+    """Reference victim selection (`generic_scheduler.go:226-290`): evict
+    all lower-priority pods, then re-admit highest-priority-first while
+    the preemptor still fits — the 1-chip pod must survive when evicting
+    only the 2-chip pod makes room."""
+    api, hosts, sched = make_cluster()
+    api.create_pod(tpu_pod("p-low", 1, priority=1))
+    api.create_pod(tpu_pod("p-mid", 2, priority=2))
+    sched.run_until_idle()
+    # host has 4 chips: 1 + 2 used, 1 free; preemptor needs 3
+    api.create_pod(tpu_pod("high", 3, priority=10))
+    sched.run_until_idle()
+    assert api.get_pod("high")["spec"]["nodeName"] == "host0"
+    names = {p["metadata"]["name"] for p in api.list_pods()}
+    assert "p-low" in names          # reprieved: evicting p-mid sufficed
+    assert "p-mid" not in names      # the single necessary victim
+
+
 def test_scheduler_restart_rebuilds_from_annotations():
     """The API server is the checkpoint: a new scheduler instance must see
     chips used by bound pods (SURVEY.md §6 checkpoint/resume)."""

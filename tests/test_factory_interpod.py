@@ -348,6 +348,49 @@ def test_policy_empty_lists_fall_back_to_defaults():
         list(factory.DEFAULT_PREDICATE_NAMES)
 
 
+def test_snapshot_carries_images_for_locality():
+    """The slim node snapshot must keep status.images or the image-
+    locality priority silently no-ops in the engine path."""
+    from kubegpu_tpu.scheduler.cache import _slim_node_copy
+
+    mb = 1024 * 1024
+    node = {"metadata": {"name": "n"}, "spec": {},
+            "status": {"images": [{"names": ["repo/model:v1"],
+                                   "sizeBytes": 500 * mb}]}}
+    slim = _slim_node_copy(node)
+    f = priorities.NodeFacts(slim, {}, {}, {})
+    pod = {"spec": {"containers": [{"image": "repo/model:v1"}]}}
+    assert priorities.image_locality(pod, f) > 0.0
+
+
+def test_preferred_only_affinity_keeps_equivalence_cache_warm():
+    """Preferred terms can't flip predicate verdicts: charging such a pod
+    must invalidate only its node; required anti-affinity flushes all."""
+    from kubegpu_tpu.scheduler.cache import SchedulerCache
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    cache = SchedulerCache(ds)
+    for name in ("n0", "n1"):
+        cache.set_node(flat_tpu_node(name))
+    gen_other = cache.equivalence.generation("n1")
+
+    soft = tpu_pod("soft", 1)
+    soft["spec"]["affinity"] = {"podAntiAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution": [
+            {"weight": 1, "podAffinityTerm": required_term({"a": "b"})}]}}
+    cache.add_pod(soft, "n0")
+    assert cache.equivalence.generation("n1") == gen_other  # untouched
+
+    hard = tpu_pod("hard", 1)
+    hard["spec"]["affinity"] = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+        [required_term({"a": "b"})]}}
+    cache.add_pod(hard, "n0")
+    assert cache.equivalence.generation("n1") > gen_other  # flushed
+
+
 # ---- end-to-end through the engine ------------------------------------------
 
 def _cluster(n_nodes=3, zones=("a", "a", "b")):
