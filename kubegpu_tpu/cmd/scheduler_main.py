@@ -38,7 +38,14 @@ def build_scheduler(client, args, config: dict | None = None) -> Scheduler:
     policy = config.get("policy")
     if policy is None and config.get("policyFile"):
         policy = common.load_config(config["policyFile"])
-    algorithm = algorithm_from_policy(policy) if policy else None
+    if policy:
+        algorithm = algorithm_from_policy(policy)
+    elif config.get("algorithmProvider"):
+        from kubegpu_tpu.scheduler.factory import algorithm_provider
+
+        algorithm = algorithm_provider(config["algorithmProvider"])
+    else:
+        algorithm = None
     extenders = load_extenders(config)
     if policy and policy.get("extenders"):
         extenders += load_extenders({"extenders": policy["extenders"]})

@@ -175,6 +175,19 @@ class NodeInfo:
             scorer=dict(self.scorer),
         )
 
+    def shape_key(self) -> tuple:
+        """Hashable fingerprint of everything a fit decision reads —
+        inventory, usage, scorer config — deliberately excluding ``name``:
+        two nodes with equal shape keys give identical (fits, reasons,
+        score) for the same request, which is what lets a uniform fleet
+        share one allocator search (the reference's tree-shape cluster
+        cache idea, `gpu.go:102-183`, applied to the fit pass)."""
+        # zero used-entries are accounting residue (take then return):
+        # a churned node must shape-match a fresh one
+        return (tuple(sorted(self.allocatable.items())),
+                tuple(sorted((k, v) for k, v in self.used.items() if v)),
+                tuple(sorted(self.scorer.items())))
+
     def to_json(self) -> dict:
         out: dict = {}
         if self.name:

@@ -61,6 +61,14 @@ class GenericScheduler:
         # default provider with optional per-priority weight overrides.
         self.algorithm = algorithm or factory.default_algorithm(priority_weights)
         self._last_node_index = 0
+        # Device-verdict shape cache: (node shape_key, pod device class) ->
+        # (fits, reasons, score). A uniform 64-host fleet runs the grpalloc
+        # backtracking search ONCE per pod class instead of once per node —
+        # the reference's tree-shape cluster-cache idea (`gpu.go:102-183`)
+        # applied to the fit pass. No invalidation needed: the key embeds
+        # the node's full allocatable+used state.
+        self._device_verdicts: dict = {}
+        self._device_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
                                         thread_name_prefix="fit")
 
@@ -77,10 +85,29 @@ class GenericScheduler:
             return self.cache.interpod_snapshot()
         return None
 
+    def _pod_info_provider(self, kube_pod: dict):
+        """Parse the pod's device annotation ONCE per scheduling pass and
+        hand out clones per node (same semantics as
+        `cache.pod_info_for_node`, minus the per-node JSON decode — the
+        old shape re-parsed the annotation for every node in the filter).
+        Thread-safe: both variants are parsed eagerly before the parallel
+        workers start; clones are per-call."""
+        base = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
+        inv = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
+
+        def get(node_name: str):
+            return (base if base.node_name == node_name else inv).clone()
+        # exposed so the device-verdict cache can tell WHICH variant a
+        # node evaluates: the pod's annotated node sees the pinned
+        # allocation, everyone else the invalidated one
+        get.pinned_node = base.node_name
+        return get
+
     def _fits_on_node(self, kube_pod: dict, node_name: str,
                       eq_class: str | None = None,
                       out_snaps: dict | None = None,
-                      meta=_AUTO_META):
+                      meta=_AUTO_META, pod_info_get=None,
+                      device_class: str | None = None):
         """The full predicate chain against a point-in-time snapshot so
         concurrent watcher mutations of node usage cannot tear mid-fit.
         Order mirrors the reference providers: cheap node gates first, the
@@ -100,7 +127,9 @@ class GenericScheduler:
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
-        result = self._run_predicates(kube_pod, snap, meta)
+        result = self._run_predicates(
+            kube_pod, snap, meta, pod_info_get,
+            device_class or self._device_class(kube_pod))
         if out_snaps is not None and result[0]:
             # Only feasible nodes are scored; don't pin snapshots of the
             # (typically many) infeasible ones for the whole pass.
@@ -109,16 +138,59 @@ class GenericScheduler:
             self.cache.equivalence.store(node_name, eq_class, result, gen)
         return result
 
-    def _run_predicates(self, kube_pod: dict, snap, meta=None):
+    MAX_DEVICE_VERDICTS = 4096
+
+    @staticmethod
+    def _device_class(kube_pod: dict) -> str:
+        """Identity of a pod's device demand: the raw device annotation
+        (INCLUDING allocate_from, so gang-pinned pods never share entries)
+        plus the container resource blocks. Unlike `equivalence_class`,
+        this must key only what `pod_fits_device` reads."""
+        import hashlib
+        import json as _json
+
+        meta = kube_pod.get("metadata") or {}
+        ann = (meta.get("annotations") or {}).get(codec.POD_ANNOTATION_KEY) or ""
+        spec = kube_pod.get("spec") or {}
+        res = _json.dumps(
+            [(c.get("name"), c.get("resources")) for c in
+             (spec.get("initContainers") or []) + (spec.get("containers") or [])],
+            sort_keys=True, default=str)
+        return hashlib.sha256(f"{ann}|{res}".encode()).hexdigest()
+
+    def _run_predicates(self, kube_pod: dict, snap, meta=None,
+                        pod_info_get=None, device_class: str | None = None):
         ctx = factory.PredicateContext(kube_pod, snap, meta)
         for _name, pred in self.algorithm.predicates:
             ok, reasons = pred(ctx)
             if not ok:
                 return False, reasons, 0.0
-        pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
+        dev_key = None
+        if device_class is not None and pod_info_get is not None:
+            # The verdict depends on WHICH PodInfo variant this node sees:
+            # the pod's annotated node evaluates the pinned allocation,
+            # shape-equal other nodes the invalidated one — the variant
+            # must be part of the key or a retry of a previously-allocated
+            # pod would poison shape-equal nodes with the wrong verdict.
+            pinned_here = pod_info_get.pinned_node == snap.name
+            dev_key = (snap.node_ex.shape_key(), device_class, pinned_here)
+            with self._device_lock:
+                hit = self._device_verdicts.get(dev_key)
+            if hit is not None:
+                return hit
+        if pod_info_get is not None:
+            pod_info = pod_info_get(snap.name)
+        else:
+            pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
         fits, reasons, score = self.device_scheduler.pod_fits_resources(
             pod_info, snap.node_ex, False)
-        return fits, [str(r) for r in reasons], score
+        result = (fits, [str(r) for r in reasons], score)
+        if dev_key is not None:
+            with self._device_lock:
+                if len(self._device_verdicts) >= self.MAX_DEVICE_VERDICTS:
+                    self._device_verdicts.clear()
+                self._device_verdicts[dev_key] = result
+        return result
 
     def find_nodes_that_fit(self, kube_pod: dict):
         """Parallel filter over all nodes (`generic_scheduler.go:310-383`),
@@ -134,10 +206,13 @@ class GenericScheduler:
         eq_class = None if interpod.pod_requires_interpod_affinity(kube_pod) \
             else equivalence_class(kube_pod)
         meta = self._interpod_meta(kube_pod)
+        pod_info_get = self._pod_info_provider(kube_pod)
+        device_class = self._device_class(kube_pod)
         snaps: dict = {}
         results = list(self._pool.map(
             lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps,
-                                              meta)),
+                                              meta, pod_info_get,
+                                              device_class)),
             names))
         feasible = {n: score for n, ok, _, score in results if ok}
         failures = {n: reasons for n, ok, reasons, _ in results if not ok}

@@ -362,6 +362,33 @@ class PolicyError(ValueError):
     pass
 
 
+def cluster_autoscaler_algorithm() -> AlgorithmConfig:
+    """ClusterAutoscalerProvider (`defaults.go`): the default set with
+    LeastRequestedPriority swapped for MostRequestedPriority — pack nodes
+    tight so the autoscaler can drain and remove empties."""
+    algo = default_algorithm()
+    algo.priorities = [
+        ("MostRequestedPriority", w, PRIORITIES["MostRequestedPriority"](None))
+        if name == "LeastRequestedPriority" else (name, w, fn)
+        for name, w, fn in algo.priorities]
+    return algo
+
+
+ALGORITHM_PROVIDERS = {
+    "DefaultProvider": default_algorithm,
+    "ClusterAutoscalerProvider": cluster_autoscaler_algorithm,
+}
+
+
+def algorithm_provider(name: str | None) -> AlgorithmConfig:
+    """Look up a registered provider by name (None -> DefaultProvider),
+    like the factory's GetAlgorithmProvider."""
+    build = ALGORITHM_PROVIDERS.get(name or "DefaultProvider")
+    if build is None:
+        raise PolicyError(f"unknown algorithm provider {name!r}")
+    return build()
+
+
 def algorithm_from_policy(policy: dict) -> AlgorithmConfig:
     """Compose from a reference-style Policy document
     (`kube-scheduler/pkg/api/types.go`):

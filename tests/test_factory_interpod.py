@@ -348,6 +348,85 @@ def test_policy_empty_lists_fall_back_to_defaults():
         list(factory.DEFAULT_PREDICATE_NAMES)
 
 
+def test_algorithm_providers():
+    algo = factory.algorithm_provider("ClusterAutoscalerProvider")
+    names = {n for n, _, _ in algo.priorities}
+    assert "MostRequestedPriority" in names
+    assert "LeastRequestedPriority" not in names
+    default = factory.algorithm_provider(None)
+    assert "LeastRequestedPriority" in {n for n, _, _ in default.priorities}
+    with pytest.raises(factory.PolicyError):
+        factory.algorithm_provider("NoSuchProvider")
+
+
+def test_device_verdict_cache_keys_on_shape_and_usage():
+    """Two same-shape nodes share one allocator verdict; a usage change
+    produces a different shape key (so no invalidation is needed)."""
+    from kubegpu_tpu.core import codec as _codec
+
+    api = InMemoryAPIServer()
+    for i in range(2):
+        api.create_node(flat_tpu_node(f"host{i}"))
+    sched = make_scheduler(api)
+    s0 = sched.cache.snapshot_node("host0")
+    s1 = sched.cache.snapshot_node("host1")
+    assert s0.node_ex.shape_key() == s1.node_ex.shape_key()
+
+    api.create_pod(tpu_pod("p0", 2))
+    sched.run_until_idle()
+    assert api.get_pod("p0")["spec"].get("nodeName")
+    # the fit pass populated the verdict cache, one entry per shape
+    assert len(sched.generic._device_verdicts) >= 1
+    bound = api.get_pod("p0")["spec"]["nodeName"]
+    other = "host1" if bound == "host0" else "host0"
+    sb = sched.cache.snapshot_node(bound)
+    so = sched.cache.snapshot_node(other)
+    assert sb.node_ex.shape_key() != so.node_ex.shape_key()  # usage differs
+
+
+def test_device_cache_distinguishes_pinned_variant():
+    """A retried pod still carrying its old allocation annotation must not
+    poison shape-equal nodes: the annotated node evaluates the PINNED
+    allocation (now taken), other nodes the invalidated variant."""
+    import copy
+
+    api = InMemoryAPIServer()
+    for i in range(2):
+        api.create_node(flat_tpu_node(f"host{i}", chips=2))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("p0", 2))
+    sched.run_until_idle()
+    bound = api.get_pod("p0")["spec"]["nodeName"]
+    other = "host1" if bound == "host0" else "host0"
+
+    # craft the retry pod: same allocation annotation (its chips are now
+    # used by p0 on the bound node), as a failed bind would leave behind
+    q = copy.deepcopy(api.get_pod("p0"))
+    q["metadata"]["name"] = "q"
+    q["spec"].pop("nodeName", None)
+    gen = sched.generic
+    provider = gen._pod_info_provider(q)
+    dc = gen._device_class(q)
+    # annotated node first — would poison a variant-blind cache
+    r_bound = gen._fits_on_node(q, bound, None, None, None, provider, dc)
+    r_other = gen._fits_on_node(q, other, None, None, None, provider, dc)
+    assert not r_bound[0]   # pinned chips are taken
+    assert r_other[0]       # free search on the other node succeeds
+
+    # the collision case: a FAILED bind leaves the annotation but charges
+    # nothing, so the annotated node is shape-equal to the rest — the two
+    # PodInfo variants must still get separate cache entries
+    api.delete_pod("p0")
+    sched.run_until_idle()
+    assert sched.cache.snapshot_node(bound).node_ex.shape_key() == \
+        sched.cache.snapshot_node(other).node_ex.shape_key()
+    gen._device_verdicts.clear()
+    r_bound = gen._fits_on_node(q, bound, None, None, None, provider, dc)
+    r_other = gen._fits_on_node(q, other, None, None, None, provider, dc)
+    assert r_bound[0] and r_other[0]
+    assert {k[2] for k in gen._device_verdicts} == {True, False}
+
+
 def test_snapshot_carries_images_for_locality():
     """The slim node snapshot must keep status.images or the image-
     locality priority silently no-ops in the engine path."""
