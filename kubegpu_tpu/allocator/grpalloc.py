@@ -392,7 +392,19 @@ def pod_fits_group_constraints(
     Returns ``(fits, failure_reasons, score)``; the score is the last
     running container's whole-node packing score, which already reflects
     every earlier allocation.
+
+    Dispatches to the native C++ core (`native/grpalloc.cpp`) when built;
+    this Python implementation is the semantic reference and the fallback.
     """
+    result = _native_pod_fits(node, pod, allocating)
+    if result is not None:
+        return result
+    return _pod_fits_group_constraints_py(node, pod, allocating)
+
+
+def _pod_fits_group_constraints_py(
+    node: NodeInfo, pod: PodInfo, allocating: bool
+) -> tuple[bool, list, float]:
     pod_resource: dict = {}
     node_resource = dict(node.used)
     used_groups: dict = {}
@@ -421,6 +433,116 @@ def pod_fits_group_constraints(
             node_resource = grp.node_resource
 
     return found, fails, total_score
+
+
+# ---- native dispatch (`native/grpalloc.cpp`) --------------------------------
+
+
+def _resolved_scorer_kind(res: str, scorer_type: int) -> int:
+    """Map a (resource, scorer enum) pair onto the native core's resolved
+    kinds: 0 leftover, 1 enum, -1 none/unresolvable."""
+    fn = scorers.scorer_for(res, scorer_type)
+    if fn is scorers.leftover_score:
+        return 0
+    if fn is scorers.enum_score:
+        return 1
+    return -1
+
+
+def _native_pod_fits(node: NodeInfo, pod: PodInfo, allocating: bool):
+    """Marshal to the native allocator; returns (fits, reasons, score) or
+    None to fall back to Python (library missing, unresolvable scorer,
+    or any native error)."""
+    from kubegpu_tpu import native
+
+    if native.get_lib() is None or not hasattr(native.get_lib(), "grp_allocate"):
+        return None
+    def _unsafe(token: str) -> bool:
+        # The line protocol is whitespace-delimited: any token with
+        # whitespace (possible — pod annotations are user-writable) would
+        # inject lines and silently diverge from the Python reference.
+        return any(ch.isspace() for ch in token)
+
+    try:
+        lines = []
+        for res in sorted_keys(node.allocatable):
+            if grammar.prechecked_resource(res):
+                continue
+            if _unsafe(res):
+                return None
+            kind = _resolved_scorer_kind(
+                res, node.scorer.get(res, scorers.DEFAULT_SCORER))
+            if kind < 0:
+                return None  # exotic scorer config: keep Python semantics
+            lines.append(f"A {res} {node.allocatable[res]} {kind}")
+        for res in sorted_keys(node.used):
+            if grammar.prechecked_resource(res):
+                continue
+            if _unsafe(res):
+                return None
+            lines.append(f"U {res} {node.used[res]}")
+
+        ordered = []
+        for phase_conts, is_init in ((pod.running_containers, False),
+                                     (pod.init_containers, True)):
+            for cont_name in sorted_keys(phase_conts):
+                ordered.append((cont_name, phase_conts[cont_name], is_init))
+        search_order = []  # (cont object) per emitted search-mode container
+        for cont_name, cont, is_init in ordered:
+            if _unsafe(cont_name):
+                return None
+            required = {res: val for res, val in cont.dev_requests.items()
+                        if not grammar.prechecked_resource(res)}
+            rescore = bool(cont.allocate_from) or not required
+            lines.append(f"C {cont_name} {int(is_init)} {int(rescore)}")
+            if not rescore:
+                search_order.append(cont)
+            for res in sorted_keys(required):
+                if _unsafe(res):
+                    return None
+                override = -1
+                if res in cont.scorer:
+                    override = _resolved_scorer_kind(res, cont.scorer[res])
+                lines.append(f"R {res} {required[res]} {override}")
+            if rescore:
+                for req in sorted_keys(cont.allocate_from):
+                    alloc = cont.allocate_from[req]
+                    if _unsafe(req) or _unsafe(alloc):
+                        return None
+                    lines.append(f"F {req} {alloc}")
+        lines.append("E")
+
+        reply = native.native_grp_allocate("\n".join(lines) + "\n")
+    except RuntimeError:
+        return None
+
+    fits, score = True, 0.0
+    reasons: list = []
+    # The core emits one C block per search-mode container, in input
+    # order — match positionally, NOT by name (a running and an init
+    # container may legally share a name).
+    placements: list = []
+    current: dict | None = None
+    for line in reply.splitlines():
+        parts = line.split(" ")
+        if parts[0] == "FITS":
+            fits = parts[1] == "1"
+        elif parts[0] == "SCORE":
+            score = float(parts[1])
+        elif parts[0] == "C":
+            current = {}
+            placements.append(current)
+        elif parts[0] == "F" and current is not None:
+            current[parts[1]] = parts[2]
+        elif parts[0] == "REASON":
+            reasons.append(InsufficientResourceError(
+                parts[1], int(parts[2]), int(parts[3]), int(parts[4])))
+    if len(placements) != len(search_order):
+        return None  # protocol desync: keep Python semantics
+    if allocating:
+        for cont, alloc_from in zip(search_order, placements):
+            cont.allocate_from = dict(alloc_from)
+    return fits, reasons, score
 
 
 def pod_clear_allocate_from(pod: PodInfo) -> None:

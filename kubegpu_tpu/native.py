@@ -57,6 +57,13 @@ def get_lib():
             ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int)]
         lib.tpu_find_contiguous_block.restype = ctypes.c_int
+        try:
+            lib.grp_allocate.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+            lib.grp_allocate.restype = ctypes.c_int
+            lib.grp_last_error.restype = ctypes.c_char_p
+        except AttributeError:
+            pass  # stale library without the allocator core
         _lib = lib
     except OSError:
         _lib = None
@@ -75,6 +82,27 @@ def native_enumerate(sysfs_root: str) -> dict:
         raise RuntimeError(
             f"tpu_enumerate failed: {lib.tpu_last_error().decode()}")
     return json.loads(buf.value.decode())
+
+
+def native_grp_allocate(payload: str) -> str:
+    """Run the native group-allocation search. ``payload``/result use the
+    line protocol documented in `native/grpalloc.cpp`. Raises RuntimeError
+    when the library is missing or the call fails (callers fall back to
+    the Python implementation)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "grp_allocate"):
+        raise RuntimeError("native allocator not built (make -C native)")
+    cap = max(1 << 16, 4 * len(payload) + 4096)
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.grp_allocate(payload.encode(), buf, cap)
+    if n == -2:  # output larger than the buffer: retry once, bigger
+        cap *= 16
+        buf = ctypes.create_string_buffer(cap)
+        n = lib.grp_allocate(payload.encode(), buf, cap)
+    if n < 0:
+        raise RuntimeError(
+            f"grp_allocate failed: {lib.grp_last_error().decode()}")
+    return buf.value.decode()
 
 
 def native_find_contiguous_block(dims, wrap, free_coords, count):

@@ -1,0 +1,533 @@
+// Hierarchical group-allocation search: native core.
+//
+// A 1:1 port of the backtracking search in
+// kubegpu_tpu/allocator/grpalloc.py (itself re-implementing the
+// reference's device-scheduler/grpalloc/grpallocate.go). The Python
+// implementation remains the semantic reference; this core is
+// differentially tested against it (tests/test_native.py) and must match
+// bit-for-bit: same sorted iteration order (std::map == Python sorted()
+// for ASCII paths), same IEEE operation order in the scorers, same
+// tie-breaking (>=, prefer-used) in the search.
+//
+// Wire protocol (line-based, space-separated; resource paths contain no
+// whitespace by grammar):
+//   in : A <path> <value> <scorer 0=leftover|1=enum>    allocatable
+//        U <path> <value>                               node used
+//        C <name> <init 0|1> <mode 0=search|1=rescore>  container (in order)
+//        R <path> <value> <override -1|0|1>             dev request
+//        F <reqpath> <allocpath>                        existing allocate_from
+//        E                                              end
+//   out: FITS <0|1> / SCORE <%.17g> / C <name> / F <req> <alloc> /
+//        REASON <name> <requested> <used> <capacity>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct ScoreResult {
+    bool found;
+    double score;
+    long long used_cont, new_pod, new_node;
+};
+
+// leftover_score (scorers.py:51-70): packing score, init max-not-sum.
+ScoreResult leftover_score(long long alloc, long long pod, long long node,
+                           const std::vector<long long>& req, bool init) {
+    long long total = 0;
+    for (long long r : req) total += r;
+    long long new_pod = init ? std::max(pod, total) : pod + total;
+    long long new_node = node + (new_pod - pod);
+    long long left = alloc - new_node;
+    double score =
+        alloc != 0 ? 1.0 - (static_cast<double>(left) / static_cast<double>(alloc))
+                   : 0.0;
+    return {left >= 0, score, total, new_pod, new_node};
+}
+
+int popcount64(unsigned long long v) {
+    int n = 0;
+    while (v) { v &= v - 1; ++n; }
+    return n;
+}
+
+// enum_score (scorers.py:87-104): bitmask attributes, never consumed.
+ScoreResult enum_score(long long alloc, long long pod, long long /*node*/,
+                       const std::vector<long long>& req, bool /*init*/) {
+    long long total = 0;
+    for (long long r : req) total |= r;
+    long long used_mask = alloc & (pod | total);
+    int ba = popcount64(static_cast<unsigned long long>(alloc));
+    int bu = popcount64(static_cast<unsigned long long>(used_mask));
+    double score = ba ? 1.0 - static_cast<double>(ba - bu) / ba : 0.0;
+    bool found = total != 0 ? (alloc & total) != 0 : true;
+    return {found, score, total, used_mask, 0};
+}
+
+ScoreResult run_scorer(int kind, long long alloc, long long pod, long long node,
+                       const std::vector<long long>& req, bool init) {
+    return kind == 1 ? enum_score(alloc, pod, node, req, init)
+                     : leftover_score(alloc, pod, node, req, init);
+}
+
+struct Reason {
+    std::string name;
+    long long requested = 0, used = 0, capacity = 0;
+};
+
+using StrMap = std::map<std::string, std::string>;
+using NumMap = std::map<std::string, long long>;
+
+struct Container {
+    std::string name;
+    bool init = false;
+    bool rescore = false;
+    NumMap required;                 // global req path -> amount
+    std::map<std::string, int> req_scorer;  // override (-1 = none)
+    StrMap allocate_from;            // pre-set placements (rescore mode)
+};
+
+struct Problem {
+    NumMap alloc;
+    std::map<std::string, int> alloc_scorer;
+    NumMap used;
+    std::vector<Container> containers;
+};
+
+struct Ctx {
+    std::string cont_name;
+    bool init = false;
+    bool prefer_used = true;
+    const NumMap* required = nullptr;
+    const std::map<std::string, int>* req_scorer = nullptr;
+    const NumMap* alloc = nullptr;
+    const std::map<std::string, int>* alloc_scorer = nullptr;
+    std::map<std::string, bool>* used_groups = nullptr;
+};
+
+// _find_subgroups (grpalloc.py:50-66): split paths as
+// base/<name>/<index>/<rest> — name and index are single segments, rest
+// may contain '/'. Requires base + at least three further segments.
+void find_subgroups(
+    const std::string& base, const StrMap& grp,
+    std::map<std::string, std::map<std::string, StrMap>>* subgroups,
+    std::map<std::string, bool>* is_subgroup) {
+    const std::string prefix = base + "/";
+    for (const auto& [local_key, global_path] : grp) {
+        bool matched = false;
+        if (global_path.rfind(prefix, 0) == 0) {
+            std::string rest0 = global_path.substr(prefix.size());
+            size_t s1 = rest0.find('/');
+            if (s1 != std::string::npos) {
+                size_t s2 = rest0.find('/', s1 + 1);
+                if (s2 != std::string::npos) {
+                    std::string name = rest0.substr(0, s1);
+                    std::string index = rest0.substr(s1 + 1, s2 - s1 - 1);
+                    std::string rest = rest0.substr(s2 + 1);
+                    (*subgroups)[name][index][rest] = global_path;
+                    matched = true;
+                }
+            }
+        }
+        (*is_subgroup)[local_key] = matched;
+    }
+}
+
+// _GrpAllocator (grpalloc.py:92-315): one level of the recursive search.
+// Mutable state has value semantics — copying the struct IS _clone().
+struct Grp {
+    Ctx* ctx;
+    const StrMap* grp_required;                    // local -> global req
+    const std::map<std::string, StrMap>* grp_alloc;  // location -> local -> global
+    std::string req_base, alloc_base_prefix;
+    StrMap allocate_from;
+    NumMap pod_res, node_res;
+    double score = 0.0;
+    std::map<std::string, bool> is_req_subgrp;
+
+    void take(Grp&& other) {
+        allocate_from = std::move(other.allocate_from);
+        pod_res = std::move(other.pod_res);
+        node_res = std::move(other.node_res);
+        score = other.score;
+    }
+
+    // _resource_available (grpalloc.py:141-175)
+    bool resource_available(const std::string& location,
+                            std::vector<Reason>* fails) {
+        static const StrMap kEmpty;
+        auto it = grp_alloc->find(location);
+        const StrMap& loc_alloc = it == grp_alloc->end() ? kEmpty : it->second;
+        bool found = true;
+        for (const auto& [req_key, req_global] : *grp_required) {
+            auto sub_it = is_req_subgrp.find(req_key);
+            if (sub_it != is_req_subgrp.end() && sub_it->second) continue;
+            long long required = 0;
+            auto rit = ctx->required->find(req_global);
+            if (rit != ctx->required->end()) required = rit->second;
+            auto lit = loc_alloc.find(req_key);
+            if (lit == loc_alloc.end()) {
+                found = false;
+                fails->push_back({ctx->cont_name + "/" + req_global,
+                                  required, 0, 0});
+                continue;
+            }
+            const std::string& global_name = lit->second;
+            int kind = -1;
+            auto oit = ctx->req_scorer->find(req_global);
+            if (oit != ctx->req_scorer->end() && oit->second >= 0)
+                kind = oit->second;
+            if (kind < 0) kind = ctx->alloc_scorer->at(global_name);
+            long long allocatable = ctx->alloc->at(global_name);
+            long long used_node = 0, used_pod = 0;
+            auto nit = node_res.find(global_name);
+            if (nit != node_res.end()) used_node = nit->second;
+            auto pit = pod_res.find(global_name);
+            if (pit != pod_res.end()) used_pod = pit->second;
+            ScoreResult r = run_scorer(kind, allocatable, used_pod, used_node,
+                                       {required}, ctx->init);
+            if (!r.found) {
+                found = false;
+                fails->push_back({ctx->cont_name + "/" + req_global,
+                                  required, used_node, allocatable});
+                continue;
+            }
+            pod_res[global_name] = r.new_pod;
+            node_res[global_name] = r.new_node;
+            allocate_from[req_global] = global_name;
+        }
+        return found;
+    }
+
+    // _allocate_subgroups (grpalloc.py:177-203)
+    bool allocate_subgroups(
+        const std::string& location,
+        const std::map<std::string, std::map<std::string, StrMap>>& subgrps_req,
+        const std::map<std::string, std::map<std::string, StrMap>>& subgrps_alloc,
+        std::vector<Reason>* fails) {
+        bool found = true;
+        for (const auto& [name, by_index] : subgrps_req) {
+            static const std::map<std::string, StrMap> kEmptyAlloc;
+            auto ait = subgrps_alloc.find(name);
+            const std::map<std::string, StrMap>& sub_alloc =
+                ait == subgrps_alloc.end() ? kEmptyAlloc : ait->second;
+            for (const auto& [index, req_map] : by_index) {
+                Grp sub{ctx,
+                        &req_map,
+                        &sub_alloc,
+                        req_base + "/" + name + "/" + index,
+                        alloc_base_prefix + "/" + location + "/" + name,
+                        allocate_from,
+                        pod_res,
+                        node_res,
+                        0.0,
+                        {}};
+                std::vector<Reason> reasons;
+                bool ok = sub.allocate_group(&reasons);
+                if (!ok) {
+                    found = false;
+                    fails->push_back({ctx->cont_name + "/" + sub.req_base,
+                                      0, 0, 0});
+                    fails->insert(fails->end(), reasons.begin(), reasons.end());
+                    continue;
+                }
+                take(std::move(sub));
+            }
+        }
+        return found;
+    }
+
+    // _find_score_and_update (grpalloc.py:205-245)
+    bool find_score_and_update(const std::string& location,
+                               std::vector<Reason>* fails) {
+        bool found = true;
+        std::map<std::string, std::vector<long long>> requested;
+        for (const auto& [req_key, req_global] : *grp_required) {
+            (void)req_key;
+            std::string alloc_from;
+            auto ait = allocate_from.find(req_global);
+            if (ait != allocate_from.end()) alloc_from = ait->second;
+            long long required = 0;
+            auto rit = ctx->required->find(req_global);
+            if (rit != ctx->required->end()) required = rit->second;
+            if (ctx->alloc->find(alloc_from) == ctx->alloc->end()) {
+                found = false;
+                fails->push_back({req_global, required, 0, 0});
+                continue;
+            }
+            requested[alloc_from].push_back(required);
+        }
+        score = 0.0;
+        static const StrMap kEmpty;
+        auto lit = grp_alloc->find(location);
+        const StrMap& loc_resources = lit == grp_alloc->end() ? kEmpty : lit->second;
+        for (const auto& [key, global_name] : loc_resources) {
+            (void)key;
+            long long allocatable = ctx->alloc->at(global_name);
+            int kind = ctx->alloc_scorer->at(global_name);
+            long long used_node = 0, used_pod = 0;
+            auto nit = node_res.find(global_name);
+            if (nit != node_res.end()) used_node = nit->second;
+            auto pit = pod_res.find(global_name);
+            if (pit != pod_res.end()) used_pod = pit->second;
+            static const std::vector<long long> kNone;
+            auto qit = requested.find(global_name);
+            const std::vector<long long>& reqs =
+                qit == requested.end() ? kNone : qit->second;
+            ScoreResult r = run_scorer(kind, allocatable, used_pod, used_node,
+                                       reqs, ctx->init);
+            if (!r.found) {
+                found = false;
+                fails->push_back({global_name, r.used_cont, used_node,
+                                  allocatable});
+                continue;
+            }
+            score += r.score;
+            pod_res[global_name] = r.new_pod;
+            node_res[global_name] = r.new_node;
+        }
+        if (!loc_resources.empty())
+            score /= static_cast<double>(loc_resources.size());
+        return found;
+    }
+
+    // _allocate_group_at (grpalloc.py:247-267)
+    bool allocate_group_at(
+        const std::string& location,
+        const std::map<std::string, std::map<std::string, StrMap>>& subgrps_req,
+        std::vector<Reason>* fails) {
+        std::string location_name = alloc_base_prefix + "/" + location;
+        static const StrMap kEmpty;
+        auto lit = grp_alloc->find(location);
+        const StrMap& loc_resources = lit == grp_alloc->end() ? kEmpty : lit->second;
+        std::map<std::string, std::map<std::string, StrMap>> subgrps_alloc;
+        std::map<std::string, bool> ignore;
+        find_subgroups(location_name, loc_resources, &subgrps_alloc, &ignore);
+
+        // saved copies for the reset discipline (clone -> charge -> reset)
+        NumMap saved_pod = pod_res, saved_node = node_res;
+        double saved_score = score;
+        bool found_res = resource_available(location, fails);
+        std::vector<Reason> fails_next;
+        bool found_next =
+            allocate_subgroups(location, subgrps_req, subgrps_alloc, &fails_next);
+        if (found_res && found_next) {
+            pod_res = std::move(saved_pod);
+            node_res = std::move(saved_node);
+            score = saved_score;
+            std::vector<Reason> fails_score;
+            bool found_score = find_score_and_update(location, &fails_score);
+            if (!found_score) {
+                found_next = false;
+                fails_next.insert(fails_next.end(), fails_score.begin(),
+                                  fails_score.end());
+            }
+        }
+        fails->insert(fails->end(), fails_next.begin(), fails_next.end());
+        return found_res && found_next;
+    }
+
+    // allocate_group (grpalloc.py:269-315): branch-and-keep-best.
+    bool allocate_group(std::vector<Reason>* fails) {
+        if (grp_required->empty()) return true;
+
+        std::map<std::string, std::map<std::string, StrMap>> subgrps_req;
+        is_req_subgrp.clear();
+        find_subgroups(req_base, *grp_required, &subgrps_req, &is_req_subgrp);
+
+        bool have_best = false;
+        Grp best{};
+        double best_score = score;
+        bool best_is_used = false;
+        std::string best_name;
+        bool any_find = false;
+
+        for (const auto& [location, unused] : *grp_alloc) {
+            (void)unused;
+            Grp cand = *this;  // _clone()
+            std::vector<Reason> reasons;
+            bool found = cand.allocate_group_at(location, subgrps_req, &reasons);
+            std::string location_name = alloc_base_prefix + "/" + location;
+            if (found) {
+                bool cand_is_used = false;
+                auto uit = ctx->used_groups->find(location_name);
+                if (uit != ctx->used_groups->end()) cand_is_used = uit->second;
+                bool take_new;
+                if (!ctx->prefer_used)
+                    take_new = cand.score >= best_score;
+                else if (best_is_used)
+                    take_new = cand_is_used && cand.score >= best_score;
+                else
+                    take_new = cand_is_used || cand.score >= best_score;
+                if (take_new) {
+                    any_find = true;
+                    have_best = true;
+                    best = std::move(cand);
+                    best_score = best.score;
+                    best_is_used = cand_is_used;
+                    best_name = location_name;
+                }
+            } else if (grp_alloc->size() == 1) {
+                fails->insert(fails->end(), reasons.begin(), reasons.end());
+            }
+        }
+        if (have_best) take(std::move(best));
+        if (any_find) {
+            (*ctx->used_groups)[best_name] = true;
+            return true;
+        }
+        return false;
+    }
+};
+
+// _container_fits_group_constraints + pod_fits_group_constraints
+// (grpalloc.py:318-423)
+struct Output {
+    bool fits = true;
+    double score = 0.0;
+    std::vector<Reason> reasons;
+    std::vector<std::pair<std::string, StrMap>> allocations;  // per container
+};
+
+Output solve(const Problem& prob) {
+    Output out;
+    NumMap pod_res;
+    NumMap node_res = prob.used;
+    std::map<std::string, bool> used_groups;
+
+    const std::string kPrefix = "alpha/grpresource";
+    std::string grp_prefix = "alpha";
+    std::string grp_name = "grpresource";
+
+    for (const auto& cont : prob.containers) {
+        StrMap top_location;
+        for (const auto& [res, val] : prob.alloc) {
+            (void)val;
+            top_location[res] = res;
+        }
+        StrMap grp_required;
+        for (const auto& [res, val] : cont.required) {
+            (void)val;
+            grp_required[res] = res;
+        }
+        std::map<std::string, StrMap> grp_alloc;
+        grp_alloc[grp_name] = std::move(top_location);
+
+        Ctx ctx;
+        ctx.cont_name = cont.name;
+        ctx.init = cont.init;
+        ctx.prefer_used = true;
+        ctx.required = &cont.required;
+        ctx.req_scorer = &cont.req_scorer;
+        ctx.alloc = &prob.alloc;
+        ctx.alloc_scorer = &prob.alloc_scorer;
+        ctx.used_groups = &used_groups;
+
+        Grp grp{&ctx,    &grp_required, &grp_alloc, kPrefix,
+                grp_prefix, {},          pod_res,    node_res,
+                0.0,     {}};
+
+        std::vector<Reason> reasons;
+        bool found;
+        if (!cont.rescore) {
+            found = grp.allocate_group(&reasons);
+        } else {
+            grp.allocate_from = cont.allocate_from;
+            found = grp.find_score_and_update(grp_name, &reasons);
+        }
+        if (!found) {
+            out.fits = false;
+            out.reasons.insert(out.reasons.end(), reasons.begin(),
+                               reasons.end());
+        } else if (!cont.init) {
+            out.score = grp.score;
+        }
+        if (!cont.rescore)
+            out.allocations.emplace_back(cont.name, grp.allocate_from);
+        pod_res = std::move(grp.pod_res);
+        node_res = std::move(grp.node_res);
+    }
+    return out;
+}
+
+thread_local std::string g_grp_error;
+
+}  // namespace
+
+extern "C" int grp_allocate(const char* input, char* out_buf, int out_cap) {
+    Problem prob;
+    std::istringstream in(input);
+    std::string line;
+    Container* cur = nullptr;
+    while (std::getline(in, line)) {
+        if (line.empty()) continue;
+        std::istringstream ls(line);
+        std::string tag;
+        ls >> tag;
+        if (tag == "A") {
+            std::string path; long long val; int sc;
+            ls >> path >> val >> sc;
+            prob.alloc[path] = val;
+            prob.alloc_scorer[path] = sc;
+        } else if (tag == "U") {
+            std::string path; long long val;
+            ls >> path >> val;
+            prob.used[path] = val;
+        } else if (tag == "C") {
+            prob.containers.emplace_back();
+            cur = &prob.containers.back();
+            int init, mode;
+            ls >> cur->name >> init >> mode;
+            cur->init = init != 0;
+            cur->rescore = mode != 0;
+        } else if (tag == "R") {
+            if (!cur) { g_grp_error = "R before C"; return -1; }
+            std::string path; long long val; int ov;
+            ls >> path >> val >> ov;
+            cur->required[path] = val;
+            cur->req_scorer[path] = ov;
+        } else if (tag == "F") {
+            if (!cur) { g_grp_error = "F before C"; return -1; }
+            std::string req, alloc;
+            ls >> req >> alloc;
+            cur->allocate_from[req] = alloc;
+        } else if (tag == "E") {
+            break;
+        } else {
+            g_grp_error = "unknown tag: " + tag;
+            return -1;
+        }
+        if (ls.fail()) { g_grp_error = "parse error: " + line; return -1; }
+    }
+
+    Output result = solve(prob);
+
+    std::ostringstream os;
+    os << "FITS " << (result.fits ? 1 : 0) << "\n";
+    char fbuf[64];
+    std::snprintf(fbuf, sizeof(fbuf), "%.17g", result.score);
+    os << "SCORE " << fbuf << "\n";
+    for (const auto& [name, af] : result.allocations) {
+        os << "C " << name << "\n";
+        for (const auto& [req, alloc] : af)
+            os << "F " << req << " " << alloc << "\n";
+    }
+    for (const auto& r : result.reasons)
+        os << "REASON " << r.name << " " << r.requested << " " << r.used
+           << " " << r.capacity << "\n";
+    std::string s = os.str();
+    if (static_cast<int>(s.size()) + 1 > out_cap) {
+        g_grp_error = "output buffer too small";
+        return -2;
+    }
+    std::memcpy(out_buf, s.c_str(), s.size() + 1);
+    return static_cast<int>(s.size());
+}
+
+extern "C" const char* grp_last_error() { return g_grp_error.c_str(); }

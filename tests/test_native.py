@@ -104,3 +104,154 @@ def test_contig_large_slice(lib):
     expected = _python_reference_block(mesh, mesh.chips, 64)
     assert got == expected
     assert len(got) == 64
+
+
+# ---- group-allocator core (native/grpalloc.cpp) -----------------------------
+
+
+def _random_problem(rng):
+    """Random hierarchical inventory + pod: 1-3 topology levels, chips/hbm
+    leaves, optional enum attributes, pre-existing usage, and 1-3
+    containers (running + init) with varied requests."""
+    from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+
+    G = "alpha/grpresource"
+    depth = rng.choice([0, 1, 2])
+    node = NodeInfo(name="n")
+    leaf_prefixes = []
+    def build(prefix, level):
+        if level == depth:
+            for d in range(rng.randint(1, 4)):
+                p = f"{prefix}/tpu/d{d}"
+                node.allocatable[f"{p}/chips"] = 1
+                node.allocatable[f"{p}/hbm"] = rng.choice([100, 200])
+                if rng.random() < 0.3:
+                    node.allocatable[f"{p}/enumLinks"] = rng.randint(1, 15)
+                leaf_prefixes.append(p)
+            return
+        for i in range(rng.randint(1, 2)):
+            build(f"{prefix}/tpugrp{depth - 1 - level}/{i}", level + 1)
+    build(G, 0)
+    for p in leaf_prefixes:
+        if rng.random() < 0.25:
+            node.used[f"{p}/chips"] = 1
+
+    pod = PodInfo(name="p")
+    n_cont = rng.randint(1, 2)
+    for ci in range(n_cont):
+        n_chips = rng.randint(1, max(1, len(leaf_prefixes)))
+        reqs = {}
+        chosen = rng.sample(leaf_prefixes, min(n_chips, len(leaf_prefixes)))
+        for j, p in enumerate(chosen):
+            # request paths use their own indices: the allocator matches by
+            # name pattern, not by literal path
+            parts = p[len(G) + 1:].split("/")
+            req_prefix = G
+            # group levels keep their names with (sometimes) renumbered
+            # indices; the LEAF index becomes r{j} — the request must stay
+            # structurally matchable against the inventory (same depth)
+            for k in range(0, len(parts) - 2, 2):
+                req_prefix += f"/{parts[k]}/{j if rng.random() < 0.5 else parts[k + 1]}"
+            req_prefix += f"/{parts[-2]}/r{j}"
+            reqs[f"{req_prefix}/chips"] = 1
+            if rng.random() < 0.6:
+                reqs[f"{req_prefix}/hbm"] = rng.choice([50, 100])
+            if rng.random() < 0.2:
+                reqs[f"{req_prefix}/enumLinks"] = rng.randint(1, 15)
+        cont = ContainerInfo(dev_requests=reqs)
+        if ci == 0 or rng.random() < 0.7:
+            pod.running_containers[f"c{ci}"] = cont
+        else:
+            pod.init_containers[f"c{ci}"] = cont
+    return node, pod
+
+
+def test_grpalloc_differential_randomized(lib):
+    """Native allocator == Python reference on random problems: same fits,
+    same score (bit-for-bit), same placements, same reason multiset."""
+    from kubegpu_tpu.allocator import grpalloc
+
+    rng = random.Random(11)
+    checked = 0
+    for trial in range(120):
+        node, pod = _random_problem(rng)
+        import copy
+
+        pod_py = copy.deepcopy(pod)
+        node_py = node.clone()
+        got = grpalloc._native_pod_fits(node, pod, True)
+        assert got is not None, "native path unavailable"
+        want = grpalloc._pod_fits_group_constraints_py(node_py, pod_py, True)
+        assert got[0] == want[0], f"trial {trial}: fits {got[0]} != {want[0]}"
+        assert got[2] == want[2], f"trial {trial}: score {got[2]} != {want[2]}"
+        assert sorted(r.info() for r in got[1]) == \
+            sorted(r.info() for r in want[1]), f"trial {trial}: reasons"
+        for phase in ("running_containers", "init_containers"):
+            for name, cont in getattr(pod, phase).items():
+                assert cont.allocate_from == \
+                    getattr(pod_py, phase)[name].allocate_from, \
+                    f"trial {trial}: {name} placement"
+        checked += 1
+    assert checked == 120
+
+
+def test_grpalloc_native_rescore_path(lib):
+    """The idempotent re-check path (allocate_from pre-set) through the
+    native core matches Python."""
+    from kubegpu_tpu.allocator import grpalloc
+
+    rng = random.Random(3)
+    for trial in range(30):
+        node, pod = _random_problem(rng)
+        import copy
+
+        # first pass fills allocate_from (via whichever impl); second pass
+        # must re-validate identically through both
+        grpalloc.pod_fits_group_constraints(node.clone(), pod, True)
+        pod_py = copy.deepcopy(pod)
+        got = grpalloc._native_pod_fits(node.clone(), pod, True)
+        want = grpalloc._pod_fits_group_constraints_py(node.clone(), pod_py, True)
+        assert got is not None
+        assert (got[0], got[2]) == (want[0], want[2]), f"trial {trial}"
+
+
+def test_grpalloc_native_phase_name_collision(lib):
+    """A running and an init container may share a name: placements must
+    stay per-phase (positional matching, not name keyed)."""
+    import copy
+
+    from kubegpu_tpu.allocator import grpalloc
+    from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+
+    G = "alpha/grpresource"
+    node = NodeInfo(name="n")
+    for d in range(4):
+        node.allocatable[f"{G}/tpu/d{d}/chips"] = 1
+    pod = PodInfo(name="p")
+    pod.running_containers["c0"] = ContainerInfo(
+        dev_requests={f"{G}/tpu/r0/chips": 1})
+    pod.init_containers["c0"] = ContainerInfo(
+        dev_requests={f"{G}/tpu/q0/chips": 1})
+    pod_py = copy.deepcopy(pod)
+    got = grpalloc._native_pod_fits(node.clone(), pod, True)
+    want = grpalloc._pod_fits_group_constraints_py(node.clone(), pod_py, True)
+    assert got is not None and (got[0], got[2]) == (want[0], want[2])
+    for phase in ("running_containers", "init_containers"):
+        assert getattr(pod, phase)["c0"].allocate_from == \
+            getattr(pod_py, phase)["c0"].allocate_from
+    assert len(pod.running_containers["c0"].allocate_from) == 1
+
+
+def test_grpalloc_native_rejects_whitespace_paths(lib):
+    """Whitespace in a request path (annotations are user-writable) would
+    inject protocol lines — the dispatch must fall back to Python."""
+    from kubegpu_tpu.allocator import grpalloc
+    from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+
+    G = "alpha/grpresource"
+    node = NodeInfo(name="n")
+    node.allocatable[f"{G}/tpu/d0/chips"] = 1
+    pod = PodInfo(name="p")
+    pod.running_containers["m"] = ContainerInfo(
+        dev_requests={f"{G}/tpu/r0/chips 1 -1\nR {G}/tpu/r0/hbm": 999})
+    assert grpalloc._native_pod_fits(node, pod, True) is None
