@@ -18,9 +18,13 @@ from kubegpu_tpu.node.advertiser import DeviceAdvertiser
 from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
 
 
-def build_manager(backend_kind: str, sysfs_root: str) -> DevicesManager:
+def build_manager(backend_kind: str, sysfs_root: str,
+                  plugins_dir: str | None = None) -> DevicesManager:
     mgr = DevicesManager()
     mgr.add_device(TPUDeviceManager(common.build_backend(backend_kind, sysfs_root)))
+    if plugins_dir:
+        # the reference's --cridevices seam (`crishim/pkg/app/app.go:33-38`)
+        mgr.add_devices_from_plugins(plugins_dir)
     mgr.start()
     return mgr
 
@@ -33,6 +37,10 @@ def main(argv=None) -> int:
     parser.add_argument("--backend", default="native",
                         choices=["native", "fake-v5p", "fake-single"])
     parser.add_argument("--sysfs-root", default="/sys/class")
+    parser.add_argument("--device-plugins-dir", default=None,
+                        help="load extra device plugins (*.py exporting "
+                             "create_device_plugin) from this directory, "
+                             "like the reference's --cridevices")
     parser.add_argument("--advertise-interval", type=float, default=20.0)
     parser.add_argument("--retry-interval", type=float, default=5.0)
     parser.add_argument("--register-node", action="store_true",
@@ -51,7 +59,8 @@ def main(argv=None) -> int:
         except KeyError:
             client.create_node({"metadata": {"name": node_name}})
 
-    mgr = build_manager(args.backend, args.sysfs_root)
+    mgr = build_manager(args.backend, args.sysfs_root,
+                        args.device_plugins_dir)
     adv = DeviceAdvertiser(client, mgr, node_name)
     adv.start(interval_s=args.advertise_interval, retry_s=args.retry_interval)
     common.serve_health(args.healthz_port,

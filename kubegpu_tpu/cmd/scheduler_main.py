@@ -31,6 +31,10 @@ def build_scheduler(client, args, config: dict | None = None) -> Scheduler:
     config = config or {}
     ds = DevicesScheduler()
     ds.add_device(TPUScheduler())
+    if getattr(args, "scheduler_plugins_dir", None):
+        # the reference's /schedulerplugins seam (`cmd/scheduler.go:50-59`),
+        # as a flag instead of a hardcoded path
+        ds.add_devices_from_plugins(args.scheduler_plugins_dir)
     # A Policy document (`kube-scheduler/pkg/api/types.go`) recomposes the
     # predicate/priority set by name; inline under "policy" or in its own
     # file via "policyFile". Extenders declared inside the policy merge
@@ -67,6 +71,9 @@ def main(argv=None) -> int:
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--lease-ttl", type=float, default=15.0)
     parser.add_argument("--healthz-port", type=int, default=0)
+    parser.add_argument("--scheduler-plugins-dir", default=None,
+                        help="load extra device-scheduler plugins (*.py "
+                             "exporting create_device_scheduler_plugin)")
     parser.add_argument("--config", default=None,
                         help="JSON/YAML file; explicit flags win")
     args = parser.parse_args(argv)

@@ -157,8 +157,27 @@ class DevicesManager:
         self.operational: dict = {}
 
     def add_device(self, device) -> None:
+        name = device.get_name()  # probe before mutating (atomic register)
         self.devices.append(device)
-        self.operational[device.get_name()] = False
+        self.operational[name] = False
+
+    def add_devices_from_plugins(self, directory: str) -> int:
+        """Load device plugins from a directory (`devicemanager.go:46-77`,
+        the `--cridevices` seam). Returns how many were registered."""
+        from kubegpu_tpu.plugins import (DEVICE_PLUGIN_SYMBOL, log,
+                                         load_plugins_from_dir)
+
+        n = 0
+        for plugin in load_plugins_from_dir(directory, DEVICE_PLUGIN_SYMBOL):
+            try:
+                self.add_device(plugin)
+                n += 1
+            except Exception:
+                # a factory returning a malformed object must not take the
+                # node agent down — same contract as a broken plugin file
+                log.exception("device plugin %r failed to register, "
+                              "skipping", plugin)
+        return n
 
     def start(self) -> None:
         for dev in self.devices:

@@ -1,0 +1,56 @@
+"""Directory-based plugin loading.
+
+Reference: the Go-plugin seam — `crishim/pkg/device/devicemanager.go:46-77`
+(`plugin.Open` + `Lookup("CreateDevicePlugin")` over `--cridevices`) and
+`device-scheduler/device/devicescheduler.go:38-64`
+(`CreateDeviceSchedulerPlugin` over `/schedulerplugins`). Here a plugin is
+a Python file exporting the factory function; compiled-in registration
+(`add_device`) remains the primary path — SURVEY.md §8 notes Go plugins
+are fragile and the reference itself half-abandoned them — but the
+directory seam exists for out-of-tree device families.
+
+A file that fails to import or lacks the factory symbol is skipped with a
+log line, mirroring the reference's continue-on-error loop: one broken
+plugin must not take down the node agent.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+
+log = logging.getLogger("kubegpu_tpu.plugins")
+
+DEVICE_PLUGIN_SYMBOL = "create_device_plugin"
+SCHEDULER_PLUGIN_SYMBOL = "create_device_scheduler_plugin"
+
+
+def load_plugins_from_dir(directory: str, symbol: str) -> list:
+    """Import every ``*.py`` in ``directory`` (sorted — deterministic
+    registration order) and call its ``symbol()`` factory. Returns the
+    created plugin objects."""
+    plugins: list = []
+    if not directory or not os.path.isdir(directory):
+        return plugins
+    for fname in sorted(os.listdir(directory)):
+        if not fname.endswith(".py") or fname.startswith("_"):
+            continue
+        path = os.path.join(directory, fname)
+        mod_name = f"kubegpu_tpu_plugin_{fname[:-3]}"
+        try:
+            spec = importlib.util.spec_from_file_location(mod_name, path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception:
+            log.exception("plugin %s failed to import, skipping", path)
+            continue
+        factory = getattr(mod, symbol, None)
+        if factory is None:
+            log.error("plugin %s lacks %s(), skipping", path, symbol)
+            continue
+        try:
+            plugins.append(factory())
+        except Exception:
+            log.exception("plugin %s factory failed, skipping", path)
+    return plugins

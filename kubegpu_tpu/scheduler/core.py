@@ -571,6 +571,8 @@ class Scheduler:
             for member in members:
                 self.queue.add_unschedulable(member)
 
+    NOMINATED_NODE_ANNOTATION = "scheduler.alpha.kubernetes.io/nominated-node-name"
+
     def _try_preempt(self, kube_pod: dict) -> bool:
         found = self.generic.preempt(kube_pod)
         if not found:
@@ -579,6 +581,17 @@ class Scheduler:
         for victim in victims:
             metrics.PREEMPTION_VICTIMS.inc()
             self.api.delete_pod(victim["metadata"]["name"])
+        # record where the preemption made room (upstream's nominated
+        # node). Must be persisted via the API: the next scheduling pass
+        # re-fetches the pod, so a local-dict-only annotation would be lost.
+        try:
+            name = kube_pod["metadata"]["name"]
+            annotations = dict(
+                (kube_pod.get("metadata") or {}).get("annotations") or {})
+            annotations[self.NOMINATED_NODE_ANNOTATION] = node_name
+            self.api.update_pod_annotations(name, annotations)
+        except Exception:
+            pass  # observability only; never block the retry
         return True
 
     def _bind(self, kube_pod: dict, host: str, t0: float) -> None:

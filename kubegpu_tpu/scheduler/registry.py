@@ -6,10 +6,11 @@ triggers the shared group-allocator pass, so multiple device families can
 coexist without double-running the allocator
 (`device-scheduler/device/devicescheduler.go:23-36`).
 
-Plugins are compiled-in Python objects rather than Go `plugin.Open` .so
-loading — the reference itself half-abandoned dynamic loading
-(`devicescheduler.go:11-13,80-85`), and SURVEY.md §8 recommends a
-compiled-in registry.
+Plugins are compiled-in Python objects by default — the reference itself
+half-abandoned Go `plugin.Open` loading (`devicescheduler.go:11-13,80-85`)
+and SURVEY.md §8 recommends a compiled-in registry — with an optional
+directory seam (`add_devices_from_plugins`, see `kubegpu_tpu.plugins`)
+for out-of-tree device families.
 """
 
 from __future__ import annotations
@@ -23,12 +24,34 @@ class DevicesScheduler:
     def add_device(self, device) -> None:
         """Register a plugin; the last group-capable plugin owns the shared
         group-allocation pass (`devicescheduler.go:23-36`)."""
+        # probe the interface BEFORE mutating: a malformed plugin must not
+        # leave itself half-registered when the probe raises
+        group_capable = bool(device.uses_group_scheduler())
         self.devices.append(device)
-        if device.uses_group_scheduler():
+        if group_capable:
             self.run_group_scheduler = [False] * len(self.run_group_scheduler)
             self.run_group_scheduler.append(True)
         else:
             self.run_group_scheduler.append(False)
+
+    def add_devices_from_plugins(self, directory: str) -> int:
+        """Load scheduler plugins from a directory
+        (`devicescheduler.go:38-64`, the `/schedulerplugins` seam).
+        Returns how many were registered."""
+        from kubegpu_tpu.plugins import (SCHEDULER_PLUGIN_SYMBOL, log,
+                                         load_plugins_from_dir)
+
+        n = 0
+        for plugin in load_plugins_from_dir(directory, SCHEDULER_PLUGIN_SYMBOL):
+            try:
+                self.add_device(plugin)
+                n += 1
+            except Exception:
+                # a factory returning a malformed object must not take the
+                # scheduler down — same contract as a broken plugin file
+                log.exception("scheduler plugin %r failed to register, "
+                              "skipping", plugin)
+        return n
 
     def add_node(self, node_name: str, node_info) -> None:
         for d in self.devices:

@@ -471,24 +471,24 @@ extern "C" int grp_allocate(const char* input, char* out_buf, int out_cap) {
         std::string tag;
         ls >> tag;
         if (tag == "A") {
-            std::string path; long long val; int sc;
+            std::string path; long long val = 0; int sc = 0;
             ls >> path >> val >> sc;
             prob.alloc[path] = val;
             prob.alloc_scorer[path] = sc;
         } else if (tag == "U") {
-            std::string path; long long val;
+            std::string path; long long val = 0;
             ls >> path >> val;
             prob.used[path] = val;
         } else if (tag == "C") {
             prob.containers.emplace_back();
             cur = &prob.containers.back();
-            int init, mode;
+            int init = 0, mode = 0;
             ls >> cur->name >> init >> mode;
             cur->init = init != 0;
             cur->rescore = mode != 0;
         } else if (tag == "R") {
             if (!cur) { g_grp_error = "R before C"; return -1; }
-            std::string path; long long val; int ov;
+            std::string path; long long val = 0; int ov = -1;
             ls >> path >> val >> ov;
             cur->required[path] = val;
             cur->req_scorer[path] = ov;

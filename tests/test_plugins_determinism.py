@@ -1,0 +1,157 @@
+"""Directory plugin loading (the reference's plugin.Open seam) and the
+determinism guarantee (docs/design.md: identical state -> identical
+placements, the reference's core correctness tool)."""
+
+import textwrap
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.node.manager import DevicesManager
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+
+
+DEVICE_PLUGIN = textwrap.dedent("""
+    class WidgetDevice:
+        def get_name(self):
+            return "widget"
+        def start(self):
+            pass
+        def update_node_info(self, node_info):
+            node_info.allocatable["alpha/widget/count"] = 3
+        def allocate(self, pod, container):
+            return [], [], {"WIDGET": "on"}
+
+    def create_device_plugin():
+        return WidgetDevice()
+""")
+
+SCHED_PLUGIN = textwrap.dedent("""
+    class WidgetScheduler:
+        calls = []
+        def uses_group_scheduler(self):
+            return False
+        def add_node(self, name, node_info):
+            pass
+        def remove_node(self, name):
+            pass
+        def pod_fits_device(self, node_info, pod_info, fill, run_grp):
+            WidgetScheduler.calls.append(pod_info.name)
+            return True, [], 0.5
+        def pod_allocate(self, node_info, pod_info, run_grp):
+            pass
+        def take_pod_resources(self, node_info, pod_info, run_grp):
+            pass
+        def return_pod_resources(self, node_info, pod_info, run_grp):
+            pass
+
+    def create_device_scheduler_plugin():
+        return WidgetScheduler()
+""")
+
+
+def test_device_plugins_load_from_dir(tmp_path):
+    (tmp_path / "widget.py").write_text(DEVICE_PLUGIN)
+    (tmp_path / "broken.py").write_text("raise RuntimeError('bad plugin')")
+    (tmp_path / "no_factory.py").write_text("x = 1")
+    (tmp_path / "_private.py").write_text("def create_device_plugin(): 1/0")
+    mgr = DevicesManager()
+    n = mgr.add_devices_from_plugins(str(tmp_path))
+    assert n == 1  # broken/no-factory/underscore files skipped, agent alive
+    mgr.start()
+    from kubegpu_tpu.core.types import NodeInfo
+
+    info = NodeInfo(name="n")
+    mgr.update_node_info(info)
+    assert info.allocatable["alpha/widget/count"] == 3
+    _, _, env = mgr.allocate_devices({"metadata": {"name": "p"}}, "c")
+    assert env == {"WIDGET": "on"}
+
+
+def test_scheduler_plugins_load_from_dir(tmp_path):
+    (tmp_path / "widget_sched.py").write_text(SCHED_PLUGIN)
+    ds = DevicesScheduler()
+    assert ds.add_devices_from_plugins(str(tmp_path)) == 1
+    from kubegpu_tpu.core.types import NodeInfo, PodInfo
+
+    fits, reasons, score = ds.pod_fits_resources(
+        PodInfo(name="p"), NodeInfo(name="n"), False)
+    assert fits and score == 0.5
+
+
+def test_missing_plugin_dir_is_noop(tmp_path):
+    assert DevicesManager().add_devices_from_plugins(
+        str(tmp_path / "nope")) == 0
+    assert DevicesScheduler().add_devices_from_plugins(None) == 0
+
+
+def test_malformed_plugin_object_is_skipped(tmp_path):
+    """A factory returning an object without the plugin interface must not
+    crash registration — same contract as a broken plugin file."""
+    (tmp_path / "bad_obj.py").write_text(
+        "def create_device_plugin():\n    return object()\n"
+        "def create_device_scheduler_plugin():\n    return object()\n")
+    (tmp_path / "widget.py").write_text(DEVICE_PLUGIN)
+    mgr = DevicesManager()
+    assert mgr.add_devices_from_plugins(str(tmp_path)) == 1  # widget only
+    ds = DevicesScheduler()
+    assert ds.add_devices_from_plugins(str(tmp_path)) == 0
+    assert ds.devices == []
+
+
+def test_preemption_persists_nominated_node():
+    """The nominated-node record must be written through the API — the
+    next scheduling pass re-fetches the pod, so a local-only annotation
+    would vanish."""
+    from kubegpu_tpu.scheduler.core import Scheduler
+
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("low", 4, priority=0))
+    sched.run_until_idle()
+    api.create_pod(tpu_pod("high", 4, priority=10))
+    sched.run_until_idle()
+    high = api.get_pod("high")
+    assert high["spec"]["nodeName"] == "host0"
+    assert high["metadata"]["annotations"][
+        Scheduler.NOMINATED_NODE_ANNOTATION] == "host0"
+
+
+# ---- determinism ------------------------------------------------------------
+
+
+def _run_workload():
+    api = InMemoryAPIServer()
+    for i in range(4):
+        node = flat_tpu_node(f"host{i}")
+        node["metadata"]["labels"] = {"zone": f"z{i % 2}"}
+        api.create_node(node)
+    sched = make_scheduler(api)
+    sizes = [2, 1, 3, 1, 2, 4, 1, 2]
+    for i, s in enumerate(sizes):
+        api.create_pod(tpu_pod(f"p{i}", s, priority=i % 3))
+    sched.run_until_idle()
+    placements = {}
+    for i in range(len(sizes)):
+        pod = api.get_pod(f"p{i}")
+        from kubegpu_tpu.core import codec
+
+        pi = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
+        alloc = {}
+        for cname, cont in pi.running_containers.items():
+            alloc[cname] = dict(cont.allocate_from)
+        placements[f"p{i}"] = (pod["spec"].get("nodeName"), alloc)
+    return placements
+
+
+def test_identical_state_gives_identical_placements():
+    """The reference's determinism rule (`docs/kubegpu.md:24-31`,
+    SortedStringKeys everywhere): same cluster + same queue order -> the
+    same node AND the same physical chips for every pod."""
+    first = _run_workload()
+    second = _run_workload()
+    assert first == second
+    # and every pod actually landed with concrete chips
+    assert all(node for node, _ in first.values())
+    assert all(any(alloc.values()) for _, alloc in first.values())
