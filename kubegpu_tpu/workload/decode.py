@@ -1,0 +1,150 @@
+"""Autoregressive inference: KV-cache prefill + decode, sharded.
+
+The serving half of the workload layer (training lives in `train.py`).
+TPU-first design:
+
+- **Static shapes**: the KV cache is allocated at ``max_seq`` up front and
+  written with `lax.dynamic_update_slice`; attention always reads the full
+  cache with a position mask, so every decode step compiles to the same
+  program (no shape-driven recompiles).
+- **Token loop inside jit**: `make_generate` runs the whole greedy decode
+  as one `lax.scan`, not a Python loop — one compilation, no host↔device
+  round-trip per token.
+- **Sharding**: batch on ``data``, heads on ``model`` (the cache is
+  sharded the same way); decode chunks are tiny so the ``seq`` axis is
+  unused here — GSPMD inserts the same per-layer collectives as training.
+
+Matches `model.make_forward` logits exactly (same weights, same RoPE
+positions) — asserted by test.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubegpu_tpu.workload import spmd
+from kubegpu_tpu.workload.model import (TransformerConfig, _rmsnorm, _rope)
+
+NEG_INF = -1e30
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_seq: int):
+    """Zeroed per-layer KV cache: list of {"k","v"} of
+    ``[B, max_seq, H, D]`` in the compute dtype."""
+    dt = cfg.compute_dtype()
+    shape = (batch, max_seq, cfg.n_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            for _ in range(cfg.n_layers)]
+
+
+def cache_pspecs(cfg: TransformerConfig):
+    """PartitionSpec pytree matching `init_cache`: batch on data, heads on
+    model (mirrors the qkv weight sharding)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(spmd.AXIS_DATA, None, spmd.AXIS_MODEL, None)
+    return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
+
+
+def make_forward_step(cfg: TransformerConfig, mesh=None):
+    """Build ``step(params, cache, tokens, start_pos) ->
+    (logits, new_cache)``: process a chunk of ``tokens [B, T]`` whose
+    first token sits at absolute position ``start_pos``, attending over
+    everything cached so far plus the chunk itself. Used with T=prompt
+    length for prefill and T=1 for decode."""
+
+    def constrain(x, *spec):
+        if mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+
+    def step(params, cache, tokens, start_pos):
+        dt = cfg.compute_dtype()
+        b, t = tokens.shape
+        s_max = cache[0]["k"].shape[1]
+        scale = cfg.head_dim ** -0.5
+        x = params["embed"].astype(dt)[tokens]
+        x = constrain(x, spmd.AXIS_DATA, None, None)
+        positions = start_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
+        # chunk position i attends cache positions <= start_pos + i
+        kv_pos = jnp.arange(s_max)
+        mask = kv_pos[None, :] <= (start_pos + jnp.arange(t))[:, None]
+
+        new_cache = []
+        for layer, kv in zip(params["layers"], cache):
+            h = _rmsnorm(x, layer["ln1"])
+            q = (h @ layer["wq"].astype(dt)).reshape(b, t, cfg.n_heads,
+                                                     cfg.head_dim)
+            k = (h @ layer["wk"].astype(dt)).reshape(b, t, cfg.n_heads,
+                                                     cfg.head_dim)
+            v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.n_heads,
+                                                     cfg.head_dim)
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            ck = lax.dynamic_update_slice(kv["k"], k.astype(dt),
+                                          (0, start_pos, 0, 0))
+            cv = lax.dynamic_update_slice(kv["v"], v.astype(dt),
+                                          (0, start_pos, 0, 0))
+            new_cache.append({"k": ck, "v": cv})
+
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           ck.astype(jnp.float32)) * scale
+            s = jnp.where(mask[None, None], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+            x = x + attn.astype(dt).reshape(b, t, -1) @ layer["wo"].astype(dt)
+            x = constrain(x, spmd.AXIS_DATA, None, None)
+
+            h = _rmsnorm(x, layer["ln2"])
+            if "moe" in layer:
+                from kubegpu_tpu.workload.moe import moe_ffn
+
+                ffn_out, _ = moe_ffn(layer["moe"], h, dt)
+                x = x + ffn_out
+            else:
+                up = h @ layer["w_up"].astype(dt)
+                gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
+                x = x + (up * gate) @ layer["w_down"].astype(dt)
+            x = constrain(x, spmd.AXIS_DATA, None, None)
+
+        x = _rmsnorm(x, params["final_norm"])
+        logits = x @ params["unembed"].astype(dt)
+        return logits.astype(jnp.float32), new_cache
+
+    return step
+
+
+def make_generate(cfg: TransformerConfig, mesh=None,
+                  max_seq: int | None = None):
+    """Build ``generate(params, prompt, n_new) -> tokens [B, n_new]``:
+    greedy decoding as prefill + ONE `lax.scan` over decode steps, all
+    inside a single jit. ``n_new`` is static (it sizes the scan)."""
+    max_seq = max_seq or cfg.max_seq
+    step = make_forward_step(cfg, mesh)
+
+    def generate(params, prompt, n_new: int):
+        b, t0 = prompt.shape
+        cache = init_cache(cfg, b, max_seq)
+        logits, cache = step(params, cache, prompt, 0)
+        first = jnp.argmax(logits[:, -1, :], axis=-1)
+
+        def body(carry, _):
+            cache, token, pos = carry
+            logits, cache = step(params, cache, token[:, None], pos)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+            return (cache, nxt, pos + 1), token
+
+        (_, last, _), toks = lax.scan(
+            body, (cache, first, jnp.int32(t0)), None, length=n_new - 1)
+        # toks: [n_new-1, B] of the fed-in tokens; append the final one
+        out = jnp.concatenate(
+            [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \
+            if n_new > 1 else last[:, None]
+        return out
+
+    return generate

@@ -8,7 +8,12 @@ set before the first ``import jax`` anywhere in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment may carry a TPU-tunnel
+# platform (JAX_PLATFORMS=axon + a sitecustomize that overrides
+# jax_platforms at interpreter start). Tests ALWAYS run on the virtual
+# CPU mesh; the config.update below wins over the sitecustomize so a
+# wedged tunnel cannot hang backend init mid-suite.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +21,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
