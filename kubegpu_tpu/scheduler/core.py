@@ -107,7 +107,7 @@ class GenericScheduler:
                       eq_class: str | None = None,
                       out_snaps: dict | None = None,
                       meta=_AUTO_META, pod_info_get=None,
-                      device_class: str | None = None):
+                      device_class=_AUTO_META, eq_gen: int | None = None):
         """The full predicate chain against a point-in-time snapshot so
         concurrent watcher mutations of node usage cannot tear mid-fit.
         Order mirrors the reference providers: cheap node gates first, the
@@ -118,18 +118,24 @@ class GenericScheduler:
             hit = self.cache.equivalence.lookup(node_name, eq_class)
             if hit is not None:
                 return hit
-            # Read the generation BEFORE the snapshot: if the node changes
-            # while we compute, store() drops the now-stale result instead
-            # of poisoning the cache (the upstream equivalence-cache race).
-            gen = self.cache.equivalence.generation(node_name)
+            # The generation must predate EVERYTHING the verdict reads —
+            # the inter-pod metadata included. The filter pass captures all
+            # generations before building the metadata and hands ours in
+            # via ``eq_gen``; a direct call reads it here, before the
+            # snapshot. Either way, a node change while we compute makes
+            # store() drop the now-stale result instead of poisoning the
+            # cache (the upstream equivalence-cache race).
+            gen = eq_gen if eq_gen is not None \
+                else self.cache.equivalence.generation(node_name)
         if meta is self._AUTO_META:
             meta = self._interpod_meta(kube_pod)
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
+        if device_class is self._AUTO_META:
+            device_class = self._device_class(kube_pod)
         result = self._run_predicates(
-            kube_pod, snap, meta, pod_info_get,
-            device_class or self._device_class(kube_pod))
+            kube_pod, snap, meta, pod_info_get, device_class)
         if out_snaps is not None and result[0]:
             # Only feasible nodes are scored; don't pin snapshots of the
             # (typically many) infeasible ones for the whole pass.
@@ -141,14 +147,44 @@ class GenericScheduler:
     MAX_DEVICE_VERDICTS = 4096
 
     @staticmethod
-    def _device_class(kube_pod: dict) -> str:
+    def _requests_auto_topology(kube_pod: dict) -> bool:
+        """True when the pod asks for topology auto-generation. Such pods
+        translate via the CLUSTER-wide shape cache
+        (`tpu_scheduler.py` ShapeCache.best_tree), which moves on any node
+        add/remove/usage change — so no per-node-keyed cache entry for them
+        can be invalidated by per-node events, and both the device-verdict
+        cache and the equivalence cache must be bypassed."""
+        import json as _json
+
+        from kubegpu_tpu.core import grammar
+
+        meta = kube_pod.get("metadata") or {}
+        ann = (meta.get("annotations") or {}).get(codec.POD_ANNOTATION_KEY)
+        if not ann:
+            return False
+        try:
+            pod_requests = _json.loads(ann).get("requests") or {}
+            return int(pod_requests.get(
+                grammar.TPU_TOPOLOGY_GENERATION, 0) or 0) == 1
+        except (TypeError, ValueError):
+            return False
+
+    @staticmethod
+    def _device_class(kube_pod: dict, auto_topology: bool | None = None) -> str | None:
         """Identity of a pod's device demand: the raw device annotation
         (INCLUDING allocate_from, so gang-pinned pods never share entries)
         plus the container resource blocks. Unlike `equivalence_class`,
-        this must key only what `pod_fits_device` reads."""
+        this must key only what `pod_fits_device` reads. None = do not
+        cache (auto-topology pods, see `_requests_auto_topology`);
+        callers that already computed the flag pass it to skip the
+        annotation re-parse."""
         import hashlib
         import json as _json
 
+        if auto_topology is None:
+            auto_topology = GenericScheduler._requests_auto_topology(kube_pod)
+        if auto_topology:
+            return None
         meta = kube_pod.get("metadata") or {}
         ann = (meta.get("annotations") or {}).get(codec.POD_ANNOTATION_KEY) or ""
         spec = kube_pod.get("spec") or {}
@@ -203,16 +239,25 @@ class GenericScheduler:
         # invalidation can't express that, and whole-cluster flushes on
         # every charge would kill the cache for everyone else. Preferred-
         # only terms don't affect predicates, so those pods stay memoized.
+        # Auto-topology pods are likewise uncacheable (cluster-wide shape
+        # dependence, `_requests_auto_topology`).
+        auto_topology = self._requests_auto_topology(kube_pod)
         eq_class = None if interpod.pod_requires_interpod_affinity(kube_pod) \
-            else equivalence_class(kube_pod)
+            or auto_topology else equivalence_class(kube_pod)
+        # Generations BEFORE the metadata snapshot: a watcher invalidation
+        # racing the metadata build must make the eventual store() a no-op
+        # — a verdict computed from pre-invalidation metadata stored under
+        # a post-invalidation generation would persist wrongly.
+        eq_gens = self.cache.equivalence.generations(names) \
+            if eq_class is not None else {}
         meta = self._interpod_meta(kube_pod)
         pod_info_get = self._pod_info_provider(kube_pod)
-        device_class = self._device_class(kube_pod)
+        device_class = self._device_class(kube_pod, auto_topology)
         snaps: dict = {}
         results = list(self._pool.map(
             lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps,
                                               meta, pod_info_get,
-                                              device_class)),
+                                              device_class, eq_gens.get(n))),
             names))
         feasible = {n: score for n, ok, _, score in results if ok}
         failures = {n: reasons for n, ok, reasons, _ in results if not ok}
@@ -318,13 +363,17 @@ class GenericScheduler:
         victims, then lexical node name for determinism. Returns
         (node_name, victim pod dicts) or None."""
         prio = _pod_priority(kube_pod)
+        # The cluster-wide inter-pod metadata is built ONCE per preemption
+        # pass and filtered per-simulation (victims removed), mirroring the
+        # reference re-running podFitsOnNode with adjusted metadata.
+        meta = self._interpod_meta(kube_pod)
         best = None
         best_key = None
         for node_name in self.cache.node_names():
             snap = self.cache.snapshot_node(node_name)
             if snap is None:
                 continue
-            victims = self._victims_on_node(kube_pod, snap, prio)
+            victims = self._victims_on_node(kube_pod, snap, prio, meta)
             if victims is None:
                 continue
             key = (max(_pod_priority(v) for v in victims),
@@ -334,20 +383,25 @@ class GenericScheduler:
                 best, best_key = (node_name, victims), key
         return best
 
-    def _fits_after_evictions(self, kube_pod, snap, sim, core_free):
-        alloc = snap.core_allocatable
-        core_ok = all(
-            req + core_free.get(res, 0) <= alloc[res]
-            for res, req in _pod_core_requests(kube_pod).items()
-            if res in alloc)
-        if not core_ok:
-            return False
-        pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
-        fits, _, _ = self.device_scheduler.pod_fits_resources(pod_info, sim, False)
+    def _fits_after_evictions(self, kube_pod, snap, meta, evicted: set):
+        """Full predicate chain against the mutated snapshot — taints,
+        selectors, volume conflicts, inter-pod terms AND device fit — the
+        reference's podFitsOnNode during preemption. A node where only
+        resources were checked could be selected, its victims deleted, and
+        the preemptor still never schedule there."""
+        sim_meta = meta
+        if meta is not None and evicted:
+            sim_meta = interpod.InterPodMetadata(
+                meta.node_labels,
+                [p for p in meta.pods if not (p.node_name == snap.name
+                                              and p.name in evicted)])
+        fits, _, _ = self._run_predicates(kube_pod, snap, sim_meta)
         return fits
 
-    def _victims_on_node(self, kube_pod, snap, prio):
+    def _victims_on_node(self, kube_pod, snap, prio, meta=None):
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
+        from kubegpu_tpu.scheduler.predicates import (pod_host_ports,
+                                                      pod_volumes)
 
         sim, core_free = snap.node_ex, snap.requested_core
         api = getattr(self, "api", None)
@@ -363,14 +417,31 @@ class GenericScheduler:
                 candidates.append(p)
         if not candidates:
             return None
+        evicted: set = set()
 
         def charge(pod, sign):
-            """sign=-1 evicts (frees), +1 re-admits."""
+            """sign=-1 evicts (frees), +1 re-admits. Keeps the WHOLE
+            snapshot consistent — core usage, device usage, ports, labels,
+            volumes — because the full predicate chain reads all of it."""
+            name = pod["metadata"]["name"]
             info = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
             if sign < 0:
                 self.device_scheduler.return_pod_resources(info, sim)
+                evicted.add(name)
+                snap.pod_names.discard(name)
+                snap.pod_labels.pop(name, None)
+                snap.pod_volumes.pop(name, None)
+                snap.used_ports -= pod_host_ports(pod)
             else:
                 self.device_scheduler.take_pod_resources(info, sim)
+                evicted.discard(name)
+                snap.pod_names.add(name)
+                labels = (pod.get("metadata") or {}).get("labels") or {}
+                snap.pod_labels[name] = dict(labels)
+                vols = pod_volumes(pod)
+                if vols:
+                    snap.pod_volumes[name] = vols
+                snap.used_ports |= pod_host_ports(pod)
             for res, val in _pod_core_requests(pod).items():
                 core_free[res] = core_free.get(res, 0) + sign * val
 
@@ -378,7 +449,7 @@ class GenericScheduler:
         # fit, this node can't be helped by preemption.
         for victim in candidates:
             charge(victim, -1)
-        if not self._fits_after_evictions(kube_pod, snap, sim, core_free):
+        if not self._fits_after_evictions(kube_pod, snap, meta, evicted):
             return None
         # Phase 2: reprieve — re-admit in descending priority (then name
         # for determinism); keep each pod that doesn't break the fit.
@@ -387,7 +458,7 @@ class GenericScheduler:
         victims = []
         for pod in candidates:
             charge(pod, +1)
-            if self._fits_after_evictions(kube_pod, snap, sim, core_free):
+            if self._fits_after_evictions(kube_pod, snap, meta, evicted):
                 continue  # reprieved
             charge(pod, -1)
             victims.append(pod)

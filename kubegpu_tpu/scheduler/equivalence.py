@@ -80,6 +80,15 @@ class EquivalenceCache:
         with self._lock:
             return self._gen.get(node_name, 0)
 
+    def generations(self, node_names: list) -> dict:
+        """All generations under ONE lock acquisition. The filter pass
+        captures these BEFORE building the cluster-wide inter-pod metadata
+        so a watcher invalidation racing the metadata build makes the
+        eventual ``store`` a no-op instead of persisting a verdict computed
+        from a pre-invalidation metadata snapshot."""
+        with self._lock:
+            return {n: self._gen.get(n, 0) for n in node_names}
+
     def lookup(self, node_name: str, eq_class: str):
         with self._lock:
             entry = self._by_node.get(node_name, {}).get(eq_class)

@@ -179,11 +179,13 @@ def match_interpod_affinity(kube_pod: dict, node_name: str,
                 break
         if satisfied:
             continue
-        # first-pod-of-group escape hatch (upstream): nothing in the
-        # cluster matches, but the pod matches its own term
+        # first-pod-of-group escape hatch (upstream,
+        # `predicates.go:1305-1326` satisfiesPodsAffinityAntiAffinity):
+        # nothing in the cluster matches and the pod matches its own term
+        # — the term is disregarded entirely, even on nodes that lack the
+        # topology label, so the first pod of a self-affine group can land.
         if not matches_anywhere and \
-                term_matches_pod(term, namespace, self_pod) and \
-                key in candidate_labels:
+                term_matches_pod(term, namespace, self_pod):
             continue
         return False, ["node(s) didn't satisfy pod affinity rules"]
 

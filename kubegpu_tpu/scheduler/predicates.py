@@ -231,12 +231,17 @@ def pod_volumes(kube_pod: dict) -> list:
 
 
 def _exclusive_volume_keys(volumes: list):
-    """Yield (identity, read_only) for conflict-capable volumes."""
+    """Yield (identity, read_only) for conflict-capable volumes. Identity
+    components stay POSITIONAL (None → ""), never filtered: an iSCSI
+    volume with lun=0 must not collide with one that has no lun, and a
+    pdName-less GCE PD must not collide with a NAMED one (two pdName-less
+    PDs still share the ("gce", "") identity, as upstream's string
+    comparison would)."""
     for vol in volumes:
         for kind, ident_fn in _VOLUME_IDENTITY.items():
             src = vol.get(kind)
             if src is not None:
-                yield (kind, *filter(None, ident_fn(src))), \
+                yield (kind, *("" if c is None else c for c in ident_fn(src))), \
                     bool(src.get("readOnly")), kind
 
 

@@ -42,6 +42,7 @@ RESOURCE_CONTIGUOUS = "alpha.tpu/contiguous"
 _CHIP_REQ_RE = re.compile(
     re.escape(DEVICE_GROUP_PREFIX) + rf".*/{grammar.TPU_LEAF}/(.*?)/{grammar.CHIPS_SUFFIX}")
 _TPU_PATH_RE = re.compile(rf".*/{grammar.TPU_LEAF}/.*")
+_CHIP_LEAF_RE = re.compile(rf".*/{grammar.TPU_LEAF}/.*/{grammar.CHIPS_SUFFIX}$")
 
 
 def translate_chip_count(num_chips: int, hbm_per_chip: int,
@@ -81,49 +82,82 @@ class ShapeCache:
 
     Nodes with structurally identical topologies share one tree entry, so
     auto-topology answers "best shape with >= n chips" without scanning
-    every node.
+    every node. Unlike the reference — which matches shapes on raw
+    capacity (`gpu.go:170-183`) and happily rewrites a request to a shape
+    whose every instance is full — ``best_tree`` is USAGE-AWARE: it keeps
+    a live reference to each node's inventory and only returns a shape
+    some member node can actually absorb right now.
     """
 
     def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
         self._entries: list = []       # [tree, node_names:set, score]
         self._node_entry: dict = {}    # node_name -> entry
+        self._node_infos: dict = {}    # node_name -> live NodeInfo
 
-    def add_node(self, node_name: str, resources: dict) -> None:
+    def add_node(self, node_name: str, node_info: NodeInfo) -> None:
+        resources = node_info.allocatable
         if not resources:
             return
         tree = tree_from_resources(resources)
-        current = self._node_entry.get(node_name)
-        if current is not None and compare_trees(tree, current[0]):
-            return
-        self.remove_node(node_name)
-        for entry in self._entries:
-            if compare_trees(tree, entry[0]):
-                entry[1].add(node_name)
-                self._node_entry[node_name] = entry
+        with self._lock:
+            self._node_infos[node_name] = node_info
+            current = self._node_entry.get(node_name)
+            if current is not None and compare_trees(tree, current[0]):
                 return
-        entry = [tree, {node_name}, compute_tree_score(tree)]
-        self._entries.append(entry)
-        self._node_entry[node_name] = entry
+            self._remove_shape_locked(node_name)
+            for entry in self._entries:
+                if compare_trees(tree, entry[0]):
+                    entry[1].add(node_name)
+                    self._node_entry[node_name] = entry
+                    return
+            entry = [tree, {node_name}, compute_tree_score(tree)]
+            self._entries.append(entry)
+            self._node_entry[node_name] = entry
 
-    def remove_node(self, node_name: str) -> None:
+    def _remove_shape_locked(self, node_name: str) -> None:
         entry = self._node_entry.pop(node_name, None)
         if entry is not None:
             entry[1].discard(node_name)
             if not entry[1]:
                 self._entries.remove(entry)
 
+    def remove_node(self, node_name: str) -> None:
+        with self._lock:
+            self._node_infos.pop(node_name, None)
+            self._remove_shape_locked(node_name)
+
     def __len__(self):
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
+
+    @staticmethod
+    def _free_chips(node_info: NodeInfo) -> int:
+        total = 0
+        for res, alloc in node_info.allocatable.items():
+            if _CHIP_LEAF_RE.match(res):
+                total += max(0, alloc - node_info.used.get(res, 0))
+        return total
 
     def best_tree(self, num_chips: int):
-        """Highest-scoring cached shape with capacity >= num_chips
-        (`gpu.go:170-183`)."""
-        best = None
-        best_score = 0.0
-        for tree, _, score in self._entries:
-            if tree.val >= num_chips and score > best_score:
-                best, best_score = tree, score
-        return best
+        """Highest-scoring cached shape that (a) has capacity >= num_chips
+        and (b) has at least one member node with that many FREE chips —
+        the usage-aware upgrade over `gpu.go:170-183`, which consults only
+        allocatable and can rewrite a request onto a fleet of full nodes."""
+        with self._lock:
+            best = None
+            best_score = 0.0
+            for tree, node_names, score in self._entries:
+                if tree.val < num_chips or score <= best_score:
+                    continue
+                for name in node_names:
+                    info = self._node_infos.get(name)
+                    if info is not None and self._free_chips(info) >= num_chips:
+                        best, best_score = tree, score
+                        break
+            return best
 
 
 def _assign_chips(tree, prefix: str, level: int, num_left: list) -> dict:
@@ -171,7 +205,7 @@ class TPUScheduler:
     # ---- node lifecycle ----------------------------------------------------
 
     def add_node(self, node_name: str, node_info: NodeInfo) -> None:
-        self.shape_cache.add_node(node_name, node_info.allocatable)
+        self.shape_cache.add_node(node_name, node_info)
 
     def remove_node(self, node_name: str) -> None:
         self.shape_cache.remove_node(node_name)
@@ -204,20 +238,39 @@ class TPUScheduler:
             grammar.TPU_TOPOLOGY_GENERATION, mode, 0, 1)]
 
     def _translate_auto_topology(self, pod_info: PodInfo) -> tuple[bool, list]:
-        """Rewrite requests to the cluster's best shape (`gpu.go:231-261`)."""
+        """Rewrite requests to the cluster's best shape (`gpu.go:231-261`).
+
+        Already-placed containers (``allocate_from`` set) keep their pinned
+        requests untouched: ``best_tree`` is usage-aware, so by re-check
+        time it may name a different shape than the one the pod was
+        allocated on — rewriting would desync ``dev_requests`` from
+        ``allocate_from`` and fail the allocator's idempotent re-score."""
+        # num counts PENDING containers only: a placed container's chips
+        # are already charged as "used", so including them would demand
+        # that many EXTRA free chips from the usage-aware best_tree and
+        # fail the idempotent re-check of an already-running pod.
         num = 0
-        for cont in pod_info.running_containers.values():
+        pending = []
+        for n in sorted_keys(pod_info.running_containers):
+            cont = pod_info.running_containers[n]
+            if cont.allocate_from:
+                continue
+            pending.append(cont)
             num += int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
-        for cont in pod_info.init_containers.values():
+        for n in sorted_keys(pod_info.init_containers):
+            cont = pod_info.init_containers[n]
+            if cont.allocate_from:
+                continue
+            pending.append(cont)
             num = max(num, int(cont.requests.get(grammar.RESOURCE_NUM_CHIPS, 0)))
+        if not pending:
+            return True, []
         tree = self.shape_cache.best_tree(num)
         if tree is None:
             return False, [InsufficientResourceError(
                 grammar.RESOURCE_NUM_CHIPS, num, 0, 0)]
-        for name in sorted_keys(pod_info.running_containers):
-            _rewrite_to_tree(tree, pod_info.running_containers[name])
-        for name in sorted_keys(pod_info.init_containers):
-            _rewrite_to_tree(tree, pod_info.init_containers[name])
+        for cont in pending:
+            _rewrite_to_tree(tree, cont)
         return True, []
 
     def _translate_contiguous(self, node_info: NodeInfo,

@@ -129,17 +129,17 @@ TREE_NODE_2 = {f"{G}/tpugrp1/{a}/tpugrp0/{b}/tpu/{i}/chips": 1
 
 def test_shape_cache_dedup_and_removal():
     cache = ShapeCache()
-    cache.add_node("A", TREE_NODE_1)
-    cache.add_node("B", TREE_NODE_2)
-    cache.add_node("C", TREE_NODE_1)  # same shape as A
-    cache.add_node("D", {"ABCD": 4})  # degenerate
+    cache.add_node("A", NodeInfo(allocatable=dict(TREE_NODE_1)))
+    cache.add_node("B", NodeInfo(allocatable=dict(TREE_NODE_2)))
+    cache.add_node("C", NodeInfo(allocatable=dict(TREE_NODE_1)))  # same shape as A
+    cache.add_node("D", NodeInfo(allocatable={"ABCD": 4}))  # degenerate
     assert len(cache) == 3
     cache.remove_node("A")
     assert len(cache) == 3  # C still holds shape 1
     cache.remove_node("C")
     assert len(cache) == 2
     # re-adding same node shape is a no-op
-    cache.add_node("B", TREE_NODE_2)
+    cache.add_node("B", NodeInfo(allocatable=dict(TREE_NODE_2)))
     assert len(cache) == 2
 
 
