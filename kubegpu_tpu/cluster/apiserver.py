@@ -36,7 +36,13 @@ class InMemoryAPIServer:
         self._lock = threading.RLock()
         self._nodes: dict = {}
         self._pods: dict = {}
+        self._pdbs: dict = {}
+        # insertion-ordered (kind, name, reason, message) -> event; the
+        # key IS the dedup identity, so record_event is O(1) not a scan
+        self._events: dict = {}
         self._watchers: list = []
+
+    MAX_EVENTS = 5000
 
     # ---- nodes -------------------------------------------------------------
 
@@ -152,6 +158,63 @@ class InMemoryAPIServer:
             pod = self._pods.pop(name, None)
             if pod is not None:
                 self._notify("pod", "deleted", pod)
+
+    # ---- pod disruption budgets -------------------------------------------
+    # Minimal PDB surface the preemption path consumes
+    # (`generic_scheduler.go:254,674-699` reads PDBs to minimize violations):
+    # {"metadata": {"name"}, "spec": {"selector": {"matchLabels": {...}},
+    #  "minAvailable": N}}.
+
+    def create_pdb(self, pdb: dict) -> dict:
+        with self._lock:
+            name = pdb["metadata"]["name"]
+            if name in self._pdbs:
+                raise Conflict(f"pdb {name} exists")
+            self._pdbs[name] = copy.deepcopy(pdb)
+            self._notify("pdb", "added", self._pdbs[name])
+            return copy.deepcopy(self._pdbs[name])
+
+    def list_pdbs(self) -> list:
+        with self._lock:
+            return [copy.deepcopy(p) for _, p in sorted(self._pdbs.items())]
+
+    def delete_pdb(self, name: str) -> None:
+        with self._lock:
+            pdb = self._pdbs.pop(name, None)
+            if pdb is not None:
+                self._notify("pdb", "deleted", pdb)
+
+    # ---- events ------------------------------------------------------------
+    # The reference records k8s Events on scheduling outcomes
+    # (`scheduler.go:198,242,272`): FailedScheduling / Preempted /
+    # Scheduled, deduplicated by (involved, reason, message) with a count.
+
+    def record_event(self, involved_kind: str, involved_name: str,
+                     event_type: str, reason: str, message: str) -> dict:
+        key = (involved_kind, involved_name, reason, message)
+        with self._lock:
+            ev = self._events.get(key)
+            if ev is not None:
+                ev["count"] += 1
+                self._notify("event", "modified", ev)
+                return copy.deepcopy(ev)
+            ev = {"involvedObject": {"kind": involved_kind,
+                                     "name": involved_name},
+                  "type": event_type, "reason": reason, "message": message,
+                  "count": 1}
+            self._events[key] = ev
+            while len(self._events) > self.MAX_EVENTS:
+                self._events.pop(next(iter(self._events)))
+            self._notify("event", "added", ev)
+            return copy.deepcopy(ev)
+
+    def list_events(self, involved_name: str | None = None) -> list:
+        with self._lock:
+            out = list(self._events.values())
+            if involved_name is not None:
+                out = [e for e in out
+                       if e["involvedObject"]["name"] == involved_name]
+            return [copy.deepcopy(e) for e in out]
 
     # ---- watch -------------------------------------------------------------
 

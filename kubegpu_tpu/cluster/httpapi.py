@@ -172,6 +172,24 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
                 body = self._body()
                 api.bind_many(body["bindings"], body.get("annotations") or {})
                 return self._send(200)
+            if parts and parts[0] == "pdbs":
+                if method == "GET" and len(parts) == 1:
+                    return self._send(200, {"items": api.list_pdbs()})
+                if method == "POST" and len(parts) == 1:
+                    return self._send(201, api.create_pdb(self._body()))
+                if method == "DELETE" and len(parts) == 2:
+                    api.delete_pdb(parts[1])
+                    return self._send(200)
+            if parts == ["events"]:
+                if method == "GET":
+                    return self._send(200, {"items": api.list_events(
+                        involved_name=query.get("involved"))})
+                if method == "POST":
+                    body = self._body()
+                    return self._send(201, api.record_event(
+                        body.get("kind", "Pod"), body["name"],
+                        body.get("type", "Normal"), body["reason"],
+                        body.get("message", "")))
             self._send(404, {"error": f"no route {method} {self.path}"})
 
         def do_GET(self):
@@ -265,6 +283,25 @@ class HTTPAPIClient:
 
     def delete_pod(self, name):
         return self._req("DELETE", f"/pods/{name}")
+
+    def create_pdb(self, pdb):
+        return self._req("POST", "/pdbs", pdb)
+
+    def list_pdbs(self):
+        return self._req("GET", "/pdbs")["items"]
+
+    def delete_pdb(self, name):
+        return self._req("DELETE", f"/pdbs/{name}")
+
+    def record_event(self, kind, name, event_type, reason, message):
+        return self._req("POST", "/events",
+                         {"kind": kind, "name": name, "type": event_type,
+                          "reason": reason, "message": message})
+
+    def list_events(self, involved_name=None):
+        path = "/events" + (f"?involved={involved_name}"
+                            if involved_name else "")
+        return self._req("GET", path)["items"]
 
     def acquire_lease(self, name, holder, ttl_s):
         try:

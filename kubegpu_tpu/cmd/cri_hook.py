@@ -5,7 +5,15 @@ CreateContainer and rewrites the container config. The modern equivalent
 plugs into containerd as an NRI/OCI hook: the runtime pipes the container
 config JSON to stdin and uses the rewritten JSON from stdout.
 
-    kgtpu-cri-hook --api ... --pod mypod --container main < config.json
+Preferred mode: thin client against the node agent's PERSISTENT rewrite
+endpoint (``--server http://127.0.0.1:PORT`` or ``unix:///run/kgtpu.sock``)
+— discovery ran once in the agent, and the interception path is a running
+server like the reference's (`docker_container.go:115-191`). Without
+``--server`` it falls back to standalone mode (own discovery pass per
+invocation) so the hook still works when no agent is running.
+
+    kgtpu-cri-hook --server unix:///run/kgtpu.sock \\
+        --pod mypod --container main < config.json
 """
 
 from __future__ import annotations
@@ -14,14 +22,14 @@ import argparse
 import json
 import sys
 
-from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
 from kubegpu_tpu.cmd import common
-from kubegpu_tpu.cmd.node_agent import build_manager
-from kubegpu_tpu.runtime.hook import TPURuntimeHook
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--server", default=None,
+                        help="node agent CRI endpoint (http://... or "
+                             "unix:///...); omit for standalone mode")
     parser.add_argument("--api", default="http://127.0.0.1:8070")
     parser.add_argument("--pod", required=True)
     parser.add_argument("--container", required=True)
@@ -31,15 +39,25 @@ def main(argv=None) -> int:
     parser.add_argument("--config", default=None)
     args = parser.parse_args(argv)
     common.merge_flags(args, common.load_config(args.config),
-                       ["api", "backend", "sysfs_root"])
+                       ["server", "api", "backend", "sysfs_root"])
 
     raw = sys.stdin.read()
     container_config = json.loads(raw) if raw.strip() else {}
 
-    client = HTTPAPIClient(args.api)
-    mgr = build_manager(args.backend, args.sysfs_root)
-    hook = TPURuntimeHook(client, mgr)
-    out = hook.create_container(args.pod, args.container, container_config)
+    if args.server:
+        from kubegpu_tpu.runtime.server import request_create_container
+
+        out = request_create_container(args.server, args.pod, args.container,
+                                       container_config)
+    else:
+        from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
+        from kubegpu_tpu.cmd.node_agent import build_manager
+        from kubegpu_tpu.runtime.hook import TPURuntimeHook
+
+        client = HTTPAPIClient(args.api)
+        mgr = build_manager(args.backend, args.sysfs_root)
+        hook = TPURuntimeHook(client, mgr)
+        out = hook.create_container(args.pod, args.container, container_config)
     json.dump(out, sys.stdout, indent=2, sort_keys=True)
     sys.stdout.write("\n")
     return 0

@@ -1,8 +1,11 @@
-"""``kgtpu-node-agent``: device discovery + advertiser (the node half).
+"""``kgtpu-node-agent``: device discovery + advertiser + CRI hook server.
 
 Reference: `crishim/pkg/app/app.go` — flag parsing, device plugin loading
-(here: backend selection), advertiser startup. The CRI interception half
-lives in ``kgtpu-cri-hook``.
+(here: backend selection), advertiser startup — plus the persistent CRI
+interception endpoint (`docker_container.go:115-191`: the reference's shim
+is a long-running gRPC CRI server, not a per-container CLI). The agent
+serves the rewrite endpoint on ``--cri-socket``/``--cri-port``;
+``kgtpu-cri-hook`` is the thin client a runtime's OCI-hook config execs.
 """
 
 from __future__ import annotations
@@ -46,10 +49,17 @@ def main(argv=None) -> int:
     parser.add_argument("--register-node", action="store_true",
                         help="create the node object if absent")
     parser.add_argument("--healthz-port", type=int, default=0)
+    parser.add_argument("--cri-socket", default=None,
+                        help="serve the CRI create-container rewrite "
+                             "endpoint on this unix socket")
+    parser.add_argument("--cri-port", type=int, default=None,
+                        help="serve the CRI rewrite endpoint on this "
+                             "loopback TCP port (0 = ephemeral)")
     parser.add_argument("--config", default=None)
     args = parser.parse_args(argv)
     common.merge_flags(args, common.load_config(args.config),
-                       ["api", "node_name", "backend", "sysfs_root"])
+                       ["api", "node_name", "backend", "sysfs_root",
+                        "cri_socket", "cri_port"])
 
     node_name = args.node_name or socket.gethostname()
     client = HTTPAPIClient(args.api)
@@ -65,12 +75,27 @@ def main(argv=None) -> int:
     adv.start(interval_s=args.advertise_interval, retry_s=args.retry_interval)
     common.serve_health(args.healthz_port,
                         extra_status=lambda: adv.patch_count > 0)
+
+    cri_server = None
+    if args.cri_socket or args.cri_port is not None:
+        from kubegpu_tpu.runtime.hook import TPURuntimeHook
+        from kubegpu_tpu.runtime.server import CRIHookServer
+
+        hook = TPURuntimeHook(client, mgr)
+        cri_server = CRIHookServer(
+            hook, unix_socket=args.cri_socket,
+            port=None if args.cri_socket else args.cri_port)
+        cri_server.start()
+        where = args.cri_socket or f"127.0.0.1:{cri_server.port}"
+        print(f"cri-hook serving on {where}", flush=True)
     print(f"node-agent advertising {node_name} -> {args.api}", flush=True)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    if cri_server is not None:
+        cri_server.stop()
     adv.stop()
     return 0
 

@@ -1,0 +1,184 @@
+"""The long-running CRI interception endpoint.
+
+Reference: `crishim/pkg/kubecri/docker_container.go:115-191` — the shim is
+a *persistent server* (dockershim wrapped in a gRPC CRI server plus a
+streaming HTTP server) that the runtime calls on every CreateContainer.
+A per-invocation CLI is not an interception path: nothing calls it unless
+something registers it.
+
+The TPU build's equivalent is NRI-plugin-shaped: the node agent serves a
+local HTTP endpoint (unix socket by default, loopback TCP optionally) and
+the container runtime — or the thin `kgtpu-cri-hook` client in its OCI
+hook configuration — POSTs the container config and uses the rewritten
+one:
+
+    POST /v1/create-container
+    {"pod": "name", "container": "main", "config": {...CRI JSON...}}
+    -> 200 {"config": {...rewritten...}}
+    -> 409 on AllocationMismatch (annotation/request disagree: refuse to
+       start, `docker_container.go:58-60`)
+    -> 404 when the pod is unknown to the API server
+
+The server shares the node agent's DevicesManager, so discovery happens
+once per process, not once per container create (the CLI's old behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubegpu_tpu.cluster.apiserver import NotFound as _NotFoundError
+from kubegpu_tpu.runtime.hook import AllocationMismatch
+
+
+class _UnixHTTPServer(ThreadingHTTPServer):
+    address_family = socket.AF_UNIX
+    daemon_threads = True
+
+    def server_bind(self):
+        # A stale socket file from a crashed agent must not block startup —
+        # but a LIVE socket (another agent serving) must: probe-connect
+        # before unlinking so a second agent fails loudly instead of
+        # silently stealing the endpoint.
+        try:
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.settimeout(0.5)
+            try:
+                probe.connect(self.server_address)
+                raise OSError(
+                    f"socket {self.server_address} is live (another agent?)")
+            except (ConnectionRefusedError, FileNotFoundError):
+                pass  # stale or absent: safe to (re)bind
+            finally:
+                probe.close()
+            os.unlink(self.server_address)
+        except FileNotFoundError:
+            pass
+        super().server_bind()
+
+    def client_address_string(self):  # pragma: no cover - logging only
+        return "local"
+
+
+class CRIHookServer:
+    """Serve `TPURuntimeHook.create_container` over a local endpoint."""
+
+    def __init__(self, hook, unix_socket: str | None = None,
+                 port: int | None = None, host: str = "127.0.0.1"):
+        if (unix_socket is None) == (port is None):
+            raise ValueError("exactly one of unix_socket / port required")
+        self.hook = hook
+        self.unix_socket = unix_socket
+        self.requests_served = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code: int, body: dict):
+                blob = json.dumps(body, sort_keys=True).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True,
+                                      "served": outer.requests_served})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/create-container":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    cfg = outer.hook.create_container(
+                        req.get("pod") or "", req.get("container") or "",
+                        req.get("config") or {})
+                except AllocationMismatch as e:
+                    self._reply(409, {"error": str(e)})
+                    return
+                except _NotFoundError as e:
+                    self._reply(404, {"error": f"pod not found: {e}"})
+                    return
+                except Exception as e:  # config must never crash the agent
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                outer.requests_served += 1
+                self._reply(200, {"config": cfg})
+
+        if unix_socket is not None:
+            self._server = _UnixHTTPServer(unix_socket, Handler)
+        else:
+            self._server = ThreadingHTTPServer((host, port), Handler)
+            self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int | None:
+        if self.unix_socket is not None:
+            return None
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="cri-hook")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self.unix_socket is not None:
+            try:
+                os.unlink(self.unix_socket)
+            except OSError:
+                pass
+
+
+def request_create_container(endpoint: str, pod: str, container: str,
+                             config: dict, timeout: float = 30.0) -> dict:
+    """Thin client used by `kgtpu-cri-hook`: POST a container config to a
+    running node agent. ``endpoint`` is ``http://host:port`` or
+    ``unix:///path/to.sock``."""
+    from http import client as http_client
+
+    body = json.dumps({"pod": pod, "container": container,
+                       "config": config}).encode()
+    if endpoint.startswith("unix://"):
+        path = endpoint[len("unix://"):]
+
+        class UnixConn(http_client.HTTPConnection):
+            def connect(self):
+                self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                self.sock.settimeout(timeout)
+                self.sock.connect(path)
+
+        conn = UnixConn("localhost", timeout=timeout)
+    else:
+        from urllib.parse import urlparse
+
+        u = urlparse(endpoint)
+        conn = http_client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/create-container", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        payload = json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+    if resp.status == 409:
+        raise AllocationMismatch(payload.get("error") or "allocation mismatch")
+    if resp.status != 200:
+        raise RuntimeError(
+            f"create-container failed ({resp.status}): {payload.get('error')}")
+    return payload["config"]

@@ -356,32 +356,108 @@ class GenericScheduler:
     def preempt(self, kube_pod: dict):
         """Find the best node to preempt on. Victim selection per the
         reference: remove ALL lower-priority pods, verify fit, then
-        reprieve victims highest-priority-first while the preemptor still
-        fits — so a cheap low-priority pod survives when evicting one big
-        pod sufficed. Node selection (pickOneNodeForPreemption): lowest
-        highest-victim-priority, then lowest priority sum, then fewest
-        victims, then lexical node name for determinism. Returns
+        reprieve victims — PDB-violating candidates first, then the rest,
+        highest-priority-first — while the preemptor still fits, so a
+        cheap low-priority pod survives when evicting one big pod
+        sufficed. Node selection (pickOneNodeForPreemption,
+        `generic_scheduler.go:674-699`): fewest PDB violations, then
+        lowest highest-victim-priority, then lowest priority sum, then
+        fewest victims, then lexical node name for determinism. Returns
         (node_name, victim pod dicts) or None."""
         prio = _pod_priority(kube_pod)
         # The cluster-wide inter-pod metadata is built ONCE per preemption
         # pass and filtered per-simulation (victims removed), mirroring the
         # reference re-running podFitsOnNode with adjusted metadata.
         meta = self._interpod_meta(kube_pod)
+        pdb_state = self._pdb_state()
         best = None
         best_key = None
         for node_name in self.cache.node_names():
             snap = self.cache.snapshot_node(node_name)
             if snap is None:
                 continue
-            victims = self._victims_on_node(kube_pod, snap, prio, meta)
-            if victims is None:
+            found = self._victims_on_node(kube_pod, snap, prio, meta,
+                                          pdb_state)
+            if found is None:
                 continue
-            key = (max(_pod_priority(v) for v in victims),
+            victims, violations = found
+            key = (violations,
+                   max(_pod_priority(v) for v in victims),
                    sum(_pod_priority(v) for v in victims),
                    len(victims), node_name)
             if best_key is None or key < best_key:
                 best, best_key = (node_name, victims), key
         return best
+
+    @staticmethod
+    def _labels_match(selector: dict, pod: dict) -> bool:
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    def _pdb_state(self):
+        """Per-PDB disruption allowance, computed once per preemption pass:
+        allowed = (bound pods matching the selector) - minAvailable. The
+        reference reads pdb.Status.PodDisruptionsAllowed; here the status
+        is derived on the fly since this scheduler is the only writer.
+        minAvailable accepts an absolute count or a "50%" string (of
+        currently-matching pods, rounded up, like upstream); a malformed
+        PDB is skipped, never allowed to break the preemption pass."""
+        import math
+
+        api = getattr(self, "api", None)
+        list_pdbs = getattr(api, "list_pdbs", None)
+        if list_pdbs is None:
+            return []
+        try:
+            pdbs = list_pdbs()
+            if not pdbs:
+                return []
+            bound = [p for p in api.list_pods()
+                     if (p.get("spec") or {}).get("nodeName")]
+        except Exception:
+            return []
+        state = []
+        for pdb in pdbs:
+            try:
+                spec = pdb.get("spec") or {}
+                selector = (spec.get("selector") or {}).get("matchLabels") or {}
+                if not selector:
+                    continue
+                healthy = sum(1 for p in bound
+                              if self._labels_match(selector, p))
+                raw = spec.get("minAvailable") or 0
+                if isinstance(raw, str) and raw.endswith("%"):
+                    min_avail = math.ceil(healthy * int(raw[:-1]) / 100.0)
+                else:
+                    min_avail = int(raw)
+                state.append({"selector": selector,
+                              "allowed": healthy - min_avail})
+            except Exception:
+                continue  # malformed PDB: ignore it, don't drop the pod
+        return state
+
+    @staticmethod
+    def _split_by_pdb_violation(candidates: list, pdb_state: list):
+        """Partition candidate victims into (violating, non_violating) the
+        way upstream's filterPodsWithPDBViolation does: walk candidates
+        highest-priority-first (then name for determinism) with a copy of
+        each PDB's allowance; a pod whose eviction would breach a matching
+        PDB (allowance exhausted) is violating, otherwise it consumes one
+        unit of allowance."""
+        allowed = [dict(s) for s in pdb_state]
+        violating, ok = [], []
+        for pod in sorted(candidates,
+                          key=lambda p: (-_pod_priority(p),
+                                         p["metadata"]["name"])):
+            matched = [s for s in allowed
+                       if GenericScheduler._labels_match(s["selector"], pod)]
+            if any(s["allowed"] <= 0 for s in matched):
+                violating.append(pod)
+            else:
+                for s in matched:
+                    s["allowed"] -= 1
+                ok.append(pod)
+        return violating, ok
 
     def _fits_after_evictions(self, kube_pod, snap, meta, evicted: set):
         """Full predicate chain against the mutated snapshot — taints,
@@ -398,7 +474,8 @@ class GenericScheduler:
         fits, _, _ = self._run_predicates(kube_pod, snap, sim_meta)
         return fits
 
-    def _victims_on_node(self, kube_pod, snap, prio, meta=None):
+    def _victims_on_node(self, kube_pod, snap, prio, meta=None,
+                         pdb_state: list | None = None):
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
         from kubegpu_tpu.scheduler.predicates import (pod_host_ports,
                                                       pod_volumes)
@@ -451,18 +528,28 @@ class GenericScheduler:
             charge(victim, -1)
         if not self._fits_after_evictions(kube_pod, snap, meta, evicted):
             return None
-        # Phase 2: reprieve — re-admit in descending priority (then name
-        # for determinism); keep each pod that doesn't break the fit.
-        candidates.sort(key=lambda p: (-_pod_priority(p),
-                                       p["metadata"]["name"]))
+        # Phase 2: reprieve — PDB-violating candidates FIRST (so they're
+        # kept whenever possible, minimizing violations), then the rest;
+        # within each class in descending priority (then name for
+        # determinism); keep each pod that doesn't break the fit
+        # (upstream selectVictimsOnNode's two-pass reprieve).
+        violating, non_violating = self._split_by_pdb_violation(
+            candidates, pdb_state or [])
+        violating_names = {p["metadata"]["name"] for p in violating}
+        by_prio = lambda p: (-_pod_priority(p), p["metadata"]["name"])  # noqa: E731
         victims = []
-        for pod in candidates:
+        for pod in sorted(violating, key=by_prio) + \
+                sorted(non_violating, key=by_prio):
             charge(pod, +1)
             if self._fits_after_evictions(kube_pod, snap, meta, evicted):
                 continue  # reprieved
             charge(pod, -1)
             victims.append(pod)
-        return victims or None
+        if not victims:
+            return None
+        violations = sum(1 for v in victims
+                         if v["metadata"]["name"] in violating_names)
+        return victims, violations
 
 
 class Scheduler:
@@ -558,15 +645,19 @@ class Scheduler:
         try:
             host = self.generic.schedule(kube_pod)
             self.generic.allocate_devices(kube_pod, host)
-        except FitError:
+        except FitError as err:
             metrics.SCHEDULE_FAILURES.inc()
+            self._event(name, "Warning", "FailedScheduling",
+                        self._summarize_failures(err.failures))
             if self.preemption_enabled and self._try_preempt(kube_pod):
                 self.queue.push(kube_pod)
             else:
                 self.queue.add_unschedulable(kube_pod)
             return True
-        except Exception:
+        except Exception as err:
             metrics.SCHEDULE_FAILURES.inc()
+            self._event(name, "Warning", "FailedScheduling",
+                        f"{type(err).__name__}: {err}")
             self.queue.add_unschedulable(kube_pod)
             return True
 
@@ -644,14 +735,43 @@ class Scheduler:
 
     NOMINATED_NODE_ANNOTATION = "scheduler.alpha.kubernetes.io/nominated-node-name"
 
+    def _event(self, pod_name: str, event_type: str, reason: str,
+               message: str) -> None:
+        """Record a scheduling Event on the pod (`scheduler.go:198,242`);
+        observability only — an API hiccup must never affect scheduling."""
+        record = getattr(self.api, "record_event", None)
+        if record is None:
+            return
+        try:
+            record("Pod", pod_name, event_type, reason, message)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _summarize_failures(failures: dict, cap: int = 5) -> str:
+        """Aggregate per-node failure reasons into the compact
+        '0/N nodes are available: M reason' shape operators expect."""
+        counts: dict = {}
+        for reasons in failures.values():
+            for reason in reasons or ["unknown"]:
+                counts[reason] = counts.get(reason, 0) + 1
+        parts = [f"{n} {r}" for r, n in
+                 sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:cap]]
+        return (f"0/{len(failures)} nodes are available: "
+                + "; ".join(parts) + ".")
+
     def _try_preempt(self, kube_pod: dict) -> bool:
         found = self.generic.preempt(kube_pod)
         if not found:
             return False
         node_name, victims = found
+        preemptor = kube_pod["metadata"]["name"]
         for victim in victims:
             metrics.PREEMPTION_VICTIMS.inc()
-            self.api.delete_pod(victim["metadata"]["name"])
+            victim_name = victim["metadata"]["name"]
+            self._event(victim_name, "Normal", "Preempted",
+                        f"by pod {preemptor} on node {node_name}")
+            self.api.delete_pod(victim_name)
         # record where the preemption made room (upstream's nominated
         # node). Must be persisted via the API: the next scheduling pass
         # re-fetches the pod, so a local-dict-only annotation would be lost.
@@ -680,6 +800,8 @@ class Scheduler:
             return
         self.cache.confirm_pod(name)
         self.queue.forget(name)  # clears any leftover backoff state
+        self._event(name, "Normal", "Scheduled",
+                    f"Successfully assigned {name} to {host}")
         now = time.perf_counter()
         metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
         metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)

@@ -124,9 +124,11 @@ def test_real_processes_end_to_end(tmp_path):
         write_sysfs_fixture(sysfs, v5p_host_inventory())
         backend = ["--backend", "native", "--sysfs-root", sysfs] \
             if native.build_native() else ["--backend", "fake-v5p"]
+        cri_sock = str(tmp_path / "kgtpu-cri.sock")
         spawn("kubegpu_tpu.cmd.node_agent", "--api", url,
               "--node-name", "host0", "--register-node",
-              "--advertise-interval", "0.2", *backend)
+              "--advertise-interval", "0.2", "--cri-socket", cri_sock,
+              *backend)
         spawn("kubegpu_tpu.cmd.scheduler_main", "--api", url)
 
         client = HTTPAPIClient(url)
@@ -146,15 +148,27 @@ def test_real_processes_end_to_end(tmp_path):
             time.sleep(0.1)
         assert client.get_pod("job")["spec"].get("nodeName") == "host0"
 
+        # container create flows through the RUNNING node-agent process:
+        # the CLI is a thin client of the agent's persistent CRI endpoint
+        # (`docker_container.go:115-191` — a served interception path).
         hook = subprocess.run(
-            [sys.executable, "-m", "kubegpu_tpu.cmd.cri_hook", "--api", url,
-             "--pod", "job", "--container", "main", *backend],
+            [sys.executable, "-m", "kubegpu_tpu.cmd.cri_hook",
+             "--server", f"unix://{cri_sock}",
+             "--pod", "job", "--container", "main"],
             cwd=REPO, input="{}", capture_output=True, text=True, timeout=30)
         assert hook.returncode == 0, hook.stderr
         cfg = json.loads(hook.stdout)
         env = {e["key"]: e["value"] for e in cfg["envs"]}
         assert env["TPU_VISIBLE_CHIPS"]
         assert len(env["TPU_CHIP_IDS"].split(",")) == 2
+
+        # standalone fallback (no agent endpoint) still works
+        hook2 = subprocess.run(
+            [sys.executable, "-m", "kubegpu_tpu.cmd.cri_hook", "--api", url,
+             "--pod", "job", "--container", "main", *backend],
+            cwd=REPO, input="{}", capture_output=True, text=True, timeout=30)
+        assert hook2.returncode == 0, hook2.stderr
+        assert json.loads(hook2.stdout)["envs"] == cfg["envs"]
         client.close()
     finally:
         for p in procs:

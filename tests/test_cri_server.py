@@ -1,0 +1,114 @@
+"""CRI hook server: the persistent interception endpoint
+(`docker_container.go:115-191` analogue) and its thin client."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
+from kubegpu_tpu.node.fake import FakeTPUBackend, v5p_host_inventory
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+from kubegpu_tpu.runtime.hook import AllocationMismatch, TPURuntimeHook
+from kubegpu_tpu.runtime.server import (CRIHookServer,
+                                        request_create_container)
+
+G = "alpha/grpresource"
+
+
+@pytest.fixture
+def served():
+    api = InMemoryAPIServer()
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(FakeTPUBackend(v5p_host_inventory())))
+    mgr.start()
+    server = CRIHookServer(TPURuntimeHook(api, mgr), port=0)
+    server.start()
+    yield api, f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+def allocated_pod(api, name="job"):
+    pi = PodInfo(name=name, node_name="host0")
+    chips = [c for c in v5p_host_inventory().chips[:2]]
+    cont = ContainerInfo(requests={grammar.RESOURCE_NUM_CHIPS: 2})
+    for chip in chips:
+        path = f"{G}/tpu/{chip.chip_id}/{grammar.CHIPS_SUFFIX}"
+        cont.dev_requests[path] = 1
+        cont.allocate_from[path] = path
+    pi.running_containers["main"] = cont
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    api.create_pod({"metadata": meta, "spec": {"containers": [{"name": "main"}]}})
+
+
+def post(url, body):
+    req = urllib.request.Request(
+        f"{url}/v1/create-container", json.dumps(body).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_served_rewrite_injects_devices_and_env(served):
+    api, url = served
+    allocated_pod(api)
+    cfg = request_create_container(url, "job", "main", {"devices": [
+        {"host_path": "/dev/accel9", "container_path": "/dev/accel9"}]})
+    env = {e["key"]: e["value"] for e in cfg["envs"]}
+    assert len(env["TPU_CHIP_IDS"].split(",")) == 2
+    # pre-existing TPU device entries were stripped, allocation appended
+    assert all(d["host_path"] != "/dev/accel9" for d in cfg["devices"])
+    assert cfg["devices"]
+
+
+def test_served_unknown_pod_is_404(served):
+    _, url = served
+    code, body = post(url, {"pod": "ghost", "container": "main", "config": {}})
+    assert code == 404 and "ghost" in body["error"]
+
+
+def test_served_allocation_mismatch_is_409(served):
+    api, url = served
+    # pod requesting 2 chips with an EMPTY allocation: refuse container start
+    pi = PodInfo(name="bad", node_name="host0")
+    pi.running_containers["main"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: 2})
+    meta = {"name": "bad"}
+    codec.pod_info_to_annotation(meta, pi)
+    api.create_pod({"metadata": meta, "spec": {"containers": [{"name": "main"}]}})
+    code, body = post(url, {"pod": "bad", "container": "main", "config": {}})
+    assert code == 409
+    with pytest.raises(AllocationMismatch):
+        request_create_container(url, "bad", "main", {})
+
+
+def test_served_healthz_counts(served):
+    api, url = served
+    allocated_pod(api, "j2")
+    request_create_container(url, "j2", "main", {})
+    with urllib.request.urlopen(f"{url}/healthz", timeout=5) as resp:
+        health = json.loads(resp.read())
+    assert health["ok"] and health["served"] == 1
+
+
+def test_unix_socket_roundtrip(tmp_path):
+    api = InMemoryAPIServer()
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(FakeTPUBackend(v5p_host_inventory())))
+    mgr.start()
+    sock = str(tmp_path / "cri.sock")
+    server = CRIHookServer(TPURuntimeHook(api, mgr), unix_socket=sock)
+    server.start()
+    try:
+        allocated_pod(api)
+        cfg = request_create_container(f"unix://{sock}", "job", "main", {})
+        env = {e["key"]: e["value"] for e in cfg["envs"]}
+        assert env["TPU_VISIBLE_CHIPS"]
+    finally:
+        server.stop()

@@ -299,3 +299,88 @@ def test_device_verdict_pinned_variant_keys_are_distinct():
     keys = list(sched.generic._device_verdicts)
     pinned_flags = {k[-1] for k in keys}
     assert pinned_flags == {True, False}  # one entry per variant
+
+
+# ---- PDB-aware preemption + Events (VERDICT missing #3, #5) ----------------
+
+
+def test_pdb_redirects_victim_choice():
+    """Two nodes can host the preemptor; the one whose victims violate a
+    PodDisruptionBudget must lose (`generic_scheduler.go:674-699`)."""
+    api = InMemoryAPIServer()
+    api.create_node(tpu_node("nodeA", chips=2))
+    api.create_node(tpu_node("nodeB", chips=2))
+    sched = make_scheduler(api)
+    # protected pod on nodeA (PDB requires all 1 replica available)
+    protected = tpu_pod("protected", 2, priority=0)
+    protected["metadata"]["labels"] = {"app": "db"}
+    protected["spec"]["nodeSelector"] = None  # keep shape simple
+    del protected["spec"]["nodeSelector"]
+    api.create_pod(protected)
+    sched.run_until_idle()
+    victim_b = tpu_pod("plain", 2, priority=0)
+    api.create_pod(victim_b)
+    sched.run_until_idle()
+    placed = {p["metadata"]["name"]: p["spec"].get("nodeName")
+              for p in api.list_pods()}
+    assert set(placed.values()) == {"nodeA", "nodeB"}
+    api.create_pdb({"metadata": {"name": "db-pdb"},
+                    "spec": {"selector": {"matchLabels": {"app": "db"}},
+                             "minAvailable": 1}})
+    # preemptor fits on either node only via eviction
+    api.create_pod(tpu_pod("high", 2, priority=100))
+    sched.run_until_idle()
+    survivors = {p["metadata"]["name"] for p in api.list_pods()}
+    assert "protected" in survivors      # PDB steered preemption away
+    assert "plain" not in survivors      # the unprotected pod was evicted
+    assert api.get_pod("high")["spec"]["nodeName"] == placed["plain"]
+
+
+def test_pdb_violated_as_last_resort():
+    """With only PDB-protected victims available, preemption still
+    proceeds (upstream semantics: PDB violation is minimized, not
+    forbidden) — and picks the node with fewest violations."""
+    api = InMemoryAPIServer()
+    api.create_node(tpu_node("only", chips=2))
+    sched = make_scheduler(api)
+    protected = tpu_pod("protected", 2, priority=0)
+    protected["metadata"]["labels"] = {"app": "db"}
+    api.create_pod(protected)
+    sched.run_until_idle()
+    api.create_pdb({"metadata": {"name": "db-pdb"},
+                    "spec": {"selector": {"matchLabels": {"app": "db"}},
+                             "minAvailable": 1}})
+    api.create_pod(tpu_pod("high", 2, priority=100))
+    sched.run_until_idle()
+    assert api.get_pod("high")["spec"]["nodeName"] == "only"
+    assert not any(p["metadata"]["name"] == "protected"
+                   for p in api.list_pods())
+
+
+def test_events_recorded_on_schedule_fail_preempt():
+    api = InMemoryAPIServer()
+    api.create_node(tpu_node("host0", chips=2))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("first", 2, priority=0))
+    sched.run_until_idle()
+    assert any(e["reason"] == "Scheduled"
+               for e in api.list_events(involved_name="first"))
+    # unschedulable pod -> FailedScheduling with the 0/N summary
+    api.create_pod(tpu_pod("toobig", 9))
+    sched.run_until_idle()
+    failed = [e for e in api.list_events(involved_name="toobig")
+              if e["reason"] == "FailedScheduling"]
+    assert failed and failed[0]["message"].startswith("0/1 nodes")
+    # preemption -> Preempted event on the victim
+    api.create_pod(tpu_pod("high", 2, priority=50))
+    sched.run_until_idle()
+    assert any(e["reason"] == "Preempted" and "high" in e["message"]
+               for e in api.list_events(involved_name="first"))
+
+
+def test_event_dedup_increments_count():
+    api = InMemoryAPIServer()
+    api.record_event("Pod", "p", "Warning", "FailedScheduling", "no chips")
+    api.record_event("Pod", "p", "Warning", "FailedScheduling", "no chips")
+    evs = api.list_events(involved_name="p")
+    assert len(evs) == 1 and evs[0]["count"] == 2
