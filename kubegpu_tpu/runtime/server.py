@@ -74,6 +74,7 @@ class CRIHookServer:
         self.hook = hook
         self.unix_socket = unix_socket
         self.requests_served = 0
+        self._count_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -114,7 +115,8 @@ class CRIHookServer:
                 except Exception as e:  # config must never crash the agent
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
-                outer.requests_served += 1
+                with outer._count_lock:
+                    outer.requests_served += 1
                 self._reply(200, {"config": cfg})
 
         if unix_socket is not None:

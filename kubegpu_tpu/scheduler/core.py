@@ -747,18 +747,22 @@ class Scheduler:
         except Exception:
             pass
 
-    @staticmethod
-    def _summarize_failures(failures: dict, cap: int = 5) -> str:
+    def _summarize_failures(self, failures: dict, cap: int = 5) -> str:
         """Aggregate per-node failure reasons into the compact
-        '0/N nodes are available: M reason' shape operators expect."""
+        '0/N nodes are available: M reason' shape operators expect. N is
+        the CLUSTER node count — a FitError raised outside the main
+        predicate pass (e.g. allocate_devices on a vanished node) carries
+        only the offending node in ``failures``."""
+        total = len(self.cache.node_names())
         counts: dict = {}
         for reasons in failures.values():
             for reason in reasons or ["unknown"]:
                 counts[reason] = counts.get(reason, 0) + 1
+        if not counts:
+            return "no nodes available to schedule pods"
         parts = [f"{n} {r}" for r, n in
                  sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:cap]]
-        return (f"0/{len(failures)} nodes are available: "
-                + "; ".join(parts) + ".")
+        return (f"0/{total} nodes are available: " + "; ".join(parts) + ".")
 
     def _try_preempt(self, kube_pod: dict) -> bool:
         found = self.generic.preempt(kube_pod)

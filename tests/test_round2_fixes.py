@@ -314,8 +314,6 @@ def test_pdb_redirects_victim_choice():
     # protected pod on nodeA (PDB requires all 1 replica available)
     protected = tpu_pod("protected", 2, priority=0)
     protected["metadata"]["labels"] = {"app": "db"}
-    protected["spec"]["nodeSelector"] = None  # keep shape simple
-    del protected["spec"]["nodeSelector"]
     api.create_pod(protected)
     sched.run_until_idle()
     victim_b = tpu_pod("plain", 2, priority=0)
