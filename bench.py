@@ -207,6 +207,30 @@ def config5():
     return lat, statistics.mean(local)
 
 
+def config_preempt():
+    """64-host cluster with every chip held by low-priority pods; each
+    iteration submits a high-priority 4-chip pod that can only land via
+    preemption. Measures the full fail->victim-search->evict->reschedule->
+    bind latency — the parallel victim search (and the potential-node
+    filter) is what keeps this flat at cluster scale."""
+    c = Cluster([v5p_host_inventory() for _ in range(64)])
+    for i in range(64):
+        for j in range(2):
+            c.api.create_pod(make_pod(f"low{i}-{j}", 2))
+    c.sched.run_until_idle()
+    lat = []
+    for k in range(8):
+        pod = make_pod(f"hi{k}", 4)
+        pod["spec"]["priority"] = 100
+        t0 = time.perf_counter()
+        c.api.create_pod(pod)
+        c.sched.run_until_idle()
+        t1 = time.perf_counter()
+        assert c.api.get_pod(f"hi{k}")["spec"].get("nodeName")
+        lat.append(t1 - t0)
+    return lat
+
+
 def config_http():
     """VERDICT r1 weak #1: the headline p50 is measured against the
     in-memory API server; the real binaries talk HTTP. This config drives
@@ -489,6 +513,9 @@ def main():
     http_lat = config_http()
     per_config["http_transport_p50_ms"] = round(
         statistics.median(http_lat) * 1e3, 3)
+    preempt_lat = config_preempt()
+    per_config["preempt_64node_p50_ms"] = round(
+        statistics.median(preempt_lat) * 1e3, 3)
     per_config.update(workload_metrics())
     result = {
         "metric": "p50_pod_schedule_latency_ms",

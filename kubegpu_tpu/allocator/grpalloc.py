@@ -457,11 +457,11 @@ def _native_pod_fits(node: NodeInfo, pod: PodInfo, allocating: bool):
 
     if native.get_lib() is None or not hasattr(native.get_lib(), "grp_allocate"):
         return None
-    def _unsafe(token: str) -> bool:
-        # The line protocol is whitespace-delimited: any token with
-        # whitespace (possible — pod annotations are user-writable) would
-        # inject lines and silently diverge from the Python reference.
-        return any(ch.isspace() for ch in token)
+    # The line protocol is whitespace-delimited: any token with whitespace
+    # (possible — pod annotations are user-writable) would inject lines and
+    # silently diverge from the Python reference. Compiled regex: this runs
+    # per token on the preemption/fit hot path.
+    _unsafe = _WS_RE.search
 
     try:
         lines = []

@@ -353,7 +353,30 @@ class GenericScheduler:
 
     # ---- preemption (`generic_scheduler.go:226-290`) ----------------------
 
-    def preempt(self, kube_pod: dict):
+    # Failure-reason markers no eviction can cure: node identity, labels,
+    # taints, conditions. A node that failed ONLY on these is excluded
+    # from the victim search (upstream nodesWherePreemptionMightHelp) —
+    # on a big cluster this prunes most nodes before the expensive
+    # evict-and-reprieve simulation.
+    UNRESOLVABLE_MARKERS = (
+        "didn't match the requested hostname",
+        "didn't match node selector",
+        "didn't match pod affinity rules",   # NODE affinity (predicates.py)
+        "were unschedulable",
+        "that the pod didn't tolerate",
+        "were not ready",
+        "had MemoryPressure",
+        "had DiskPressure",
+        "didn't satisfy label presence",
+        "had no available volume zone",
+    )
+
+    @classmethod
+    def _preemption_might_help(cls, reasons: list) -> bool:
+        return not any(marker in reason for reason in reasons
+                       for marker in cls.UNRESOLVABLE_MARKERS)
+
+    def preempt(self, kube_pod: dict, failures: dict | None = None):
         """Find the best node to preempt on. Victim selection per the
         reference: remove ALL lower-priority pods, verify fit, then
         reprieve victims — PDB-violating candidates first, then the rest,
@@ -370,24 +393,47 @@ class GenericScheduler:
         # reference re-running podFitsOnNode with adjusted metadata.
         meta = self._interpod_meta(kube_pod)
         pdb_state = self._pdb_state()
-        best = None
-        best_key = None
-        for node_name in self.cache.node_names():
+        names = self.cache.node_names()
+        if failures is not None:
+            names = [n for n in names
+                     if self._preemption_might_help(failures.get(n) or [])]
+        # One pod-list fetch and ONE preemptor parse for the whole pass —
+        # the simulation re-checks fit ~2x per candidate per node, so
+        # per-check API fetches/JSON decodes would dominate at 64 nodes.
+        api = getattr(self, "api", None)
+        if api is None:
+            return None
+        try:
+            pods_by_name = {p["metadata"]["name"]: p
+                            for p in api.list_pods()}
+        except Exception:
+            return None
+        pod_info_get = self._pod_info_provider(kube_pod)
+
+        def eval_node(node_name):
             snap = self.cache.snapshot_node(node_name)
             if snap is None:
-                continue
+                return None
             found = self._victims_on_node(kube_pod, snap, prio, meta,
-                                          pdb_state)
+                                          pdb_state, pods_by_name,
+                                          pod_info_get)
             if found is None:
-                continue
+                return None
             victims, violations = found
             key = (violations,
                    max(_pod_priority(v) for v in victims),
                    sum(_pod_priority(v) for v in victims),
                    len(victims), node_name)
-            if best_key is None or key < best_key:
-                best, best_key = (node_name, victims), key
-        return best
+            return key, (node_name, victims)
+
+        # Victim search parallelized over nodes with the fit pool — each
+        # worker simulates on its own snapshot (the reference runs this
+        # 16-way too). min() over keys keeps selection deterministic.
+        results = [r for r in self._pool.map(eval_node, names)
+                   if r is not None]
+        if not results:
+            return None
+        return min(results, key=lambda r: r[0])[1]
 
     @staticmethod
     def _labels_match(selector: dict, pod: dict) -> bool:
@@ -459,7 +505,8 @@ class GenericScheduler:
                 ok.append(pod)
         return violating, ok
 
-    def _fits_after_evictions(self, kube_pod, snap, meta, evicted: set):
+    def _fits_after_evictions(self, kube_pod, snap, meta, evicted: set,
+                              pod_info_get=None):
         """Full predicate chain against the mutated snapshot — taints,
         selectors, volume conflicts, inter-pod terms AND device fit — the
         reference's podFitsOnNode during preemption. A node where only
@@ -471,11 +518,14 @@ class GenericScheduler:
                 meta.node_labels,
                 [p for p in meta.pods if not (p.node_name == snap.name
                                               and p.name in evicted)])
-        fits, _, _ = self._run_predicates(kube_pod, snap, sim_meta)
+        fits, _, _ = self._run_predicates(kube_pod, snap, sim_meta,
+                                          pod_info_get)
         return fits
 
     def _victims_on_node(self, kube_pod, snap, prio, meta=None,
-                         pdb_state: list | None = None):
+                         pdb_state: list | None = None,
+                         pods_by_name: dict | None = None,
+                         pod_info_get=None):
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
         from kubegpu_tpu.scheduler.predicates import (pod_host_ports,
                                                       pod_volumes)
@@ -486,10 +536,15 @@ class GenericScheduler:
             return None
         candidates = []
         for pod_name in sorted(snap.pod_names):
-            try:
-                p = api.get_pod(pod_name)
-            except NotFound:
-                continue
+            if pods_by_name is not None:
+                p = pods_by_name.get(pod_name)
+                if p is None:
+                    continue
+            else:
+                try:
+                    p = api.get_pod(pod_name)
+                except NotFound:
+                    continue
             if _pod_priority(p) < prio:
                 candidates.append(p)
         if not candidates:
@@ -526,7 +581,8 @@ class GenericScheduler:
         # fit, this node can't be helped by preemption.
         for victim in candidates:
             charge(victim, -1)
-        if not self._fits_after_evictions(kube_pod, snap, meta, evicted):
+        if not self._fits_after_evictions(kube_pod, snap, meta, evicted,
+                                          pod_info_get):
             return None
         # Phase 2: reprieve — PDB-violating candidates FIRST (so they're
         # kept whenever possible, minimizing violations), then the rest;
@@ -541,7 +597,8 @@ class GenericScheduler:
         for pod in sorted(violating, key=by_prio) + \
                 sorted(non_violating, key=by_prio):
             charge(pod, +1)
-            if self._fits_after_evictions(kube_pod, snap, meta, evicted):
+            if self._fits_after_evictions(kube_pod, snap, meta, evicted,
+                                          pod_info_get):
                 continue  # reprieved
             charge(pod, -1)
             victims.append(pod)
@@ -649,7 +706,8 @@ class Scheduler:
             metrics.SCHEDULE_FAILURES.inc()
             self._event(name, "Warning", "FailedScheduling",
                         self._summarize_failures(err.failures))
-            if self.preemption_enabled and self._try_preempt(kube_pod):
+            if self.preemption_enabled and \
+                    self._try_preempt(kube_pod, err.failures):
                 self.queue.push(kube_pod)
             else:
                 self.queue.add_unschedulable(kube_pod)
@@ -764,8 +822,9 @@ class Scheduler:
                  sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:cap]]
         return (f"0/{total} nodes are available: " + "; ".join(parts) + ".")
 
-    def _try_preempt(self, kube_pod: dict) -> bool:
-        found = self.generic.preempt(kube_pod)
+    def _try_preempt(self, kube_pod: dict,
+                     failures: dict | None = None) -> bool:
+        found = self.generic.preempt(kube_pod, failures)
         if not found:
             return False
         node_name, victims = found
