@@ -74,13 +74,23 @@ BINDING_LATENCY = Histogram("scheduler_binding_latency_microseconds")
 SCHEDULE_ATTEMPTS = Counter("scheduler_schedule_attempts_total")
 SCHEDULE_FAILURES = Counter("scheduler_schedule_failures_total")
 PREEMPTION_VICTIMS = Counter("scheduler_preemption_victims_total")
+# Internal faults (non-FitError exceptions escaping the scheduling
+# algorithm) — these indicate a code bug, not an unschedulable pod, and
+# must stay distinguishable from ordinary failures (the reference panics
+# on corrupted internal state: `node_info.go:336-340`).
+INTERNAL_ERRORS = Counter("scheduler_internal_errors_total")
+# Native allocator faults that degraded to the Python path — the log is
+# one-shot per process, so the counter is how a persistent native break
+# (a silent performance cliff) stays visible.
+NATIVE_FALLBACKS = Counter("allocator_native_fallbacks_total")
 
 
 def reset_all() -> None:
     """Fresh metric state (tests and bench runs)."""
     for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY):
         h.__init__(h.name)
-    for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS):
+    for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS,
+              INTERNAL_ERRORS, NATIVE_FALLBACKS):
         c.__init__(c.name)
 
 

