@@ -159,6 +159,97 @@ class InMemoryAPIServer:
             if pod is not None:
                 self._notify("pod", "deleted", pod)
 
+    # ---- persistent volumes / claims ---------------------------------------
+    # The volume-binding surface the scheduler consumes
+    # (`volumebinder/volume_binder.go:1-74`,
+    # `predicates.go:1443-1465`): PVCs reference storage demands, PVs
+    # carry capacity + node affinity, and `bind_volume` commits a
+    # claim<->volume pairing atomically (both objects flip to Bound).
+    #
+    # PVC: {"metadata": {"name"}, "spec": {"resources": {"requests":
+    #   {"storage": "10Gi"}}, "storageClassName", "volumeName"?}}
+    # PV:  {"metadata": {"name"}, "spec": {"capacity": {"storage": ...},
+    #   "storageClassName", "nodeAffinity": {"required":
+    #   {"nodeSelectorTerms": [...]}}, "claimRef"?}}
+
+    def create_pvc(self, pvc: dict) -> dict:
+        with self._lock:
+            name = pvc["metadata"]["name"]
+            if name in self._pvcs:
+                raise Conflict(f"pvc {name} exists")
+            stored = copy.deepcopy(pvc)
+            stored.setdefault("status", {"phase": "Pending"})
+            self._pvcs[name] = stored
+            self._notify("pvc", "added", stored)
+            return copy.deepcopy(stored)
+
+    def get_pvc(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._pvcs:
+                raise NotFound(f"pvc {name}")
+            return copy.deepcopy(self._pvcs[name])
+
+    def list_pvcs(self) -> list:
+        with self._lock:
+            return [copy.deepcopy(p) for _, p in sorted(self._pvcs.items())]
+
+    def delete_pvc(self, name: str) -> None:
+        with self._lock:
+            pvc = self._pvcs.pop(name, None)
+            if pvc is not None:
+                self._notify("pvc", "deleted", pvc)
+
+    def create_pv(self, pv: dict) -> dict:
+        with self._lock:
+            name = pv["metadata"]["name"]
+            if name in self._pvs:
+                raise Conflict(f"pv {name} exists")
+            stored = copy.deepcopy(pv)
+            stored.setdefault("status", {"phase": "Available"})
+            self._pvs[name] = stored
+            self._notify("pv", "added", stored)
+            return copy.deepcopy(stored)
+
+    def get_pv(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._pvs:
+                raise NotFound(f"pv {name}")
+            return copy.deepcopy(self._pvs[name])
+
+    def list_pvs(self) -> list:
+        with self._lock:
+            return [copy.deepcopy(p) for _, p in sorted(self._pvs.items())]
+
+    def delete_pv(self, name: str) -> None:
+        with self._lock:
+            pv = self._pvs.pop(name, None)
+            if pv is not None:
+                self._notify("pv", "deleted", pv)
+
+    def bind_volume(self, pv_name: str, claim_name: str) -> None:
+        """Atomically pair a PV with a PVC: PV gains ``claimRef`` and PVC
+        gains ``volumeName``; both flip to Bound. Conflict if either side
+        is already paired elsewhere."""
+        with self._lock:
+            if pv_name not in self._pvs:
+                raise NotFound(f"pv {pv_name}")
+            if claim_name not in self._pvcs:
+                raise NotFound(f"pvc {claim_name}")
+            pv, pvc = self._pvs[pv_name], self._pvcs[claim_name]
+            ref = (pv.get("spec") or {}).get("claimRef")
+            if ref and ref.get("name") != claim_name:
+                raise Conflict(f"pv {pv_name} already claimed by "
+                               f"{ref.get('name')}")
+            bound = (pvc.get("spec") or {}).get("volumeName")
+            if bound and bound != pv_name:
+                raise Conflict(f"pvc {claim_name} already bound to {bound}")
+            pv.setdefault("spec", {})["claimRef"] = {"name": claim_name}
+            pv.setdefault("status", {})["phase"] = "Bound"
+            pvc.setdefault("spec", {})["volumeName"] = pv_name
+            pvc.setdefault("status", {})["phase"] = "Bound"
+            self._notify("pv", "modified", pv)
+            self._notify("pvc", "modified", pvc)
+
     # ---- pod disruption budgets -------------------------------------------
     # Minimal PDB surface the preemption path consumes
     # (`generic_scheduler.go:254,674-699` reads PDBs to minimize violations):

@@ -315,6 +315,105 @@ def no_volume_zone_conflict(kube_pod: dict, kube_node: dict) -> tuple:
     return True, []
 
 
+# ---- volume binding (CheckVolumeBinding, `predicates.go:1443-1465`) --------
+
+
+def pod_pvc_names(kube_pod: dict) -> list:
+    """Names of the PersistentVolumeClaims the pod's volumes reference."""
+    out = []
+    for vol in pod_volumes(kube_pod):
+        src = vol.get("persistentVolumeClaim")
+        if src and src.get("claimName"):
+            out.append(src["claimName"])
+    return out
+
+
+def pv_node_affinity_matches(pv: dict, kube_node: dict) -> bool:
+    """A PV's ``spec.nodeAffinity.required`` nodeSelectorTerms against the
+    node's labels (OR across terms, like node affinity)."""
+    required = ((pv.get("spec") or {}).get("nodeAffinity") or {}) \
+        .get("required") or {}
+    terms = required.get("nodeSelectorTerms") or []
+    if not terms:
+        return True  # no affinity: usable anywhere
+    labels = (kube_node.get("metadata") or {}).get("labels") or {}
+    return any(node_selector_term_matches(labels, term) for term in terms)
+
+
+def _pv_capacity(pv: dict) -> int:
+    from kubegpu_tpu.core import codec as _codec
+
+    cap = ((pv.get("spec") or {}).get("capacity") or {}).get("storage", 0)
+    try:
+        return _codec.parse_quantity(cap)
+    except ValueError:
+        return 0
+
+
+def _pvc_request(pvc: dict) -> int:
+    from kubegpu_tpu.core import codec as _codec
+
+    req = (((pvc.get("spec") or {}).get("resources") or {})
+           .get("requests") or {}).get("storage", 0)
+    try:
+        return _codec.parse_quantity(req)
+    except ValueError:
+        return 0
+
+
+def _pv_available(pv: dict) -> bool:
+    spec = pv.get("spec") or {}
+    return not spec.get("claimRef") and \
+        (pv.get("status") or {}).get("phase", "Available") != "Bound"
+
+
+def check_volume_binding(kube_pod: dict, kube_node: dict,
+                         pvcs_by_name: dict, pvs: list,
+                         reserved_pvs: set | None = None) -> tuple:
+    """CheckVolumeBinding (`predicates.go:1443-1465`): every bound PVC's PV
+    must tolerate this node (node affinity); every unbound PVC must have a
+    matchable available PV compatible with this node.
+
+    Returns ``(ok, reasons, proposed)`` where ``proposed`` maps
+    pvc name -> pv name for the unbound claims — the provisional decision
+    the binder commits at bind time (`volume_binder.go:1-74` queues the
+    same work). ``reserved_pvs`` are PVs already promised to in-flight
+    pods and excluded from matching. Matching picks the smallest adequate
+    PV (upstream smallest-fit), deterministic by (capacity, name)."""
+    reserved = set(reserved_pvs or ())
+    proposed: dict = {}
+    for claim_name in pod_pvc_names(kube_pod):
+        pvc = pvcs_by_name.get(claim_name)
+        if pvc is None:
+            return False, [f"persistentvolumeclaim \"{claim_name}\" "
+                           "not found"], {}
+        bound_pv = (pvc.get("spec") or {}).get("volumeName")
+        if bound_pv:
+            pv = next((p for p in pvs
+                       if p["metadata"]["name"] == bound_pv), None)
+            if pv is None or not pv_node_affinity_matches(pv, kube_node):
+                return False, ["node(s) had volume node affinity "
+                               "conflict"], {}
+            continue
+        want_class = (pvc.get("spec") or {}).get("storageClassName") or ""
+        need = _pvc_request(pvc)
+        candidates = sorted(
+            (p for p in pvs
+             if _pv_available(p)
+             and p["metadata"]["name"] not in reserved
+             and p["metadata"]["name"] not in proposed.values()
+             and ((p.get("spec") or {}).get("storageClassName") or "")
+             == want_class
+             and _pv_capacity(p) >= need
+             and pv_node_affinity_matches(p, kube_node)),
+            key=lambda p: (_pv_capacity(p), p["metadata"]["name"]))
+        if not candidates:
+            return False, ["node(s) didn't find available persistent "
+                           "volumes to bind"], {}
+        proposed[claim_name] = candidates[0]["metadata"]["name"]
+    return True, [], proposed
+
+
 def general_predicates(kube_pod: dict, kube_node: dict, used_ports: set,
                        core_allocatable: dict, requested_core: dict) -> tuple:
     """The GeneralPredicates composite: resources + host + ports +
