@@ -50,6 +50,15 @@ class TransformerConfig:
     # routed experts sharded over the model axis (expert parallelism).
     n_experts: int = 0
     moe_aux_weight: float = 0.01
+    # Rematerialisation (activation checkpointing) per transformer layer —
+    # the TPU trade of FLOPs for HBM (scaling-book recipe; the reference
+    # has no training runtime, SURVEY.md §0):
+    #   "none" — save all activations (fastest per-step, most HBM);
+    #   "dots" — jax.checkpoint with dots_with_no_batch_dims_saveable:
+    #            keep matmul outputs, recompute elementwise/softmax;
+    #   "full" — save only layer boundaries, recompute the whole layer
+    #            in backward (~+1 fwd of FLOPs, minimal HBM).
+    remat: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -185,16 +194,14 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(*spec)))
 
-    def forward(params, tokens):
-        dt = cfg.compute_dtype()
-        b, t = tokens.shape
-        x = params["embed"].astype(dt)[tokens]
-        x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
-        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
-        aux_total = jnp.zeros((), jnp.float32)
-        attend = attention_fn(t)
+    def make_block(attend):
+        """One transformer layer as ``block(layer, x, positions) ->
+        (x, aux)`` so `jax.checkpoint` can wrap exactly one layer's
+        activations (the remat unit)."""
 
-        for layer in params["layers"]:
+        def block(layer, x, positions):
+            dt = cfg.compute_dtype()
+            b, t = x.shape[:2]
             h = _rmsnorm(x, layer["ln1"])
             q = (h @ layer["wq"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
             k = (h @ layer["wk"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
@@ -211,12 +218,36 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
 
                 ffn_out, aux = moe_ffn(layer["moe"], h, dt)
                 x = x + ffn_out
-                aux_total = aux_total + aux
             else:
                 up = h @ layer["w_up"].astype(dt)
                 gate = jax.nn.silu(h @ layer["w_gate"].astype(dt))
                 x = x + (up * gate) @ layer["w_down"].astype(dt)
+                aux = jnp.zeros((), jnp.float32)
             x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
+            return x, aux
+
+        if cfg.remat == "full":
+            return jax.checkpoint(block)
+        if cfg.remat == "dots":
+            return jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        if cfg.remat != "none":
+            raise ValueError(f"unknown remat mode {cfg.remat!r}")
+        return block
+
+    def forward(params, tokens):
+        dt = cfg.compute_dtype()
+        b, t = tokens.shape
+        x = params["embed"].astype(dt)[tokens]
+        x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
+        positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+        aux_total = jnp.zeros((), jnp.float32)
+        block = make_block(attention_fn(t))
+
+        for layer in params["layers"]:
+            x, aux = block(layer, x, positions)
+            aux_total = aux_total + aux
 
         x = _rmsnorm(x, params["final_norm"])
         logits = x @ params["unembed"].astype(dt)
