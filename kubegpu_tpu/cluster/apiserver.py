@@ -37,6 +37,8 @@ class InMemoryAPIServer:
         self._nodes: dict = {}
         self._pods: dict = {}
         self._pdbs: dict = {}
+        self._pvcs: dict = {}
+        self._pvs: dict = {}
         # insertion-ordered (kind, name, reason, message) -> event; the
         # key IS the dedup identity, so record_event is O(1) not a scan
         self._events: dict = {}

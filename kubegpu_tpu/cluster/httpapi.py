@@ -16,6 +16,8 @@ Routes (JSON bodies):
     PUT    /pods/<name>/annotations
     POST   /pods/<name>/bind            {"node": ...}
     POST   /bindmany                    {"bindings": {...}, "annotations": {...}}
+    GET    /pvcs | POST /pvcs | GET/DELETE /pvcs/<name>   (likewise /pvs)
+    POST   /bindvolume                  {"pv": ..., "pvc": ...}
     GET    /watch?since=<seq>           -> {"events": [[seq, kind, event, obj]...]}
     POST   /leases/<name>               {"holder":..., "ttl":...} -> 200/409
 
@@ -172,6 +174,25 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
                 body = self._body()
                 api.bind_many(body["bindings"], body.get("annotations") or {})
                 return self._send(200)
+            for kind, create, get_, list_, delete in (
+                    ("pvcs", api.create_pvc, api.get_pvc, api.list_pvcs,
+                     api.delete_pvc),
+                    ("pvs", api.create_pv, api.get_pv, api.list_pvs,
+                     api.delete_pv)):
+                if parts and parts[0] == kind:
+                    if method == "GET" and len(parts) == 1:
+                        return self._send(200, {"items": list_()})
+                    if method == "POST" and len(parts) == 1:
+                        return self._send(201, create(self._body()))
+                    if method == "GET" and len(parts) == 2:
+                        return self._send(200, get_(parts[1]))
+                    if method == "DELETE" and len(parts) == 2:
+                        delete(parts[1])
+                        return self._send(200)
+            if parts == ["bindvolume"] and method == "POST":
+                body = self._body()
+                api.bind_volume(body["pv"], body["pvc"])
+                return self._send(200)
             if parts and parts[0] == "pdbs":
                 if method == "GET" and len(parts) == 1:
                     return self._send(200, {"items": api.list_pdbs()})
@@ -292,6 +313,36 @@ class HTTPAPIClient:
 
     def delete_pdb(self, name):
         return self._req("DELETE", f"/pdbs/{name}")
+
+    # -- persistent volumes / claims ----------------------------------------
+
+    def create_pvc(self, pvc):
+        return self._req("POST", "/pvcs", pvc)
+
+    def get_pvc(self, name):
+        return self._req("GET", f"/pvcs/{name}")
+
+    def list_pvcs(self):
+        return self._req("GET", "/pvcs")["items"]
+
+    def delete_pvc(self, name):
+        return self._req("DELETE", f"/pvcs/{name}")
+
+    def create_pv(self, pv):
+        return self._req("POST", "/pvs", pv)
+
+    def get_pv(self, name):
+        return self._req("GET", f"/pvs/{name}")
+
+    def list_pvs(self):
+        return self._req("GET", "/pvs")["items"]
+
+    def delete_pv(self, name):
+        return self._req("DELETE", f"/pvs/{name}")
+
+    def bind_volume(self, pv_name, claim_name):
+        return self._req("POST", "/bindvolume",
+                         {"pv": pv_name, "pvc": claim_name})
 
     def record_event(self, kind, name, event_type, reason, message):
         return self._req("POST", "/events",

@@ -70,6 +70,8 @@ class GenericScheduler:
         # the node's full allocatable+used state.
         self._device_verdicts: dict = {}
         self._device_lock = threading.Lock()
+        # Set by Scheduler; None = no volume surface (predicate no-ops).
+        self.volume_binder = None
         self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
                                         thread_name_prefix="fit")
 
@@ -104,11 +106,19 @@ class GenericScheduler:
         get.pinned_node = base.node_name
         return get
 
+    def _volume_snapshot(self, kube_pod: dict):
+        """Pass-level PV/PVC snapshot for CheckVolumeBinding, or None when
+        the pod references no PVCs / no binder is wired."""
+        if self.volume_binder is None:
+            return None
+        return self.volume_binder.snapshot(kube_pod)
+
     def _fits_on_node(self, kube_pod: dict, node_name: str,
                       eq_class: str | None = None,
                       out_snaps: dict | None = None,
                       meta=_AUTO_META, pod_info_get=None,
-                      device_class=_AUTO_META, eq_gen: int | None = None):
+                      device_class=_AUTO_META, eq_gen: int | None = None,
+                      vol=_AUTO_META):
         """The full predicate chain against a point-in-time snapshot so
         concurrent watcher mutations of node usage cannot tear mid-fit.
         Order mirrors the reference providers: cheap node gates first, the
@@ -130,13 +140,15 @@ class GenericScheduler:
                 else self.cache.equivalence.generation(node_name)
         if meta is self._AUTO_META:
             meta = self._interpod_meta(kube_pod)
+        if vol is self._AUTO_META:
+            vol = self._volume_snapshot(kube_pod)
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
         if device_class is self._AUTO_META:
             device_class = self._device_class(kube_pod)
         result = self._run_predicates(
-            kube_pod, snap, meta, pod_info_get, device_class)
+            kube_pod, snap, meta, pod_info_get, device_class, vol)
         if out_snaps is not None and result[0]:
             # Only feasible nodes are scored; don't pin snapshots of the
             # (typically many) infeasible ones for the whole pass.
@@ -196,8 +208,9 @@ class GenericScheduler:
         return hashlib.sha256(f"{ann}|{res}".encode()).hexdigest()
 
     def _run_predicates(self, kube_pod: dict, snap, meta=None,
-                        pod_info_get=None, device_class: str | None = None):
-        ctx = factory.PredicateContext(kube_pod, snap, meta)
+                        pod_info_get=None, device_class: str | None = None,
+                        vol=None):
+        ctx = factory.PredicateContext(kube_pod, snap, meta, vol)
         for _name, pred in self.algorithm.predicates:
             ok, reasons = pred(ctx)
             if not ok:
@@ -243,8 +256,12 @@ class GenericScheduler:
         # Auto-topology pods are likewise uncacheable (cluster-wide shape
         # dependence, `_requests_auto_topology`).
         auto_topology = self._requests_auto_topology(kube_pod)
+        # PVC-referencing pods are likewise uncacheable: their verdict
+        # moves with cluster-wide PV state (creates, binds, reservations),
+        # which per-node invalidation cannot express.
+        vol = self._volume_snapshot(kube_pod)
         eq_class = None if interpod.pod_requires_interpod_affinity(kube_pod) \
-            or auto_topology else equivalence_class(kube_pod)
+            or auto_topology or vol is not None else equivalence_class(kube_pod)
         # Generations BEFORE the metadata snapshot: a watcher invalidation
         # racing the metadata build must make the eventual store() a no-op
         # — a verdict computed from pre-invalidation metadata stored under
@@ -258,7 +275,8 @@ class GenericScheduler:
         results = list(self._pool.map(
             lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps,
                                               meta, pod_info_get,
-                                              device_class, eq_gens.get(n))),
+                                              device_class, eq_gens.get(n),
+                                              vol)),
             names))
         feasible = {n: score for n, ok, _, score in results if ok}
         failures = {n: reasons for n, ok, reasons, _ in results if not ok}
@@ -393,6 +411,7 @@ class GenericScheduler:
         # pass and filtered per-simulation (victims removed), mirroring the
         # reference re-running podFitsOnNode with adjusted metadata.
         meta = self._interpod_meta(kube_pod)
+        vol = self._volume_snapshot(kube_pod)
         pdb_state = self._pdb_state()
         names = self.cache.node_names()
         if failures is not None:
@@ -417,7 +436,7 @@ class GenericScheduler:
                 return None
             found = self._victims_on_node(kube_pod, snap, prio, meta,
                                           pdb_state, pods_by_name,
-                                          pod_info_get)
+                                          pod_info_get, vol)
             if found is None:
                 return None
             victims, violations = found
@@ -507,7 +526,7 @@ class GenericScheduler:
         return violating, ok
 
     def _fits_after_evictions(self, kube_pod, snap, meta, evicted: set,
-                              pod_info_get=None):
+                              pod_info_get=None, vol=None):
         """Full predicate chain against the mutated snapshot — taints,
         selectors, volume conflicts, inter-pod terms AND device fit — the
         reference's podFitsOnNode during preemption. A node where only
@@ -520,13 +539,13 @@ class GenericScheduler:
                 [p for p in meta.pods if not (p.node_name == snap.name
                                               and p.name in evicted)])
         fits, _, _ = self._run_predicates(kube_pod, snap, sim_meta,
-                                          pod_info_get)
+                                          pod_info_get, None, vol)
         return fits
 
     def _victims_on_node(self, kube_pod, snap, prio, meta=None,
                          pdb_state: list | None = None,
                          pods_by_name: dict | None = None,
-                         pod_info_get=None):
+                         pod_info_get=None, vol=None):
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
         from kubegpu_tpu.scheduler.predicates import (pod_host_ports,
                                                       pod_volumes)
@@ -583,7 +602,7 @@ class GenericScheduler:
         for victim in candidates:
             charge(victim, -1)
         if not self._fits_after_evictions(kube_pod, snap, meta, evicted,
-                                          pod_info_get):
+                                          pod_info_get, vol):
             return None
         # Phase 2: reprieve — PDB-violating candidates FIRST (so they're
         # kept whenever possible, minimizing violations), then the rest;
@@ -599,7 +618,7 @@ class GenericScheduler:
                 sorted(non_violating, key=by_prio):
             charge(pod, +1)
             if self._fits_after_evictions(kube_pod, snap, meta, evicted,
-                                          pod_info_get):
+                                          pod_info_get, vol):
                 continue  # reprieved
             charge(pod, -1)
             victims.append(pod)
@@ -625,11 +644,15 @@ class Scheduler:
         self.device_scheduler = device_scheduler
         self.cache = SchedulerCache(device_scheduler)
         self.queue = SchedulingQueue()
+        from kubegpu_tpu.scheduler.volumebinder import VolumeBinder
+
         self.generic = GenericScheduler(self.cache, device_scheduler, parallelism,
                                         extenders=extenders,
                                         priority_weights=priority_weights,
                                         algorithm=algorithm)
         self.generic.api = api
+        self.volume_binder = VolumeBinder(api)
+        self.generic.volume_binder = self.volume_binder
         self.gang_buffer = GangBuffer()
         self.gang_planner = GangPlanner(self.cache)
         self.bind_async = bind_async
@@ -673,6 +696,10 @@ class Scheduler:
                 if node_name:
                     self.cache.remove_pod(obj, node_name)
                 self.queue.move_all_to_active()
+        elif kind in ("pv", "pvc"):
+            # a new/changed volume can make an unschedulable PVC pod
+            # feasible (unbound-PVC pods wait for a matching PV)
+            self.queue.move_all_to_active()
 
     # ---- the loop (`scheduler.go:439-502`) ---------------------------------
 
@@ -702,8 +729,18 @@ class Scheduler:
         self.cache.expire_assumed()
         try:
             host = self.generic.schedule(kube_pod)
+            if not self._assume_volumes(kube_pod, host):
+                # volume state moved between the fit pass and host
+                # selection (another pod grabbed the PV): requeue, the
+                # next pass recomputes against fresh PV state
+                metrics.SCHEDULE_FAILURES.inc()
+                self._event(name, "Warning", "FailedScheduling",
+                            f"volume binding lost race on {host}")
+                self.queue.add_unschedulable(kube_pod)
+                return True
             self.generic.allocate_devices(kube_pod, host)
         except FitError as err:
+            self.volume_binder.forget(name)
             metrics.SCHEDULE_FAILURES.inc()
             self._event(name, "Warning", "FailedScheduling",
                         self._summarize_failures(err.failures))
@@ -719,6 +756,7 @@ class Scheduler:
             # round). Log loudly, count separately, and park the pod so the
             # loop survives — but never silently (reference stance:
             # `node_info.go:336-340` panics on corrupted internal state).
+            self.volume_binder.forget(name)
             metrics.INTERNAL_ERRORS.inc()
             logging.getLogger(__name__).exception(
                 "internal scheduler error while scheduling %s", name)
@@ -773,6 +811,20 @@ class Scheduler:
                 metrics.SCHEDULE_FAILURES.inc()
                 self.queue.add_unschedulable(kube_pod)
                 return
+        # Volumes: reserve every member's pvc->pv pairings before any pod
+        # binds (same contract as the single-pod path — the kubelet must
+        # find claims bound when the pod lands); all-or-nothing like the
+        # rest of the gang commit.
+        vol_assumed: list = []
+        for name, node_name, pinned in pinned_members:
+            if self._assume_volumes(pinned, node_name):
+                vol_assumed.append(name)
+            else:
+                for done in vol_assumed:
+                    self.volume_binder.forget(done)
+                metrics.SCHEDULE_FAILURES.inc()
+                self.queue.add_unschedulable(kube_pod)
+                return
         self.gang_buffer.drop_gang(gang)
         # Two-phase all-or-nothing commit: assume everything (reversible),
         # then one atomic bind of the whole pod-set.
@@ -781,6 +833,9 @@ class Scheduler:
             for _, node_name, pinned in pinned_members:
                 self.cache.assume_pod(pinned, node_name)
                 assumed.append(pinned)
+            for name, _, _ in pinned_members:
+                if not self.volume_binder.bind(name):
+                    raise RuntimeError(f"volume bind conflict for {name}")
             self.api.bind_many(
                 {n: node for n, node, _ in pinned_members},
                 {n: p["metadata"].get("annotations") or {}
@@ -792,8 +847,12 @@ class Scheduler:
                 metrics.E2E_SCHEDULING_LATENCY.observe(
                     (time.perf_counter() - t0) * 1e6)
         except Exception:
-            # nothing bound (bind_many is atomic): release every assume
+            # nothing bound (bind_many is atomic): release every assume.
+            # Committed volume binds stay (idempotent and harmless, see
+            # volumebinder.py) — the retry recomputes against them.
             metrics.SCHEDULE_FAILURES.inc()
+            for name, _, _ in pinned_members:
+                self.volume_binder.forget(name)
             for pinned in assumed:
                 self.cache.forget_pod(pinned)
             for member in members:
@@ -856,11 +915,30 @@ class Scheduler:
             pass  # observability only; never block the retry
         return True
 
+    def _assume_volumes(self, kube_pod: dict, host: str) -> bool:
+        """Reserve pvc->pv pairings for the chosen host (the reference
+        assumes volume bindings after host selection,
+        `volume_binder.go:1-74`). True = nothing to do or reserved."""
+        snap = self.cache.snapshot_node(host)
+        if snap is None:
+            return False
+        return self.volume_binder.assume(kube_pod, snap.kube_node)
+
     def _bind(self, kube_pod: dict, host: str, t0: float) -> None:
-        """Annotation first, then the binding — the kubelet-side hook must
-        see allocate_from the moment the pod lands (`scheduler.go:405-417`)."""
+        """Volumes first (the kubelet must find claims bound when the pod
+        lands), then annotation, then the binding — the kubelet-side hook
+        must see allocate_from the moment the pod lands
+        (`scheduler.go:405-417`)."""
         name = kube_pod["metadata"]["name"]
         tb = time.perf_counter()
+        if not self.volume_binder.bind(name):
+            # bind-time conflict (external writer grabbed the PV):
+            # requeue; the next pass recomputes against fresh PV state
+            self.cache.forget_pod(kube_pod)
+            self._event(name, "Warning", "FailedScheduling",
+                        "volume bind conflict; rescheduling")
+            self.queue.add_unschedulable(kube_pod)
+            return
         try:
             self.api.update_pod_annotations(
                 name, kube_pod["metadata"].get("annotations") or {})

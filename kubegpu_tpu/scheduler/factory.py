@@ -27,12 +27,13 @@ from kubegpu_tpu.scheduler import interpod, predicates, priorities
 
 
 class PredicateContext:
-    __slots__ = ("kube_pod", "snap", "meta")
+    __slots__ = ("kube_pod", "snap", "meta", "vol")
 
-    def __init__(self, kube_pod, snap, meta=None):
+    def __init__(self, kube_pod, snap, meta=None, vol=None):
         self.kube_pod = kube_pod
         self.snap = snap
         self.meta = meta  # interpod.InterPodMetadata | None
+        self.vol = vol    # VolumeBinder.snapshot() | None (no PVCs)
 
 
 class PriorityContext:
@@ -143,6 +144,22 @@ def _p_volume_zone(args):
         ctx.kube_pod, ctx.snap.kube_node)
 
 
+def _p_volume_binding(args):
+    """CheckVolumeBinding (`predicates.go:1443-1465`): bound PVCs' PVs must
+    tolerate the node; unbound PVCs must have a matchable available PV.
+    ``ctx.vol`` is the pass-level `VolumeBinder.snapshot()`; None means the
+    pod references no PVCs (or the API has no volume surface) and the
+    predicate is free."""
+    def fn(ctx):
+        if ctx.vol is None:
+            return True, []
+        pvcs, pvs, reserved = ctx.vol
+        ok, reasons, _ = predicates.check_volume_binding(
+            ctx.kube_pod, ctx.snap.kube_node, pvcs, pvs, reserved)
+        return ok, reasons
+    return fn
+
+
 def _p_general(args):
     return lambda ctx: predicates.general_predicates(
         ctx.kube_pod, ctx.snap.kube_node, ctx.snap.used_ports,
@@ -193,6 +210,7 @@ FIT_PREDICATES = {
     "MaxEBSVolumeCount": _p_max_volumes("awsElasticBlockStore", 39),
     "MaxGCEPDVolumeCount": _p_max_volumes("gcePersistentDisk", 16),
     "NoVolumeZoneConflict": _p_volume_zone,
+    "CheckVolumeBinding": _p_volume_binding,
     "GeneralPredicates": _p_general,
     "MatchInterPodAffinity": _p_interpod,
     "CheckNodeLabelPresence": _p_label_presence,
@@ -316,7 +334,7 @@ DEFAULT_PREDICATE_NAMES = (
     "PodFitsHost", "MatchNodeSelector",
     "PodToleratesNodeTaints", "PodFitsHostPorts", "PodFitsResources",
     "NoDiskConflict", "NoVolumeZoneConflict", "MaxEBSVolumeCount",
-    "MaxGCEPDVolumeCount", "MatchInterPodAffinity",
+    "MaxGCEPDVolumeCount", "CheckVolumeBinding", "MatchInterPodAffinity",
 )
 
 DEFAULT_PRIORITIES = (

