@@ -14,6 +14,7 @@ disposable, annotations are the checkpoint (SURVEY.md §6).
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 import time
@@ -72,6 +73,14 @@ class GenericScheduler:
         self._device_lock = threading.Lock()
         # Set by Scheduler; None = no volume surface (predicate no-ops).
         self.volume_binder = None
+        # Nominated preemptors: pod name -> (node, expiry, pod snapshot).
+        # The room preemption freed is spoken-for until the preemptor
+        # binds, its nomination expires, or the pod is deleted
+        # (`generic_scheduler.go:226-290` routes the preemptor back with
+        # its annotation visible; here other pods' fit passes charge the
+        # nominated pod's demand onto the node, see `_fits_on_node`).
+        self._nominations: dict = {}
+        self._nom_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
                                         thread_name_prefix="fit")
 
@@ -106,6 +115,63 @@ class GenericScheduler:
         get.pinned_node = base.node_name
         return get
 
+    # ---- nominated-node reservations --------------------------------------
+
+    NOMINATION_TTL_S = 30.0
+
+    def nominate(self, kube_pod: dict, node_name: str,
+                 ttl_s: float | None = None) -> None:
+        """Reserve the room preemption just freed on ``node_name`` for this
+        pod until it binds or the TTL expires."""
+        name = kube_pod["metadata"]["name"]
+        expires = time.monotonic() + (ttl_s if ttl_s is not None
+                                      else self.NOMINATION_TTL_S)
+        with self._nom_lock:
+            self._nominations[name] = (node_name, expires,
+                                       copy.deepcopy(kube_pod))
+
+    def clear_nomination(self, pod_name: str) -> None:
+        with self._nom_lock:
+            self._nominations.pop(pod_name, None)
+
+    def _nominated_pods_on(self, node_name: str, exclude: str,
+                           min_priority: int) -> list:
+        """Live nominations on ``node_name`` that an incoming pod of
+        ``min_priority`` must respect: only nominated pods of >= priority
+        hold their room (a strictly higher-priority pod may take it, like
+        upstream), and a pod never blocks on its own nomination."""
+        now = time.monotonic()
+        out = []
+        with self._nom_lock:
+            for name in list(self._nominations):
+                node, expires, pod = self._nominations[name]
+                if expires <= now:
+                    del self._nominations[name]
+                    continue
+                if node == node_name and name != exclude and \
+                        _pod_priority(pod) >= min_priority:
+                    out.append(pod)
+        return out
+
+    def _charge_nominated(self, nominated: list, snap) -> None:
+        """Charge nominated pods' demand onto a (private) fit snapshot:
+        core requests always; device demand via a simulated allocation
+        (the nominated pod has no allocate_from yet — its chips are
+        whichever ones a fresh allocation would take). Ports/labels are
+        not charged, matching upstream's resource-only treatment of
+        nominated pods."""
+        for pod in nominated:
+            for res, val in _pod_core_requests(pod).items():
+                snap.requested_core[res] = \
+                    snap.requested_core.get(res, 0) + val
+            try:
+                info = self.cache.pod_info_for_node(pod, snap.name)
+                self.device_scheduler.pod_allocate(info, snap.node_ex)
+                self.device_scheduler.take_pod_resources(info, snap.node_ex)
+            except Exception:
+                # freed room already retaken: nothing left to charge
+                continue
+
     def _volume_snapshot(self, kube_pod: dict):
         """Pass-level PV/PVC snapshot for CheckVolumeBinding, or None when
         the pod references no PVCs / no binder is wired."""
@@ -125,6 +191,13 @@ class GenericScheduler:
         device predicate (`devicepredicate.go:11-26`) last. A snapshot
         taken here is stashed in ``out_snaps`` so the scoring pass can
         reuse it instead of re-snapshotting."""
+        nominated = self._nominated_pods_on(
+            node_name, exclude=kube_pod["metadata"]["name"],
+            min_priority=_pod_priority(kube_pod))
+        if nominated:
+            # nomination-dependent verdicts must not be memoized: the
+            # reservation expires outside any node event
+            eq_class = None
         if eq_class is not None:
             hit = self.cache.equivalence.lookup(node_name, eq_class)
             if hit is not None:
@@ -145,6 +218,8 @@ class GenericScheduler:
         snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
+        if nominated:
+            self._charge_nominated(nominated, snap)
         if device_class is self._AUTO_META:
             device_class = self._device_class(kube_pod)
         result = self._run_predicates(
@@ -429,6 +504,7 @@ class GenericScheduler:
         except Exception:
             return None
         pod_info_get = self._pod_info_provider(kube_pod)
+        device_class = self._device_class(kube_pod)
 
         def eval_node(node_name):
             snap = self.cache.snapshot_node(node_name)
@@ -436,7 +512,7 @@ class GenericScheduler:
                 return None
             found = self._victims_on_node(kube_pod, snap, prio, meta,
                                           pdb_state, pods_by_name,
-                                          pod_info_get, vol)
+                                          pod_info_get, vol, device_class)
             if found is None:
                 return None
             victims, violations = found
@@ -526,12 +602,19 @@ class GenericScheduler:
         return violating, ok
 
     def _fits_after_evictions(self, kube_pod, snap, meta, evicted: set,
-                              pod_info_get=None, vol=None):
+                              pod_info_get=None, vol=None,
+                              device_class=None):
         """Full predicate chain against the mutated snapshot — taints,
         selectors, volume conflicts, inter-pod terms AND device fit — the
         reference's podFitsOnNode during preemption. A node where only
         resources were checked could be selected, its victims deleted, and
-        the preemptor still never schedule there."""
+        the preemptor still never schedule there.
+
+        ``device_class`` keys the device-verdict shape cache across the
+        simulation: on a uniform fleet the post-eviction node states
+        repeat across nodes, so the grpalloc search runs once per unique
+        (shape, demand) instead of ~2x per candidate per node — this is
+        what holds preemption p50 flat at cluster scale."""
         sim_meta = meta
         if meta is not None and evicted:
             sim_meta = interpod.InterPodMetadata(
@@ -539,13 +622,13 @@ class GenericScheduler:
                 [p for p in meta.pods if not (p.node_name == snap.name
                                               and p.name in evicted)])
         fits, _, _ = self._run_predicates(kube_pod, snap, sim_meta,
-                                          pod_info_get, None, vol)
+                                          pod_info_get, device_class, vol)
         return fits
 
     def _victims_on_node(self, kube_pod, snap, prio, meta=None,
                          pdb_state: list | None = None,
                          pods_by_name: dict | None = None,
-                         pod_info_get=None, vol=None):
+                         pod_info_get=None, vol=None, device_class=None):
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
         from kubegpu_tpu.scheduler.predicates import (pod_host_ports,
                                                       pod_volumes)
@@ -554,6 +637,7 @@ class GenericScheduler:
         api = getattr(self, "api", None)
         if api is None:
             return None
+        preemptor_name = kube_pod["metadata"]["name"]
         candidates = []
         for pod_name in sorted(snap.pod_names):
             if pods_by_name is not None:
@@ -598,11 +682,19 @@ class GenericScheduler:
                 core_free[res] = core_free.get(res, 0) + sign * val
 
         # Phase 1: evict every candidate; if the preemptor still doesn't
-        # fit, this node can't be helped by preemption.
+        # fit, this node can't be helped by preemption. Room reserved for
+        # another nominated preemptor (equal-or-higher priority) is
+        # charged first — preempting onto it would defeat the reservation
+        # and ping-pong evictions (upstream adds nominated pods into the
+        # preemption fit simulation too).
         for victim in candidates:
             charge(victim, -1)
+        nominated = self._nominated_pods_on(snap.name, exclude=preemptor_name,
+                                            min_priority=prio)
+        if nominated:
+            self._charge_nominated(nominated, snap)
         if not self._fits_after_evictions(kube_pod, snap, meta, evicted,
-                                          pod_info_get, vol):
+                                          pod_info_get, vol, device_class):
             return None
         # Phase 2: reprieve — PDB-violating candidates FIRST (so they're
         # kept whenever possible, minimizing violations), then the rest;
@@ -618,7 +710,7 @@ class GenericScheduler:
                 sorted(non_violating, key=by_prio):
             charge(pod, +1)
             if self._fits_after_evictions(kube_pod, snap, meta, evicted,
-                                          pod_info_get, vol):
+                                          pod_info_get, vol, device_class):
                 continue  # reprieved
             charge(pod, -1)
             victims.append(pod)
@@ -673,6 +765,14 @@ class Scheduler:
             if node_name:
                 self.cache.add_pod(pod, node_name)
             else:
+                # a pending preemptor's nomination survives restart via
+                # its persisted annotation (the API server IS the
+                # checkpoint) — re-reserve before scheduling resumes
+                nominated = ((pod.get("metadata") or {})
+                             .get("annotations") or {}) \
+                    .get(self.NOMINATED_NODE_ANNOTATION)
+                if nominated:
+                    self.generic.nominate(pod, nominated)
                 self.queue.push(pod)
 
     def _on_event(self, kind: str, event: str, obj: dict) -> None:
@@ -692,6 +792,7 @@ class Scheduler:
                 self.cache.add_pod(obj, node_name)
             elif event == "deleted":
                 self.queue.forget(obj["metadata"]["name"])
+                self.generic.clear_nomination(obj["metadata"]["name"])
                 self.gang_buffer.discard_pod(obj["metadata"]["name"])
                 if node_name:
                     self.cache.remove_pod(obj, node_name)
@@ -826,9 +927,16 @@ class Scheduler:
                 self.queue.add_unschedulable(kube_pod)
                 return
         self.gang_buffer.drop_gang(gang)
-        # Two-phase all-or-nothing commit: assume everything (reversible),
-        # then one atomic bind of the whole pod-set.
+        # Two-phase commit: assume everything (reversible), then bind the
+        # pod-set. Without a delegated binder the bind is one atomic
+        # `bind_many` (all-or-nothing). A bind-verb extender owns EVERY
+        # binding (same contract as the single-pod path) and binds members
+        # one at a time — atomicity then holds only up to the first
+        # failure, and members already bound stay bound.
+        binder = next((e for e in self.generic.extenders
+                       if getattr(e, "bind_verb", None)), None)
         assumed: list = []
+        committed: list = []
         try:
             for _, node_name, pinned in pinned_members:
                 self.cache.assume_pod(pinned, node_name)
@@ -836,27 +944,43 @@ class Scheduler:
             for name, _, _ in pinned_members:
                 if not self.volume_binder.bind(name):
                     raise RuntimeError(f"volume bind conflict for {name}")
-            self.api.bind_many(
-                {n: node for n, node, _ in pinned_members},
-                {n: p["metadata"].get("annotations") or {}
-                 for n, _, p in pinned_members},
-            )
+            if binder is None:
+                self.api.bind_many(
+                    {n: node for n, node, _ in pinned_members},
+                    {n: p["metadata"].get("annotations") or {}
+                     for n, _, p in pinned_members},
+                )
+                committed = [n for n, _, _ in pinned_members]
+            else:
+                for name, node_name, pinned in pinned_members:
+                    self.api.update_pod_annotations(
+                        name, pinned["metadata"].get("annotations") or {})
+                    binder.bind(name, node_name)
+                    committed.append(name)
             for name, _, _ in pinned_members:
                 self.cache.confirm_pod(name)
                 self.queue.forget(name)
                 metrics.E2E_SCHEDULING_LATENCY.observe(
                     (time.perf_counter() - t0) * 1e6)
         except Exception:
-            # nothing bound (bind_many is atomic): release every assume.
+            # Release every assume EXCEPT members a delegated binder
+            # already bound (they are placed; their charge must stand).
             # Committed volume binds stay (idempotent and harmless, see
             # volumebinder.py) — the retry recomputes against them.
             metrics.SCHEDULE_FAILURES.inc()
-            for name, _, _ in pinned_members:
+            done = set(committed)
+            for name, _, pinned in pinned_members:
+                if name in done:
+                    self.cache.confirm_pod(name)
+                    self.queue.forget(name)
+                    continue
                 self.volume_binder.forget(name)
             for pinned in assumed:
-                self.cache.forget_pod(pinned)
+                if pinned["metadata"]["name"] not in done:
+                    self.cache.forget_pod(pinned)
             for member in members:
-                self.queue.add_unschedulable(member)
+                if member["metadata"]["name"] not in done:
+                    self.queue.add_unschedulable(member)
 
     NOMINATED_NODE_ANNOTATION = "scheduler.alpha.kubernetes.io/nominated-node-name"
 
@@ -912,7 +1036,10 @@ class Scheduler:
             annotations[self.NOMINATED_NODE_ANNOTATION] = node_name
             self.api.update_pod_annotations(name, annotations)
         except Exception:
-            pass  # observability only; never block the retry
+            pass  # the annotation is the persisted mirror; the in-memory
+            # nomination below still protects the room this side of a
+            # scheduler restart
+        self.generic.nominate(kube_pod, node_name)
         return True
 
     def _assume_volumes(self, kube_pod: dict, host: str) -> bool:
@@ -942,12 +1069,27 @@ class Scheduler:
         try:
             self.api.update_pod_annotations(
                 name, kube_pod["metadata"].get("annotations") or {})
-            self.api.bind_pod(name, host)
+            # an extender declaring a bind verb owns the binding
+            # (`extender.go:44,90`); an ignorable binder that errors
+            # falls back to the API binding, a non-ignorable one fails
+            # the bind like any API error
+            binder = next((e for e in self.generic.extenders
+                           if getattr(e, "bind_verb", None)), None)
+            if binder is None:
+                self.api.bind_pod(name, host)
+            else:
+                try:
+                    binder.bind(name, host)
+                except Exception:
+                    if not binder.ignorable:
+                        raise
+                    self.api.bind_pod(name, host)
         except Exception:
             self.cache.forget_pod(kube_pod)
             self.queue.add_unschedulable(kube_pod)
             return
         self.cache.confirm_pod(name)
+        self.generic.clear_nomination(name)  # reservation served its purpose
         self.queue.forget(name)  # clears any leftover backoff state
         self._event(name, "Normal", "Scheduled",
                     f"Successfully assigned {name} to {host}")

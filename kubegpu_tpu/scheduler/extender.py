@@ -12,6 +12,11 @@ Wire protocol (JSON over POST, mirroring upstream's v1 shapes):
                 {"nodeNames": [...], "failedNodes": {name: reason}}
 - ``prioritize``: {"pod": <pod>, "nodeNames": [...]} ->
                 [{"host": name, "score": int}, ...]   (0..10 per upstream)
+- ``bind``:     {"podName": name, "node": name} -> {} | {"error": reason}
+                (`extender.go:44,90`: an extender declaring a bind verb
+                OWNS the binding — it performs the placement itself, e.g.
+                against its own device manager, instead of the scheduler
+                POSTing the Binding)
 
 Declared in the scheduler config as::
 
@@ -37,10 +42,12 @@ class ExtenderError(RuntimeError):
 class HTTPExtender:
     def __init__(self, url_prefix: str, filter_verb: str | None = None,
                  prioritize_verb: str | None = None, weight: float = 1.0,
-                 ignorable: bool = False, timeout_s: float = 5.0):
+                 ignorable: bool = False, timeout_s: float = 5.0,
+                 bind_verb: str | None = None):
         self.url_prefix = url_prefix.rstrip("/")
         self.filter_verb = filter_verb
         self.prioritize_verb = prioritize_verb
+        self.bind_verb = bind_verb
         self.weight = weight
         self.ignorable = ignorable
         self.timeout_s = timeout_s
@@ -51,6 +58,7 @@ class HTTPExtender:
             url_prefix=cfg["urlPrefix"],
             filter_verb=cfg.get("filterVerb"),
             prioritize_verb=cfg.get("prioritizeVerb"),
+            bind_verb=cfg.get("bindVerb"),
             weight=float(cfg.get("weight", 1.0)),
             ignorable=bool(cfg.get("ignorable", False)),
             timeout_s=float(cfg.get("httpTimeout", 5.0)),
@@ -97,6 +105,21 @@ class HTTPExtender:
                     and entry.get("host") in allowed}
         except Exception:
             return {}  # prioritize errors are non-fatal upstream
+
+
+    def bind(self, pod_name: str, node_name: str) -> None:
+        """Delegate the binding to the extender (`extender.go:44,90`).
+        Raises ``ExtenderError`` when the extender refuses or errors —
+        binding is placement, never soft-failed like prioritize."""
+        try:
+            out = self._post(self.bind_verb,
+                             {"podName": pod_name, "node": node_name})
+        except Exception as e:
+            raise ExtenderError(
+                f"extender {self.url_prefix} bind: {e}") from e
+        if isinstance(out, dict) and out.get("error"):
+            raise ExtenderError(
+                f"extender {self.url_prefix} bind: {out['error']}")
 
 
 def load_extenders(config: dict) -> list:

@@ -37,3 +37,21 @@ def test_bench_all_configs_smoke():
         assert key in result, key
     assert result["ici_locality"] == 1.0
     assert result["packing_utilization"] > 0
+
+
+def test_stderr_summary_surfaces_oom_not_traceback_header():
+    """The failure capture must surface the OOM line even though
+    'Traceback' appears first in stderr (VERDICT r3 weak #2)."""
+    import bench
+
+    stderr = (
+        "Traceback (most recent call last):\n"
+        '  File "x.py", line 1, in <module>\n'
+        "jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED: "
+        "Ran out of memory in memory space hbm. Used 19.34G of 15.75G.\n"
+        "For simplicity, JAX has removed its internal frames.\n"
+        "one more note line\n"
+        "and another\n")
+    out = bench._stderr_summary(stderr, 1)
+    assert "RESOURCE_EXHAUSTED" in out
+    assert not out.startswith("Traceback")

@@ -437,6 +437,142 @@ def test_ignorable_extender_failure_is_soft():
     assert survivors == ["a", "b"] and failed == {}
 
 
+def _serve_bind_extender(api, fail_with=None):
+    """Stub extender owning the bind verb: performs the Binding itself
+    against the API server (what a real delegated binder does), or
+    refuses with ``fail_with``. Returns (server, url, calls)."""
+    calls = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(
+                self.rfile.read(int(self.headers["Content-Length"])))
+            assert self.path.endswith("/bind"), self.path
+            calls.append(body)
+            if fail_with:
+                out = {"error": fail_with}
+            else:
+                api.bind_pod(body["podName"], body["node"])
+                out = {}
+            blob = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}", calls
+
+
+def test_extender_bind_verb_owns_binding():
+    """`extender.go:44,90`: a bind-verb extender performs the Binding;
+    the scheduler must not double-bind through the API."""
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    srv, url, calls = _serve_bind_extender(api)
+    try:
+        ds = DevicesScheduler()
+        ds.add_device(TPUScheduler())
+        ext = HTTPExtender(url, bind_verb="bind")
+        sched = Scheduler(api, ds, extenders=[ext])
+        api.create_pod(tpu_pod("p", 2))
+        sched.run_until_idle()
+        assert api.get_pod("p")["spec"]["nodeName"] == "host0"
+        assert calls == [{"podName": "p", "node": "host0"}]
+        # the annotation (device allocation) still went through the API
+        # before the delegated bind
+        from kubegpu_tpu.core import codec
+        assert codec.POD_ANNOTATION_KEY in \
+            api.get_pod("p")["metadata"]["annotations"]
+    finally:
+        srv.shutdown()
+
+
+def test_extender_bind_failure_requeues():
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    srv, url, calls = _serve_bind_extender(api, fail_with="not today")
+    try:
+        ds = DevicesScheduler()
+        ds.add_device(TPUScheduler())
+        ext = HTTPExtender(url, bind_verb="bind")
+        sched = Scheduler(api, ds, extenders=[ext])
+        api.create_pod(tpu_pod("p", 2))
+        sched.run_until_idle()
+        assert not api.get_pod("p")["spec"].get("nodeName")
+        assert calls  # the extender WAS consulted
+        # cache charge was rolled back: a second pod takes the chips
+        api.create_pod(tpu_pod("q", 4))
+        sched.queue.move_all_to_active()
+        # q needs all 4 chips; it only fits if p's charge was forgotten
+        ext.bind_verb = None  # binder out of the way for the retry
+        sched.run_until_idle()
+        assert api.get_pod("q")["spec"].get("nodeName") == "host0"
+    finally:
+        srv.shutdown()
+
+
+def test_ignorable_bind_extender_falls_back_to_api():
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    # unreachable binder, but ignorable: the API binding takes over
+    ext = HTTPExtender("http://127.0.0.1:1", bind_verb="bind",
+                       ignorable=True, timeout_s=0.2)
+    sched = Scheduler(api, ds, extenders=[ext])
+    api.create_pod(tpu_pod("p", 2))
+    sched.run_until_idle()
+    assert api.get_pod("p")["spec"]["nodeName"] == "host0"
+
+
+def test_gang_commit_flows_through_bind_extender():
+    """Gang members must honor a bind-verb extender exactly like the
+    single-pod path — no silent disagreement on who owns binding."""
+    from kubegpu_tpu.node.fake import v5p_host_inventory
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+    from tests.test_e2e import TPUHost
+    from tests.test_gang import gang_pod
+
+    api = InMemoryAPIServer()
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0)]):
+        TPUHost(api, f"host{i}",
+                v5p_host_inventory(host_origin=origin, mesh_dims=(4, 2, 1)))
+    srv, url, calls = _serve_bind_extender(api)
+    try:
+        ds = DevicesScheduler()
+        ds.add_device(TPUScheduler())
+        ext = HTTPExtender(url, bind_verb="bind")
+        sched = Scheduler(api, ds, extenders=[ext])
+        for i in range(2):
+            api.create_pod(gang_pod(f"g-{i}", 4, gang_id=1, gang_size=2))
+        sched.run_until_idle()
+        assert all(api.get_pod(f"g-{i}")["spec"].get("nodeName")
+                   for i in range(2))
+        assert sorted(c["podName"] for c in calls) == ["g-0", "g-1"]
+    finally:
+        srv.shutdown()
+
+
 # ---- review-fix regressions -------------------------------------------------
 
 
