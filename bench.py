@@ -38,6 +38,47 @@ from kubegpu_tpu.topology.mesh import ICIMesh
 # Tunable so tests can smoke the full bench cheaply (VERDICT r2 weak #4).
 ITERS = int(os.environ.get("KGTPU_BENCH_ITERS", "30"))
 
+# ---- device tables ----------------------------------------------------------
+# Shared by the embedded workload script (which imports bench) and by
+# `tests/test_device_fixture.py`, which pins them against the committed
+# real-device capture (`tests/fixtures/tpu_device_capture.json`).
+
+# Per-chip dense-bf16 peak (TFLOP/s), public spec sheets. device_kind
+# strings vary by runtime ("TPU v5 lite", "TPU v5e", ...); substring
+# match, then the axon env hint, then conservative v5e.
+PEAK_TFLOPS = [("v6e", 918.0), ("v6 lite", 918.0), ("v5p", 459.0),
+               ("v5 lite", 197.0), ("v5e", 197.0), ("v5", 459.0),
+               ("v4", 275.0), ("v3", 123.0), ("v2", 45.0)]
+
+# Usable HBM per chip (GiB): public spec minus runtime reservation — the
+# v5e figure is the judge-verified usable number (15.75 of 16 GB).
+HBM_GB = [("v6e", 30.0), ("v6 lite", 30.0), ("v5p", 93.0),
+          ("v5 lite", 15.75), ("v5e", 15.75), ("v5", 93.0),
+          ("v4", 30.0), ("v3", 30.0), ("v2", 15.0)]
+
+
+def peak_for(kind_str: str) -> float:
+    ks = (kind_str or "").lower()
+    for tag, tf in PEAK_TFLOPS:
+        if tag in ks:
+            return tf
+    hint = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for tag, tf in PEAK_TFLOPS:
+        if tag and tag == hint:
+            return tf
+    return 197.0
+
+
+def hbm_budget_for_kind(kind_str: str) -> float:
+    """Table-only HBM budget (GiB); the workload script first tries the
+    live ``memory_stats()`` (None under axon — see the committed device
+    fixture) and falls back to this."""
+    ks = (kind_str or "").lower()
+    for tag, gb in HBM_GB:
+        if tag in ks:
+            return gb
+    return 15.75  # conservative: smallest current part
+
 
 def make_pod(name, numchips, pod_requests=None, hbm=0):
     pi = PodInfo(name=name, requests=dict(pod_requests or {}))
@@ -309,41 +350,20 @@ backend = jax.default_backend()
 kind = str(getattr(jax.devices()[0], "device_kind", ""))
 preset = os.environ.get("KGTPU_BENCH_PRESET", "cpu")
 
-# Per-chip dense-bf16 peak (TFLOP/s), public spec sheets. device_kind
-# strings vary by runtime ("TPU v5 lite", "TPU v5e", ...); substring
-# match, then the axon env hint, then conservative v5e.
-PEAK_TFLOPS = [("v6e", 918.0), ("v6 lite", 918.0), ("v5p", 459.0),
-               ("v5 lite", 197.0), ("v5e", 197.0), ("v5", 459.0),
-               ("v4", 275.0), ("v3", 123.0), ("v2", 45.0)]
-def peak_for(kind_str):
-    ks = kind_str.lower()
-    for tag, tf in PEAK_TFLOPS:
-        if tag in ks:
-            return tf
-    hint = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for tag, tf in PEAK_TFLOPS:
-        if tag and tag == hint:
-            return tf
-    return 197.0
+# Device tables live in bench.py proper (this script runs with the repo
+# root as cwd) so tests pin them against the committed device fixture.
+from bench import hbm_budget_for_kind, peak_for
 
-# Usable HBM per chip (GiB). memory_stats() when the runtime exposes it
-# (axon returns None), else public spec minus runtime reservation — the
-# v5e number is the judge-verified usable figure (15.75 of 16 GB).
-HBM_GB = [("v6e", 30.0), ("v6 lite", 30.0), ("v5p", 93.0),
-          ("v5 lite", 15.75), ("v5e", 15.75), ("v5", 93.0),
-          ("v4", 30.0), ("v3", 30.0), ("v2", 15.0)]
 def hbm_budget_gb(kind_str):
+    # live memory_stats() when the runtime exposes it (axon returns
+    # None — see tests/fixtures/tpu_device_capture.json), else the table
     try:
         ms = jax.devices()[0].memory_stats() or {}
         if ms.get("bytes_limit"):
             return ms["bytes_limit"] / 2**30
     except Exception:
         pass
-    ks = kind_str.lower()
-    for tag, gb in HBM_GB:
-        if tag in ks:
-            return gb
-    return 15.75  # conservative: smallest current part
+    return hbm_budget_for_kind(kind_str)
 
 ndev = len(jax.devices())
 mesh = make_mesh(ndev, dp=ndev, sp=1, tp=1) if ndev > 1 \
@@ -624,6 +644,9 @@ def _workload_fingerprint() -> str:
     import hashlib
 
     h = hashlib.sha256(_WORKLOAD_BENCH.encode())
+    # the device tables moved to module level but stay part of what the
+    # workload measures — a table change must invalidate old captures
+    h.update(repr((PEAK_TFLOPS, HBM_GB)).encode())
     root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "kubegpu_tpu", "workload")
     for dirpath, _, files in sorted(os.walk(root)):

@@ -161,16 +161,17 @@ class GenericScheduler:
         not charged, matching upstream's resource-only treatment of
         nominated pods."""
         for pod in nominated:
-            for res, val in _pod_core_requests(pod).items():
-                snap.requested_core[res] = \
-                    snap.requested_core.get(res, 0) + val
             try:
                 info = self.cache.pod_info_for_node(pod, snap.name)
                 self.device_scheduler.pod_allocate(info, snap.node_ex)
                 self.device_scheduler.take_pod_resources(info, snap.node_ex)
             except Exception:
-                # freed room already retaken: nothing left to charge
+                # freed room already retaken: the reservation is dead —
+                # charge nothing (core charges included)
                 continue
+            for res, val in _pod_core_requests(pod).items():
+                snap.requested_core[res] = \
+                    snap.requested_core.get(res, 0) + val
 
     def _volume_snapshot(self, kube_pod: dict):
         """Pass-level PV/PVC snapshot for CheckVolumeBinding, or None when
@@ -955,7 +956,14 @@ class Scheduler:
                 for name, node_name, pinned in pinned_members:
                     self.api.update_pod_annotations(
                         name, pinned["metadata"].get("annotations") or {})
-                    binder.bind(name, node_name)
+                    try:
+                        binder.bind(name, node_name)
+                    except Exception:
+                        # same contract as the single-pod path: an
+                        # ignorable binder falls back to the API binding
+                        if not binder.ignorable:
+                            raise
+                        self.api.bind_pod(name, node_name)
                     committed.append(name)
             for name, _, _ in pinned_members:
                 self.cache.confirm_pod(name)
@@ -978,9 +986,37 @@ class Scheduler:
             for pinned in assumed:
                 if pinned["metadata"]["name"] not in done:
                     self.cache.forget_pod(pinned)
-            for member in members:
-                if member["metadata"]["name"] not in done:
+            if not done:
+                # nothing bound: the whole gang re-buffers and retries
+                for member in members:
                     self.queue.add_unschedulable(member)
+                return
+            # Partial delegated commit: the gang can never re-buffer to
+            # full size (bound members won't return), so stragglers
+            # retry as SOLO pods pinned to their planned chips. The
+            # de-ganged annotation must be persisted — schedule_one
+            # re-fetches the pod from the API and would otherwise see
+            # the gang request again and park it in the buffer forever.
+            from kubegpu_tpu.scheduler.gang import (RESOURCE_GANG,
+                                                    RESOURCE_GANG_SIZE)
+            for name, _, pinned in pinned_members:
+                if name in done:
+                    continue
+                try:
+                    info = codec.kube_pod_to_pod_info(
+                        pinned, invalidate_existing=False)
+                    info.requests.pop(RESOURCE_GANG, None)
+                    info.requests.pop(RESOURCE_GANG_SIZE, None)
+                    codec.pod_info_to_annotation(pinned["metadata"], info)
+                    self.api.update_pod_annotations(
+                        name, pinned["metadata"]["annotations"])
+                except Exception:
+                    pass  # keep the gang shape; the buffer retry below
+                    # is degraded but the pod is not lost
+                self._event(name, "Warning", "FailedScheduling",
+                            "gang partially bound; retrying member solo "
+                            "pinned to its planned chips")
+                self.queue.add_unschedulable(pinned)
 
     NOMINATED_NODE_ANNOTATION = "scheduler.alpha.kubernetes.io/nominated-node-name"
 

@@ -7,8 +7,32 @@
 // tests) and emits the host's chip/ICI inventory as JSON, which the Python
 // `NativeTPUBackend` parses into a TPUInventory.
 //
-// Expected tree layout (modeled on /sys/class/accel + a topology dir the
-// libtpu runtime exposes; fixture-identical in tests):
+// Expected tree layout, and how it maps to the PUBLIC TPU-VM layout.
+// (This build host has no local accel sysfs — the TPU is behind a
+// tunnel — so the layout below is documented against public sources and
+// exercised via `write_sysfs_fixture`; the tunnel-reachable device
+// attributes are pinned in `tests/fixtures/tpu_device_capture.json`.)
+//
+// What is standard, with sources:
+// - `/sys/class/accel/accel<N>/` per accelerator and `/dev/accel/accel<N>`
+//   char devices: the Linux compute-accelerator subsystem
+//   (kernel Documentation/accel/introduction.rst, merged v6.2; class
+//   name "accel", minors under major 261).
+// - Cloud TPU VMs expose one device node per chip, `/dev/accel0..3` on a
+//   v4/v5e host (Google Cloud TPU docs, "TPU VM architecture" /
+//   troubleshooting pages reference /dev/accel* ownership), and libtpu
+//   consumes chip visibility via TPU_VISIBLE_CHIPS-style env — which is
+//   exactly what the runtime hook injects (`kubegpu_tpu/runtime/hook.py`).
+// - VFIO passthrough hosts instead expose `/dev/vfio/<group>`
+//   (kernel Documentation/driver-api/vfio.rst); the optional
+//   `vfio_group` attribute models that deployment.
+//
+// What is THIS framework's contract (not stock kernel attributes):
+// `chip_id`, `hbm_bytes`, and the `<root>/topology/` directory are
+// populated by the node provisioner (or the test fixture writer,
+// `enumerator.write_sysfs_fixture`) from libtpu's topology query — the
+// kernel accel class does not publish mesh coordinates or HBM size; some
+// runtime component must, and this file defines the agreed shape:
 //
 //   <root>/accel/accel<N>/device/chip_id     "x.y.z" mesh coordinates
 //   <root>/accel/accel<N>/device/hbm_bytes   decimal bytes
@@ -18,6 +42,10 @@
 //   <root>/topology/host_bounds              "X,Y,Z"
 //   <root>/topology/tray_shape               "X,Y,Z"
 //   <root>/topology/runtime_version          free-form string
+//
+// Deviation from the kernel-doc layout: device nodes are emitted flat
+// (`/dev/accelN`, the Cloud TPU VM shape) rather than the subsystem's
+// `/dev/accel/accelN`; the CRI hook treats both as opaque paths.
 //
 // C ABI:
 //   int tpu_enumerate(const char* root, char* out, int out_len);

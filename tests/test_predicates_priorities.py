@@ -573,6 +573,88 @@ def test_gang_commit_flows_through_bind_extender():
         srv.shutdown()
 
 
+def test_gang_partial_bind_failure_recovers_members_solo():
+    """If the delegated binder fails mid-gang, bound members stay bound
+    and stragglers must still land once the binder recovers — not sit in
+    a gang buffer that can never re-complete."""
+    from kubegpu_tpu.node.fake import v5p_host_inventory
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+    from tests.test_e2e import TPUHost
+    from tests.test_gang import gang_pod
+
+    api = InMemoryAPIServer()
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0)]):
+        TPUHost(api, f"host{i}",
+                v5p_host_inventory(host_origin=origin, mesh_dims=(4, 2, 1)))
+    failing = {"g-1"}  # fail this member's first delegated bind
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(
+                self.rfile.read(int(self.headers["Content-Length"])))
+            if body["podName"] in failing:
+                failing.discard(body["podName"])
+                out = {"error": "binder hiccup"}
+            else:
+                api.bind_pod(body["podName"], body["node"])
+                out = {}
+            blob = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        ds = DevicesScheduler()
+        ds.add_device(TPUScheduler())
+        ext = HTTPExtender(f"http://127.0.0.1:{srv.server_address[1]}",
+                           bind_verb="bind")
+        sched = Scheduler(api, ds, extenders=[ext])
+        for i in range(2):
+            api.create_pod(gang_pod(f"g-{i}", 4, gang_id=1, gang_size=2))
+        sched.run_until_idle()
+        assert api.get_pod("g-0")["spec"].get("nodeName")  # committed
+        assert not api.get_pod("g-1")["spec"].get("nodeName")
+        # binder recovered (one-shot failure): the straggler retries SOLO
+        sched.queue.move_all_to_active()
+        sched.run_until_idle()
+        assert api.get_pod("g-1")["spec"].get("nodeName"), \
+            "straggler stuck in a gang buffer that can never complete"
+    finally:
+        srv.shutdown()
+
+
+def test_gang_ignorable_binder_falls_back_to_api():
+    from kubegpu_tpu.node.fake import v5p_host_inventory
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+    from tests.test_e2e import TPUHost
+    from tests.test_gang import gang_pod
+
+    api = InMemoryAPIServer()
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0)]):
+        TPUHost(api, f"host{i}",
+                v5p_host_inventory(host_origin=origin, mesh_dims=(4, 2, 1)))
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    ext = HTTPExtender("http://127.0.0.1:1", bind_verb="bind",
+                       ignorable=True, timeout_s=0.2)
+    sched = Scheduler(api, ds, extenders=[ext])
+    for i in range(2):
+        api.create_pod(gang_pod(f"g-{i}", 4, gang_id=1, gang_size=2))
+    sched.run_until_idle()
+    assert all(api.get_pod(f"g-{i}")["spec"].get("nodeName")
+               for i in range(2))
+
+
 # ---- review-fix regressions -------------------------------------------------
 
 
