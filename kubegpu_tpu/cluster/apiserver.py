@@ -228,29 +228,60 @@ class InMemoryAPIServer:
             if pv is not None:
                 self._notify("pv", "deleted", pv)
 
+    def patch_pv_spec(self, name: str, spec_patch: dict) -> dict:
+        """Strategic-merge patch of a PV's spec — the real binder's first
+        write (`kubeclient.bind_volume` PATCHes ``claimRef``). Conflicts
+        if the patch re-claims a PV already claimed elsewhere."""
+        with self._lock:
+            if name not in self._pvs:
+                raise NotFound(f"pv {name}")
+            pv = self._pvs[name]
+            ref = (spec_patch or {}).get("claimRef")
+            cur = (pv.get("spec") or {}).get("claimRef")
+            if ref and cur and cur.get("name") != ref.get("name"):
+                raise Conflict(f"pv {name} already claimed by "
+                               f"{cur.get('name')}")
+            _merge(pv.setdefault("spec", {}), spec_patch or {})
+            if pv["spec"].get("claimRef"):
+                pv.setdefault("status", {})["phase"] = "Bound"
+            self._notify("pv", "modified", pv)
+            return copy.deepcopy(pv)
+
+    def patch_pvc_spec(self, name: str, spec_patch: dict) -> dict:
+        """Strategic-merge patch of a PVC's spec (``volumeName`` — the
+        binder's second write)."""
+        with self._lock:
+            if name not in self._pvcs:
+                raise NotFound(f"pvc {name}")
+            pvc = self._pvcs[name]
+            vol = (spec_patch or {}).get("volumeName")
+            cur = (pvc.get("spec") or {}).get("volumeName")
+            if vol and cur and cur != vol:
+                raise Conflict(f"pvc {name} already bound to {cur}")
+            _merge(pvc.setdefault("spec", {}), spec_patch or {})
+            if pvc["spec"].get("volumeName"):
+                pvc.setdefault("status", {})["phase"] = "Bound"
+            self._notify("pvc", "modified", pvc)
+            return copy.deepcopy(pvc)
+
     def bind_volume(self, pv_name: str, claim_name: str) -> None:
         """Atomically pair a PV with a PVC: PV gains ``claimRef`` and PVC
         gains ``volumeName``; both flip to Bound. Conflict if either side
-        is already paired elsewhere."""
+        is already paired elsewhere. One copy of the conflict semantics:
+        delegates to the two spec-patch methods (the RLock is reentrant),
+        with the PVC side pre-checked so a conflicting claim cannot
+        half-claim the PV."""
         with self._lock:
             if pv_name not in self._pvs:
                 raise NotFound(f"pv {pv_name}")
             if claim_name not in self._pvcs:
                 raise NotFound(f"pvc {claim_name}")
-            pv, pvc = self._pvs[pv_name], self._pvcs[claim_name]
-            ref = (pv.get("spec") or {}).get("claimRef")
-            if ref and ref.get("name") != claim_name:
-                raise Conflict(f"pv {pv_name} already claimed by "
-                               f"{ref.get('name')}")
-            bound = (pvc.get("spec") or {}).get("volumeName")
+            bound = (self._pvcs[claim_name].get("spec") or {}) \
+                .get("volumeName")
             if bound and bound != pv_name:
                 raise Conflict(f"pvc {claim_name} already bound to {bound}")
-            pv.setdefault("spec", {})["claimRef"] = {"name": claim_name}
-            pv.setdefault("status", {})["phase"] = "Bound"
-            pvc.setdefault("spec", {})["volumeName"] = pv_name
-            pvc.setdefault("status", {})["phase"] = "Bound"
-            self._notify("pv", "modified", pv)
-            self._notify("pvc", "modified", pvc)
+            self.patch_pv_spec(pv_name, {"claimRef": {"name": claim_name}})
+            self.patch_pvc_spec(claim_name, {"volumeName": pv_name})
 
     # ---- pod disruption budgets -------------------------------------------
     # Minimal PDB surface the preemption path consumes

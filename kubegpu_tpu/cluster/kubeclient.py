@@ -256,6 +256,59 @@ class KubeAPIClient:
     def delete_pod(self, name: str) -> None:
         self._req("DELETE", self._pod_path(name))
 
+    # -- persistent volumes / claims ----------------------------------------
+    # PVCs are namespaced, PVs cluster-scoped (the real wire grammar). The
+    # scheduler's volume binder consumes exactly this surface
+    # (`volumebinder/volume_binder.go:1-74`).
+
+    def _pvc_path(self, name: str = "") -> str:
+        base = f"/api/v1/namespaces/{self.namespace}/persistentvolumeclaims"
+        return base + (f"/{urllib.parse.quote(name)}" if name else "")
+
+    def create_pvc(self, pvc: dict) -> dict:
+        return self._req("POST", self._pvc_path(), pvc)
+
+    def get_pvc(self, name: str) -> dict:
+        return self._req("GET", self._pvc_path(name))
+
+    def list_pvcs(self) -> list:
+        return self._req("GET", self._pvc_path()).get("items") or []
+
+    def delete_pvc(self, name: str) -> None:
+        self._req("DELETE", self._pvc_path(name))
+
+    def create_pv(self, pv: dict) -> dict:
+        return self._req("POST", "/api/v1/persistentvolumes", pv)
+
+    def get_pv(self, name: str) -> dict:
+        return self._req(
+            "GET", f"/api/v1/persistentvolumes/{urllib.parse.quote(name)}")
+
+    def list_pvs(self) -> list:
+        return self._req("GET", "/api/v1/persistentvolumes") \
+            .get("items") or []
+
+    def delete_pv(self, name: str) -> None:
+        self._req(
+            "DELETE", f"/api/v1/persistentvolumes/{urllib.parse.quote(name)}")
+
+    def bind_volume(self, pv_name: str, claim_name: str) -> None:
+        """Commit a claim<->volume pairing the way the real binder does:
+        patch the PV's ``claimRef``, then the PVC's ``volumeName`` (two
+        strategic-merge patches — Kubernetes has no atomic pair-bind; the
+        PV patch first makes the reservation visible before the claim
+        flips)."""
+        self._req(
+            "PATCH",
+            f"/api/v1/persistentvolumes/{urllib.parse.quote(pv_name)}",
+            {"spec": {"claimRef": {"name": claim_name,
+                                   "namespace": self.namespace}}},
+            content_type=STRATEGIC_MERGE)
+        self._req(
+            "PATCH", self._pvc_path(claim_name),
+            {"spec": {"volumeName": pv_name}},
+            content_type=STRATEGIC_MERGE)
+
     # -- watches ------------------------------------------------------------
 
     def add_watcher(self, fn) -> None:
@@ -265,7 +318,9 @@ class KubeAPIClient:
         if not self._watch_threads:
             for kind, path in (
                     ("node", "/api/v1/nodes"),
-                    ("pod", self._pod_path())):
+                    ("pod", self._pod_path()),
+                    ("pvc", self._pvc_path()),
+                    ("pv", "/api/v1/persistentvolumes")):
                 t = threading.Thread(
                     target=self._watch_loop, args=(kind, path), daemon=True,
                     name=f"kubewatch-{kind}")

@@ -219,6 +219,55 @@ def serve_mock_kube(api: InMemoryAPIServer | None = None,
                     api.bind_pod(sub[0], (binding.get("target") or {})["name"])
                     return self._send(201, {"kind": "Status", "code": 201})
 
+            # /api/v1/persistentvolumes[...] (cluster-scoped)
+            if rest and rest[0] == "persistentvolumes":
+                if len(rest) == 1:
+                    if method == "GET" and query.get("watch") == "true":
+                        return self._stream_watch(
+                            "pv", int(query.get("resourceVersion") or 0))
+                    if method == "GET":
+                        return self._list("PersistentVolumeList",
+                                          api.list_pvs())
+                    if method == "POST":
+                        return self._send(201, api.create_pv(self._body()))
+                elif len(rest) == 2:
+                    name = rest[1]
+                    if method == "GET":
+                        return self._send(200, api.get_pv(name))
+                    if method == "DELETE":
+                        api.delete_pv(name)
+                        return self._send(200, {"kind": "Status", "code": 200})
+                    if method == "PATCH":
+                        self._require_smp()
+                        return self._send(200, api.patch_pv_spec(
+                            name, self._body().get("spec") or {}))
+
+            # /api/v1/namespaces/{ns}/persistentvolumeclaims[...]
+            if (len(rest) >= 3 and rest[0] == "namespaces"
+                    and rest[1] == namespace
+                    and rest[2] == "persistentvolumeclaims"):
+                sub = rest[3:]
+                if not sub:
+                    if method == "GET" and query.get("watch") == "true":
+                        return self._stream_watch(
+                            "pvc", int(query.get("resourceVersion") or 0))
+                    if method == "GET":
+                        return self._list("PersistentVolumeClaimList",
+                                          api.list_pvcs())
+                    if method == "POST":
+                        return self._send(201, api.create_pvc(self._body()))
+                elif len(sub) == 1:
+                    name = sub[0]
+                    if method == "GET":
+                        return self._send(200, api.get_pvc(name))
+                    if method == "DELETE":
+                        api.delete_pvc(name)
+                        return self._send(200, {"kind": "Status", "code": 200})
+                    if method == "PATCH":
+                        self._require_smp()
+                        return self._send(200, api.patch_pvc_spec(
+                            name, self._body().get("spec") or {}))
+
             self._send(404, {"kind": "Status", "code": 404,
                              "message": f"no route {method} {self.path}"})
 

@@ -395,6 +395,20 @@ def check_volume_binding(kube_pod: dict, kube_node: dict,
                 return False, ["node(s) had volume node affinity "
                                "conflict"], {}
             continue
+        # A PV whose claimRef already names THIS claim must match even
+        # though it is no longer "available" — operator prebinding, and
+        # the recovery path for a half-committed two-patch bind (PV
+        # claimRef landed, PVC volumeName patch failed): without this the
+        # claim can never reach the idempotent re-bind and wedges forever.
+        prebound = sorted(
+            (p for p in pvs
+             if (((p.get("spec") or {}).get("claimRef") or {}).get("name")
+                 == claim_name)
+             and pv_node_affinity_matches(p, kube_node)),
+            key=lambda p: p["metadata"]["name"])
+        if prebound:
+            proposed[claim_name] = prebound[0]["metadata"]["name"]
+            continue
         want_class = (pvc.get("spec") or {}).get("storageClassName") or ""
         need = _pvc_request(pvc)
         candidates = sorted(

@@ -92,11 +92,14 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
                                           (0, start_pos, 0, 0))
             new_cache.append({"k": ck, "v": cv})
 
-            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                           ck.astype(jnp.float32)) * scale
+            # bf16 operands, f32 accumulation — MXU-native (see
+            # model._causal_attention)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                           preferred_element_type=jnp.float32) * scale
             s = jnp.where(mask[None, None], s, NEG_INF)
             p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+            attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), cv,
+                              preferred_element_type=jnp.float32)
             x = x + attn.astype(dt).reshape(b, t, -1) @ layer["wo"].astype(dt)
             x = constrain(x, spmd.AXIS_DATA, None, None)
 

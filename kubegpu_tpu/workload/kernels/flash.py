@@ -86,8 +86,12 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(_block_visible(cfg, off_ref, qi, ki))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        # Dots take the NATIVE (bf16) operands with f32 ACCUMULATION —
+        # the MXU's native mode. Casting operands to f32 first would run
+        # every matmul at 1/4 the bf16 rate; the accumulator precision is
+        # identical either way (preferred_element_type=f32).
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * cfg.scale
         if cfg.causal:
@@ -105,7 +109,7 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = l_ref[...] * alpha + jnp.broadcast_to(
             jnp.sum(p, 1, keepdims=True), m_prev.shape)
         m_ref[...] = m_new
-        pv = lax.dot_general(p, v_ref[0, 0].astype(jnp.float32),
+        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0, 0],
                              (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
         acc_ref[...] = acc_ref[...] * alpha[:, :1] + pv
@@ -169,8 +173,8 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_block_visible(cfg, off_ref, qi, ki))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * cfg.scale
         if cfg.causal:
@@ -179,13 +183,12 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])
-        do = do_ref[0, 0].astype(jnp.float32)
-        dp = lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
+        do = do_ref[0, 0]
+        dp = lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, :1])
         dq_acc[...] += cfg.scale * lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_k - 1)
@@ -204,8 +207,8 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_block_visible(cfg, off_ref, qi, ki))
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * cfg.scale
         if cfg.causal:
@@ -214,15 +217,15 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])
-        do = do_ref[0, 0].astype(jnp.float32)
-        dv_acc[...] += lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        do = do_ref[0, 0]
+        dv_acc[...] += lax.dot_general(p.astype(do.dtype), do,
+                                       (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v_ref[0, 0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
+        dp = lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0, 0][:, :1])
         dk_acc[...] += cfg.scale * lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q - 1)

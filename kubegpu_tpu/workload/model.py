@@ -126,14 +126,20 @@ def _rope(x, positions, theta: float):
 
 
 def _causal_attention(q, k, v, scale: float):
-    """Single-shard fused causal attention ([B,T,H,D] layout)."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    """Single-shard fused causal attention ([B,T,H,D] layout).
+
+    Operands stay in the compute dtype (bf16) with f32 ACCUMULATION
+    (``preferred_element_type``) — the MXU's native mode. Casting inputs
+    to f32 before the einsum would run the matmuls at 1/4 the bf16 rate
+    for no extra accumulator precision."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     t = q.shape[1]
     mask = jnp.tril(jnp.ones((t, t), bool))
     s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 

@@ -10,7 +10,7 @@ import time
 
 import pytest
 
-from kubegpu_tpu.cluster.apiserver import NotFound
+from kubegpu_tpu.cluster.apiserver import Conflict, NotFound
 from kubegpu_tpu.cluster.kubeclient import KubeAPIClient, KubeConfig
 from kubegpu_tpu.cluster.mock_kube import serve_mock_kube
 
@@ -199,6 +199,81 @@ def test_end_to_end_over_real_grammar(kube):
             "job-a", "main", {})
         env = {e["key"]: e["value"] for e in config["envs"]}
         assert len(env["TPU_VISIBLE_CHIPS"].split(",")) == 2
+    finally:
+        sched.stop()
+        sched_client.close()
+
+
+def test_pvc_pv_crud_and_two_patch_bind(kube):
+    """The real binder's wire shape: PV claimRef patch, then PVC
+    volumeName patch, both strategic-merge; re-claim conflicts."""
+    client, _ = kube
+    client.create_pvc({"metadata": {"name": "c1"},
+                       "spec": {"resources": {"requests":
+                                              {"storage": "5Gi"}},
+                                "storageClassName": ""}})
+    client.create_pv({"metadata": {"name": "v1"},
+                      "spec": {"capacity": {"storage": "10Gi"},
+                               "storageClassName": ""}})
+    assert [p["metadata"]["name"] for p in client.list_pvcs()] == ["c1"]
+    assert [p["metadata"]["name"] for p in client.list_pvs()] == ["v1"]
+    client.bind_volume("v1", "c1")
+    assert client.get_pv("v1")["spec"]["claimRef"]["name"] == "c1"
+    assert client.get_pvc("c1")["spec"]["volumeName"] == "v1"
+    client.create_pvc({"metadata": {"name": "c2"}, "spec": {}})
+    with pytest.raises(Conflict):
+        client.bind_volume("v1", "c2")  # re-claim conflicts (409)
+    client.delete_pvc("c2")
+    client.delete_pv("v1")
+    with pytest.raises(NotFound):
+        client.get_pv("v1")
+
+
+def test_volume_binding_end_to_end_over_real_grammar(kube):
+    """Unbound-PVC pod over Kubernetes REST: scheduler waits, PV arrives
+    via the pv watch, pod binds and the claim flips to Bound through the
+    two-patch bind."""
+    from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+    from kubegpu_tpu.node.fake import FakeTPUBackend, v5p_host_inventory
+    from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    client, _ = kube
+    client.create_node(_node("host0"))
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(FakeTPUBackend(v5p_host_inventory())))
+    mgr.start()
+    DeviceAdvertiser(client, mgr, "host0").advertise_once()
+
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched_client = KubeAPIClient(KubeConfig(server=client.config.server))
+    sched = Scheduler(sched_client, ds)
+    try:
+        client.create_pvc({"metadata": {"name": "data"},
+                           "spec": {"resources": {"requests":
+                                                  {"storage": "5Gi"}},
+                                    "storageClassName": ""}})
+        pod = _pod("vol-job", chips=1)
+        pod["spec"]["volumes"] = [
+            {"name": "d", "persistentVolumeClaim": {"claimName": "data"}}]
+        client.create_pod(pod)
+        sched.run_until_idle()
+        assert not client.get_pod("vol-job")["spec"].get("nodeName")
+        client.create_pv({"metadata": {"name": "vol1"},
+                          "spec": {"capacity": {"storage": "10Gi"},
+                                   "storageClassName": ""}})
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            sched.run_until_idle()
+            if client.get_pod("vol-job")["spec"].get("nodeName"):
+                break
+            time.sleep(0.05)
+        assert client.get_pod("vol-job")["spec"].get("nodeName") == "host0"
+        assert client.get_pvc("data")["spec"]["volumeName"] == "vol1"
+        assert client.get_pv("vol1")["spec"]["claimRef"]["name"] == "data"
     finally:
         sched.stop()
         sched_client.close()

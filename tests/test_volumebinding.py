@@ -222,6 +222,40 @@ def test_bind_time_conflict_requeues_then_recovers():
     assert api.get_pvc("claim1")["spec"]["volumeName"] == "vol2"
 
 
+def test_half_committed_bind_recovers_via_prebound_pv():
+    """The two-patch REST bind can land the PV's claimRef and then fail
+    the PVC patch. The retry must still match the pre-claimed PV (it
+    names this claim) and complete the idempotent bind — there is no PV
+    controller to clear the stale claimRef."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1"))
+    api.create_pv(pv("vol1"))
+    # simulate the half-committed state: claimRef landed, volumeName didn't
+    api.patch_pv_spec("vol1", {"claimRef": {"name": "claim1"}})
+    assert not api.get_pvc("claim1")["spec"].get("volumeName")
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert api.get_pod("p1")["spec"]["nodeName"] == "host0"
+    assert api.get_pvc("claim1")["spec"]["volumeName"] == "vol1"
+
+
+def test_prebound_pv_not_stolen_by_other_claim():
+    """A PV pre-claimed for claim A must never be proposed to claim B."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claimA"))
+    api.create_pvc(pvc("claimB"))
+    api.create_pv(pv("volA"))
+    api.patch_pv_spec("volA", {"claimRef": {"name": "claimA"}})
+    api.create_pod(pod_with_claim("pb", "claimB"))
+    sched.run_until_idle()
+    assert not api.get_pod("pb")["spec"].get("nodeName")
+    assert not api.get_pvc("claimB")["spec"].get("volumeName")
+
+
 def test_gang_members_commit_volumes():
     """Gang pods with PVCs must land with their claims bound (same
     kubelet-side contract as the single-pod path) and a missing PV must
