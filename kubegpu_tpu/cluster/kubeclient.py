@@ -265,6 +265,11 @@ class KubeAPIClient:
         base = f"/api/v1/namespaces/{self.namespace}/persistentvolumeclaims"
         return base + (f"/{urllib.parse.quote(name)}" if name else "")
 
+    @staticmethod
+    def _pv_path(name: str = "") -> str:
+        base = "/api/v1/persistentvolumes"
+        return base + (f"/{urllib.parse.quote(name)}" if name else "")
+
     def create_pvc(self, pvc: dict) -> dict:
         return self._req("POST", self._pvc_path(), pvc)
 
@@ -278,36 +283,53 @@ class KubeAPIClient:
         self._req("DELETE", self._pvc_path(name))
 
     def create_pv(self, pv: dict) -> dict:
-        return self._req("POST", "/api/v1/persistentvolumes", pv)
+        return self._req("POST", self._pv_path(), pv)
 
     def get_pv(self, name: str) -> dict:
-        return self._req(
-            "GET", f"/api/v1/persistentvolumes/{urllib.parse.quote(name)}")
+        return self._req("GET", self._pv_path(name))
 
     def list_pvs(self) -> list:
-        return self._req("GET", "/api/v1/persistentvolumes") \
-            .get("items") or []
+        return self._req("GET", self._pv_path()).get("items") or []
 
     def delete_pv(self, name: str) -> None:
-        self._req(
-            "DELETE", f"/api/v1/persistentvolumes/{urllib.parse.quote(name)}")
+        self._req("DELETE", self._pv_path(name))
 
     def bind_volume(self, pv_name: str, claim_name: str) -> None:
         """Commit a claim<->volume pairing the way the real binder does:
         patch the PV's ``claimRef``, then the PVC's ``volumeName`` (two
         strategic-merge patches — Kubernetes has no atomic pair-bind; the
         PV patch first makes the reservation visible before the claim
-        flips)."""
-        self._req(
-            "PATCH",
-            f"/api/v1/persistentvolumes/{urllib.parse.quote(pv_name)}",
-            {"spec": {"claimRef": {"name": claim_name,
-                                   "namespace": self.namespace}}},
-            content_type=STRATEGIC_MERGE)
-        self._req(
-            "PATCH", self._pvc_path(claim_name),
-            {"spec": {"volumeName": pv_name}},
-            content_type=STRATEGIC_MERGE)
+        flips).
+
+        Re-claim guard: a real apiserver merges a claimRef patch over an
+        existing one without complaint, so each side is GET-verified
+        first (Conflict on a foreign pairing) and the observed
+        ``resourceVersion`` rides in the patch body, which makes the
+        write an optimistic test-and-set on servers that stamp it — an
+        external binder racing into the GET->PATCH window loses to the
+        precondition instead of being silently overwritten."""
+        pv = self.get_pv(pv_name)
+        ref = (pv.get("spec") or {}).get("claimRef")
+        if ref and ref.get("name") != claim_name:
+            raise Conflict(f"pv {pv_name} already claimed by "
+                           f"{ref.get('name')}")
+        body: dict = {"spec": {"claimRef": {"name": claim_name,
+                                            "namespace": self.namespace}}}
+        rv = (pv.get("metadata") or {}).get("resourceVersion")
+        if rv:
+            body["metadata"] = {"resourceVersion": rv}
+        self._req("PATCH", self._pv_path(pv_name), body,
+                  content_type=STRATEGIC_MERGE)
+        pvc = self.get_pvc(claim_name)
+        bound = (pvc.get("spec") or {}).get("volumeName")
+        if bound and bound != pv_name:
+            raise Conflict(f"pvc {claim_name} already bound to {bound}")
+        body = {"spec": {"volumeName": pv_name}}
+        rv = (pvc.get("metadata") or {}).get("resourceVersion")
+        if rv:
+            body["metadata"] = {"resourceVersion": rv}
+        self._req("PATCH", self._pvc_path(claim_name), body,
+                  content_type=STRATEGIC_MERGE)
 
     # -- watches ------------------------------------------------------------
 

@@ -395,19 +395,27 @@ def check_volume_binding(kube_pod: dict, kube_node: dict,
                 return False, ["node(s) had volume node affinity "
                                "conflict"], {}
             continue
-        # A PV whose claimRef already names THIS claim must match even
-        # though it is no longer "available" — operator prebinding, and
-        # the recovery path for a half-committed two-patch bind (PV
-        # claimRef landed, PVC volumeName patch failed): without this the
-        # claim can never reach the idempotent re-bind and wedges forever.
+        # A PV whose claimRef already names THIS claim is the ONLY
+        # permissible match (real-Kubernetes prebinding semantics) —
+        # operator prebinding, and the recovery path for a half-committed
+        # two-patch bind (PV claimRef landed, PVC volumeName patch
+        # failed): without it the claim could never reach the idempotent
+        # re-bind, and matching a DIFFERENT PV here would strand the
+        # pre-claimed one claimRef'd forever (no PV controller exists to
+        # clear it). If none tolerates this node, the node fails — the
+        # pod is steered to where its pre-claimed PV lives.
         prebound = sorted(
             (p for p in pvs
              if (((p.get("spec") or {}).get("claimRef") or {}).get("name")
-                 == claim_name)
-             and pv_node_affinity_matches(p, kube_node)),
+                 == claim_name)),
             key=lambda p: p["metadata"]["name"])
         if prebound:
-            proposed[claim_name] = prebound[0]["metadata"]["name"]
+            usable = [p for p in prebound
+                      if pv_node_affinity_matches(p, kube_node)]
+            if not usable:
+                return False, ["node(s) had volume node affinity "
+                               "conflict"], {}
+            proposed[claim_name] = usable[0]["metadata"]["name"]
             continue
         want_class = (pvc.get("spec") or {}).get("storageClassName") or ""
         need = _pvc_request(pvc)

@@ -316,7 +316,15 @@ def _flash(cfg: _Cfg, offsets, q, k, v):
 
 
 def _flash_fwd(cfg: _Cfg, offsets, q, k, v):
+    from jax.ad_checkpoint import checkpoint_name
+
     o, lse = _fwd(cfg, offsets, q, k, v)
+    # Name the residuals so a rematerialisation policy can SAVE them
+    # (model.py's "dots" policy does): without this, jax.checkpoint must
+    # re-run the whole forward kernel in the backward pass just to
+    # regenerate (o, lse) for the custom VJP.
+    o = checkpoint_name(o, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return (o, lse), (offsets, q, k, v, o, lse)
 
 

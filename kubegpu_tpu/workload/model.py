@@ -23,6 +23,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from kubegpu_tpu.workload import spmd
 from kubegpu_tpu.workload.ring import make_sharded_ring_attention
@@ -214,7 +215,7 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
             v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
-            attn = attend(q, k, v)
+            attn = checkpoint_name(attend(q, k, v), "attn_out")
             x = x + attn.reshape(b, t, -1) @ layer["wo"].astype(dt)
             x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
 
@@ -235,9 +236,17 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
         if cfg.remat == "full":
             return jax.checkpoint(block)
         if cfg.remat == "dots":
+            # matmul outputs PLUS the named attention residuals: the
+            # attention einsums have batch dims (so the dots policy alone
+            # recomputes them), and the flash kernel's custom VJP would
+            # re-run its whole forward to regenerate (o, lse) — saving
+            # "attn_out"/"attn_lse" (~1 activation per layer) avoids both.
             return jax.checkpoint(
                 block,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+                policy=jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "attn_out", "attn_lse")))
         if cfg.remat != "none":
             raise ValueError(f"unknown remat mode {cfg.remat!r}")
         return block

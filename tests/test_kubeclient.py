@@ -207,7 +207,7 @@ def test_end_to_end_over_real_grammar(kube):
 def test_pvc_pv_crud_and_two_patch_bind(kube):
     """The real binder's wire shape: PV claimRef patch, then PVC
     volumeName patch, both strategic-merge; re-claim conflicts."""
-    client, _ = kube
+    client, api = kube
     client.create_pvc({"metadata": {"name": "c1"},
                        "spec": {"resources": {"requests":
                                               {"storage": "5Gi"}},
@@ -223,6 +223,14 @@ def test_pvc_pv_crud_and_two_patch_bind(kube):
     client.create_pvc({"metadata": {"name": "c2"}, "spec": {}})
     with pytest.raises(Conflict):
         client.bind_volume("v1", "c2")  # re-claim conflicts (409)
+    # the client-side GET-verify guards even against servers that would
+    # happily merge a foreign claimRef (real apiserver behavior)
+    api.create_pv({"metadata": {"name": "v9"},
+                   "spec": {"capacity": {"storage": "1Gi"},
+                            "storageClassName": "",
+                            "claimRef": {"name": "someone-else"}}})
+    with pytest.raises(Conflict):
+        client.bind_volume("v9", "c2")
     client.delete_pvc("c2")
     client.delete_pv("v1")
     with pytest.raises(NotFound):

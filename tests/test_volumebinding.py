@@ -241,6 +241,44 @@ def test_half_committed_bind_recovers_via_prebound_pv():
     assert api.get_pvc("claim1")["spec"]["volumeName"] == "vol1"
 
 
+def test_prebound_pv_is_the_only_match_and_steers_placement():
+    """A pre-claimed PV must be the claim's ONLY permissible match: the
+    pod is steered to the node the pre-claimed PV tolerates, never bound
+    to a different PV (which would strand the pre-claimed one forever)."""
+    api = InMemoryAPIServer()
+    for name in ("host0", "host1"):
+        node = flat_tpu_node(name)
+        node["metadata"]["labels"] = {"kubernetes.io/hostname": name}
+        api.create_node(node)
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1"))
+    api.create_pv(pv("vol1", node_hostname="host1"))
+    api.patch_pv_spec("vol1", {"claimRef": {"name": "claim1"}})
+    api.create_pv(pv("vol2"))  # available everywhere — must NOT be taken
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    assert api.get_pod("p1")["spec"]["nodeName"] == "host1"
+    assert api.get_pvc("claim1")["spec"]["volumeName"] == "vol1"
+    assert not (api.get_pv("vol2")["spec"].get("claimRef"))
+
+
+def test_prebound_pv_unreachable_node_keeps_pod_pending():
+    api = InMemoryAPIServer()
+    node = flat_tpu_node("host0")
+    node["metadata"]["labels"] = {"kubernetes.io/hostname": "host0"}
+    api.create_node(node)
+    sched = make_scheduler(api)
+    api.create_pvc(pvc("claim1"))
+    api.create_pv(pv("vol1", node_hostname="elsewhere"))
+    api.patch_pv_spec("vol1", {"claimRef": {"name": "claim1"}})
+    api.create_pv(pv("vol2"))
+    api.create_pod(pod_with_claim("p1", "claim1"))
+    sched.run_until_idle()
+    # waiting beats silently binding vol2 and stranding vol1
+    assert not api.get_pod("p1")["spec"].get("nodeName")
+    assert not (api.get_pv("vol2")["spec"].get("claimRef"))
+
+
 def test_prebound_pv_not_stolen_by_other_claim():
     """A PV pre-claimed for claim A must never be proposed to claim B."""
     api = InMemoryAPIServer()
