@@ -377,7 +377,8 @@ def est_gb(c, B, T, remat):
     P = 2 * V * d + L * (4 * d * d + 3 * d * dff)
     state = P * 4 * 4                     # params + 2 Adam moments + grads
     act1 = B * T * d * 2                  # one bf16 [B,T,d] tensor
-    per_layer = {"full": 1.5, "dots": 12.0, "none": 16.0}[remat]
+    # "dots" saves matmul outputs + the named attention residuals
+    per_layer = {"full": 1.5, "dots": 13.5, "none": 16.0}[remat]
     acts = L * act1 * per_layer + 6 * B * T * dff * 2
     logits = int(2.5 * B * T * V * 4)     # logits + log_softmax + grad
     return 1.2 * (state + acts + logits) / 2**30

@@ -310,9 +310,12 @@ class KubeAPIClient:
         precondition instead of being silently overwritten."""
         pv = self.get_pv(pv_name)
         ref = (pv.get("spec") or {}).get("claimRef")
-        if ref and ref.get("name") != claim_name:
-            raise Conflict(f"pv {pv_name} already claimed by "
-                           f"{ref.get('name')}")
+        if ref and (ref.get("name") != claim_name
+                    or (ref.get("namespace") or self.namespace)
+                    != self.namespace):
+            raise Conflict(
+                f"pv {pv_name} already claimed by "
+                f"{ref.get('namespace') or self.namespace}/{ref.get('name')}")
         body: dict = {"spec": {"claimRef": {"name": claim_name,
                                             "namespace": self.namespace}}}
         rv = (pv.get("metadata") or {}).get("resourceVersion")

@@ -1,0 +1,101 @@
+"""``kgtpu-train``: the workload a scheduled pod actually runs.
+
+The "8-chip JAX job" end of the placement contract, as a binary: build a
+mesh from the chips the runtime hook granted (``TPU_VISIBLE_CHIPS`` via
+`spmd.mesh_from_env` — or every visible device standalone), stream
+batches from token shards through the native data loader
+(`native/dataloader.cpp`, Python fallback), and run the sharded train
+step. Synthetic shards are generated on demand so the demo runs
+anywhere.
+
+    python -m kubegpu_tpu.cmd.train_demo --steps 4 --d-model 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", nargs="*", default=None,
+                    help="token shard paths (default: generate synthetic)")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "dots", "full"])
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
+
+    import jax
+
+    # honor an explicit platform choice even under a sitecustomize that
+    # pins a TPU-tunnel plugin (same workaround as tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+    import numpy as np
+
+    from kubegpu_tpu.workload import spmd
+    from kubegpu_tpu.workload.data import make_loader, write_token_shard
+    from kubegpu_tpu.workload.model import TransformerConfig
+    from kubegpu_tpu.workload.train import init_sharded, make_train_step
+
+    paths = args.data
+    tmp = None
+    if not paths:
+        tmp = tempfile.mkdtemp(prefix="kgtpu-tokens-")
+        rng = np.random.default_rng(args.seed)
+        paths = [write_token_shard(
+            os.path.join(tmp, f"shard{i}.kgtd"),
+            rng.integers(0, args.vocab, size=50_000, dtype=np.uint32))
+            for i in range(2)]
+
+    cfg = TransformerConfig(
+        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=4 * args.d_model,
+        max_seq=args.seq, remat=args.remat)
+    mesh = spmd.mesh_from_env()
+    params, opt_state, optimizer = init_sharded(
+        jax.random.PRNGKey(args.seed), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer)
+    loader = make_loader(paths, args.batch, args.seq, seed=args.seed)
+    loader_kind = type(loader).__name__
+
+    losses = []
+    t0 = time.perf_counter()
+    try:
+        for i in range(args.steps):
+            tokens = jax.numpy.asarray(next(loader))
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(jax.device_get(loss)))
+    finally:
+        loader.close()
+    wall = time.perf_counter() - t0
+
+    print(json.dumps({
+        "loader": loader_kind,
+        "devices": len(mesh.devices.flatten()),
+        "steps": args.steps,
+        "first_loss": round(losses[0], 4),
+        "last_loss": round(losses[-1], 4),
+        "tokens_per_s": round(args.steps * args.batch * args.seq / wall, 1),
+    }))
+    return 0 if all(np.isfinite(losses)) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

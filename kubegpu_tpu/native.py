@@ -64,6 +64,19 @@ def get_lib():
             lib.grp_last_error.restype = ctypes.c_char_p
         except AttributeError:
             pass  # stale library without the allocator core
+        try:
+            lib.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_longlong,
+                                    ctypes.c_longlong, ctypes.c_ulonglong,
+                                    ctypes.c_int]
+            lib.dl_open.restype = ctypes.c_void_p
+            lib.dl_next.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.c_longlong]
+            lib.dl_next.restype = ctypes.c_longlong
+            lib.dl_close.argtypes = [ctypes.c_void_p]
+            lib.dl_last_error.restype = ctypes.c_char_p
+        except AttributeError:
+            pass  # stale library without the data loader
         _lib = lib
     except OSError:
         _lib = None

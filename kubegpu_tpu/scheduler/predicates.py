@@ -404,11 +404,21 @@ def check_volume_binding(kube_pod: dict, kube_node: dict,
         # pre-claimed one claimRef'd forever (no PV controller exists to
         # clear it). If none tolerates this node, the node fails — the
         # pod is steered to where its pre-claimed PV lives.
-        prebound = sorted(
-            (p for p in pvs
-             if (((p.get("spec") or {}).get("claimRef") or {}).get("name")
-                 == claim_name)),
-            key=lambda p: p["metadata"]["name"])
+        pod_ns = (kube_pod.get("metadata") or {}).get("namespace")
+
+        def _prebound_for_claim(p):
+            ref = ((p.get("spec") or {}).get("claimRef") or {})
+            if ref.get("name") != claim_name:
+                return False
+            # PVs are cluster-scoped: a same-named claim in ANOTHER
+            # namespace is a foreign binding, not ours. Either side
+            # omitting the namespace (the single-namespace in-memory
+            # model) matches.
+            ref_ns = ref.get("namespace")
+            return ref_ns is None or pod_ns is None or ref_ns == pod_ns
+
+        prebound = sorted((p for p in pvs if _prebound_for_claim(p)),
+                          key=lambda p: p["metadata"]["name"])
         if prebound:
             usable = [p for p in prebound
                       if pv_node_affinity_matches(p, kube_node)]

@@ -1,0 +1,245 @@
+// Token-shard data loader: mmap'd shards + a prefetch ring.
+//
+// The input pipeline for the workload layer (`kubegpu_tpu/workload/data.py`)
+// — the "data-loader" entry of the native runtime the reference outsourced
+// entirely (its only native seam was the nvidia-docker daemon, SURVEY.md
+// §0/§2.9; it has no training runtime at all). Host-side C++ so tokenizing
+// IO never competes with the Python thread driving the TPU: a producer
+// thread fills a bounded ring of ready batches while the previous step runs
+// on device.
+//
+// Shard format (written by `workload/data.py::write_token_shard`):
+//   8-byte magic "KGTDSH01", uint64 LE n_tokens, then n_tokens x uint32 LE.
+//
+// Sampling contract (MUST stay bit-identical to PyTokenLoader, it is
+// differentially tested): splitmix64 PRNG from `seed`; per sample draw
+//   r1 = next() -> shard = r1 % n_shards
+//   r2 = next() -> start = r2 % (shard_n_tokens - seq1 + 1)
+// and emit seq1 consecutive tokens; `batch` samples form one batch, drawn
+// in row order. Deterministic across implementations and runs.
+//
+// C ABI:
+//   void* dl_open(const char* paths_nl, long long batch, long long seq1,
+//                 unsigned long long seed, int prefetch);
+//   long long dl_next(void* h, int* out, long long capacity); // -1 on error
+//   void dl_close(void* h);
+//   const char* dl_last_error();
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_dl_error;
+
+constexpr char kMagic[8] = {'K', 'G', 'T', 'D', 'S', 'H', '0', '1'};
+
+struct Shard {
+  const uint32_t* tokens = nullptr;  // past the header
+  uint64_t n_tokens = 0;
+  void* map = nullptr;
+  size_t map_len = 0;
+};
+
+struct SplitMix64 {
+  uint64_t x;
+  explicit SplitMix64(uint64_t seed) : x(seed) {}
+  uint64_t next() {
+    x += 0x9E3779B97F4A7C15ULL;
+    uint64_t z = x;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+};
+
+struct Loader {
+  std::vector<Shard> shards;
+  long long batch = 0;
+  long long seq1 = 0;
+  SplitMix64 rng{0};
+  int prefetch = 2;
+
+  std::thread producer;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_space;
+  std::deque<std::vector<int32_t>> ring;
+  std::atomic<bool> stop{false};
+  std::string error;
+
+  ~Loader() {
+    stop.store(true);
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    if (producer.joinable()) producer.join();
+    for (auto& s : shards)
+      if (s.map) munmap(s.map, s.map_len);
+  }
+
+  void fill_batch(std::vector<int32_t>* out) {
+    out->resize(static_cast<size_t>(batch) * seq1);
+    int32_t* dst = out->data();
+    for (long long b = 0; b < batch; b++) {
+      const uint64_t r1 = rng.next();
+      const Shard& s = shards[r1 % shards.size()];
+      const uint64_t r2 = rng.next();
+      const uint64_t span = s.n_tokens - static_cast<uint64_t>(seq1) + 1;
+      const uint64_t start = r2 % span;
+      // uint32 tokens -> int32 out (vocab ids are far below 2^31)
+      std::memcpy(dst, s.tokens + start,
+                  static_cast<size_t>(seq1) * sizeof(int32_t));
+      dst += seq1;
+    }
+  }
+
+  void run() {
+    while (!stop.load()) {
+      std::vector<int32_t> buf;
+      fill_batch(&buf);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_space.wait(lk, [&] {
+        return stop.load() || static_cast<int>(ring.size()) < prefetch;
+      });
+      if (stop.load()) return;
+      ring.push_back(std::move(buf));
+      cv_ready.notify_one();
+    }
+  }
+};
+
+bool open_shard(const std::string& path, Shard* out, std::string* err) {
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 16) {
+    close(fd);
+    *err = "short or unreadable shard " + path;
+    return false;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (map == MAP_FAILED) {
+    *err = "mmap failed for " + path;
+    return false;
+  }
+  const char* base = static_cast<const char*>(map);
+  if (std::memcmp(base, kMagic, 8) != 0) {
+    munmap(map, st.st_size);
+    *err = "bad magic in " + path;
+    return false;
+  }
+  uint64_t n_tokens;
+  std::memcpy(&n_tokens, base + 8, 8);
+  // divide, don't multiply: n_tokens*4 wraps for a corrupted header
+  // (n_tokens >= 2^62) and would accept a shard we then read past
+  if (n_tokens > (static_cast<uint64_t>(st.st_size) - 16) / 4) {
+    munmap(map, st.st_size);
+    *err = "truncated shard " + path;
+    return false;
+  }
+  out->map = map;
+  out->map_len = st.st_size;
+  out->tokens = reinterpret_cast<const uint32_t*>(base + 16);
+  out->n_tokens = n_tokens;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* dl_last_error() { return g_dl_error.c_str(); }
+
+void* dl_open(const char* paths_nl, long long batch, long long seq1,
+              unsigned long long seed, int prefetch) {
+  g_dl_error.clear();
+  if (!paths_nl || batch <= 0 || seq1 <= 0) {
+    g_dl_error = "bad arguments";
+    return nullptr;
+  }
+  auto loader = new Loader();
+  loader->batch = batch;
+  loader->seq1 = seq1;
+  loader->rng = SplitMix64(seed);
+  loader->prefetch = prefetch > 0 ? prefetch : 2;
+
+  std::string all(paths_nl), err;
+  size_t pos = 0;
+  while (pos <= all.size()) {
+    size_t nl = all.find('\n', pos);
+    std::string path = all.substr(
+        pos, nl == std::string::npos ? std::string::npos : nl - pos);
+    if (!path.empty()) {
+      Shard s;
+      if (!open_shard(path, &s, &err)) {
+        g_dl_error = err;
+        delete loader;
+        return nullptr;
+      }
+      if (s.n_tokens < static_cast<uint64_t>(seq1)) {
+        g_dl_error = "shard " + path + " shorter than sequence length";
+        munmap(s.map, s.map_len);
+        delete loader;
+        return nullptr;
+      }
+      loader->shards.push_back(s);
+    }
+    if (nl == std::string::npos) break;
+    pos = nl + 1;
+  }
+  if (loader->shards.empty()) {
+    g_dl_error = "no shards";
+    delete loader;
+    return nullptr;
+  }
+  loader->producer = std::thread([loader] { loader->run(); });
+  return loader;
+}
+
+long long dl_next(void* h, int32_t* out, long long capacity) {
+  g_dl_error.clear();
+  auto loader = static_cast<Loader*>(h);
+  if (!loader || !out) {
+    g_dl_error = "bad handle";
+    return -1;
+  }
+  const long long need = loader->batch * loader->seq1;
+  if (capacity < need) {
+    g_dl_error = "capacity too small";
+    return -1;
+  }
+  std::vector<int32_t> buf;
+  {
+    std::unique_lock<std::mutex> lk(loader->mu);
+    loader->cv_ready.wait(lk, [&] {
+      return loader->stop.load() || !loader->ring.empty();
+    });
+    if (loader->ring.empty()) {
+      g_dl_error = "loader stopped";
+      return -1;
+    }
+    buf = std::move(loader->ring.front());
+    loader->ring.pop_front();
+    loader->cv_space.notify_one();
+  }
+  std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+  return need;
+}
+
+void dl_close(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

@@ -231,6 +231,14 @@ def test_pvc_pv_crud_and_two_patch_bind(kube):
                             "claimRef": {"name": "someone-else"}}})
     with pytest.raises(Conflict):
         client.bind_volume("v9", "c2")
+    # a same-NAMED claim in another namespace is a foreign binding too
+    api.create_pv({"metadata": {"name": "v10"},
+                   "spec": {"capacity": {"storage": "1Gi"},
+                            "storageClassName": "",
+                            "claimRef": {"name": "c2",
+                                         "namespace": "other-ns"}}})
+    with pytest.raises(Conflict):
+        client.bind_volume("v10", "c2")
     client.delete_pvc("c2")
     client.delete_pv("v1")
     with pytest.raises(NotFound):

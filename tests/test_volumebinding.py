@@ -279,6 +279,29 @@ def test_prebound_pv_unreachable_node_keeps_pod_pending():
     assert not (api.get_pv("vol2")["spec"].get("claimRef"))
 
 
+def test_foreign_namespace_claimref_is_not_our_prebinding():
+    """PVs are cluster-scoped: a PV claimRef'd to a same-named claim in
+    ANOTHER namespace must be invisible to this claim — neither treated
+    as its exclusive prebound match nor proposed as available."""
+    from kubegpu_tpu.scheduler.predicates import check_volume_binding
+
+    pod = pod_with_claim("p1", "data")
+    pod["metadata"]["namespace"] = "ns-a"
+    node = flat_tpu_node("host0")
+    foreign = pv("volB")
+    foreign["spec"]["claimRef"] = {"name": "data", "namespace": "ns-b"}
+    free = pv("volFree")
+    ok, _, proposed = check_volume_binding(
+        pod, node, {"data": pvc("data")}, [foreign, free], set())
+    assert ok and proposed == {"data": "volFree"}
+    # same-namespace claimRef IS our prebinding and wins exclusively
+    ours = pv("volA")
+    ours["spec"]["claimRef"] = {"name": "data", "namespace": "ns-a"}
+    ok, _, proposed = check_volume_binding(
+        pod, node, {"data": pvc("data")}, [ours, foreign, free], set())
+    assert ok and proposed == {"data": "volA"}
+
+
 def test_prebound_pv_not_stolen_by_other_claim():
     """A PV pre-claimed for claim A must never be proposed to claim B."""
     api = InMemoryAPIServer()
