@@ -680,14 +680,18 @@ def persist_tpu_capture(out: dict) -> None:
         pass
 
 
-def load_tpu_capture() -> dict | None:
+def load_tpu_capture(allow_stale: bool = False) -> dict | None:
+    """The persisted TPU capture, or None. A capture whose fingerprint no
+    longer matches the workload code is STALE: it never substitutes for a
+    current number (default), but ``allow_stale=True`` returns it so the
+    caller can surface it as clearly-labeled historical context."""
     try:
         with open(CAPTURE_PATH) as f:
             out = json.load(f)
         if out.get("workload_backend") != "tpu":
             return None
         if out.get("workload_fingerprint") != _workload_fingerprint():
-            return None  # workload code changed since capture: stale
+            return out if allow_stale else None
         return out
     except Exception:
         return None
@@ -736,10 +740,26 @@ def workload_metrics() -> dict:
         return captured
     out, cpu_err = _run_workload(_cpu_env(), "cpu", CPU_RUN_TIMEOUT_S)
     if out is None:
-        return {"tpu_error": tpu_error or "no tpu configured",
-                "workload_error": cpu_err}
-    if tpu_error:
+        out = {"tpu_error": tpu_error or "no tpu configured",
+               "workload_error": cpu_err}
+    elif tpu_error:
         out["tpu_error"] = tpu_error
+    if tpu_error:
+        # the last real-TPU number from OLDER workload code, clearly
+        # labeled — context, never the headline (the fingerprint says the
+        # measured code has changed since)
+        stale = load_tpu_capture(allow_stale=True)
+        if stale is not None:
+            out["stale_tpu_capture"] = {
+                k: stale.get(k) for k in
+                ("captured_at", "workload_fingerprint", "mfu",
+                 "train_step_ms", "train_step_ms_flash",
+                 "train_step_ms_xla", "flash_max_abs_err",
+                 "workload_device_kind", "workload_sizing")
+                if k in stale}
+            out["stale_tpu_capture"]["note"] = \
+                "captured from older workload code; NOT comparable to " \
+                "current sources"
     return out
 
 

@@ -49,7 +49,9 @@ def main(argv=None) -> int:
     mesh_dims = (4, 2 * ((args.hosts + 1) // 2), 1)
     for i, origin in enumerate(origins):
         name = f"host{i}"
-        api.create_node({"metadata": {"name": name},
+        api.create_node({"metadata": {"name": name,
+                                      "labels": {"kubernetes.io/hostname":
+                                                 name}},
                          "status": {"allocatable": {"cpu": "64", "pods": 100}}})
         mgr = DevicesManager()
         mgr.add_device(TPUDeviceManager(FakeTPUBackend(
@@ -66,6 +68,26 @@ def main(argv=None) -> int:
     api.create_pod(make_pod("hbm-floored", 1, hbm=90 * 2**30))
     api.create_pod(make_pod("contig-4chip", 4,
                             pod_requests={RESOURCE_CONTIGUOUS: 1}))
+    # volume-bound pod: the PV's node affinity pins it to host1 (which
+    # the mixed pods leave a chip on), so placement is visibly steered
+    # and the claim flips to Bound at schedule time — without stealing a
+    # chip the gang needs
+    pinned_host = f"host{min(1, args.hosts - 1)}"
+    api.create_pvc({"metadata": {"name": "demo-claim"},
+                    "spec": {"resources": {"requests": {"storage": "10Gi"}},
+                             "storageClassName": ""}})
+    api.create_pv({"metadata": {"name": "demo-vol"},
+                   "spec": {"capacity": {"storage": "10Gi"},
+                            "storageClassName": "",
+                            "nodeAffinity": {"required": {
+                                "nodeSelectorTerms": [{"matchExpressions": [
+                                    {"key": "kubernetes.io/hostname",
+                                     "operator": "In",
+                                     "values": [pinned_host]}]}]}}}})
+    vol_pod = make_pod("vol-1chip", 1)
+    vol_pod["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": "demo-claim"}}]
+    api.create_pod(vol_pod)
     gang_n = min(2, args.hosts)
     for i in range(gang_n):
         api.create_pod(make_pod(f"gang-{i}", 4,
@@ -81,17 +103,22 @@ def main(argv=None) -> int:
         if node:
             cfg = hooks[node].create_container(name, "main", {})
             env = {e["key"]: e["value"] for e in cfg.get("envs", [])}
-        rows.append({"pod": name, "node": node or "<pending>",
-                     "chips": env.get("TPU_CHIP_IDS", ""),
-                     "bounds": env.get("TPU_PROCESS_BOUNDS", "")})
+        row = {"pod": name, "node": node or "<pending>",
+               "chips": env.get("TPU_CHIP_IDS", ""),
+               "bounds": env.get("TPU_PROCESS_BOUNDS", "")}
+        if name == "vol-1chip":
+            row["volume"] = api.get_pvc("demo-claim")["spec"] \
+                .get("volumeName", "<unbound>")
+        rows.append(row)
 
     if args.json:
         print(json.dumps(rows, indent=2))
     else:
         width = max(len(r["pod"]) for r in rows) + 2
-        print(f"{'POD':<{width}}{'NODE':<10}{'CHIPS':<28}BOUNDS")
+        print(f"{'POD':<{width}}{'NODE':<10}{'CHIPS':<28}{'BOUNDS':<8}VOLUME")
         for r in rows:
-            print(f"{r['pod']:<{width}}{r['node']:<10}{r['chips']:<28}{r['bounds']}")
+            print(f"{r['pod']:<{width}}{r['node']:<10}{r['chips']:<28}"
+                  f"{r['bounds']:<8}{r.get('volume', '')}")
     sched.stop()
     return 0
 

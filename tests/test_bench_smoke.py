@@ -55,3 +55,25 @@ def test_stderr_summary_surfaces_oom_not_traceback_header():
     out = bench._stderr_summary(stderr, 1)
     assert "RESOURCE_EXHAUSTED" in out
     assert not out.startswith("Traceback")
+
+
+def test_stale_capture_is_rejected_but_retrievable(tmp_path, monkeypatch):
+    """A capture from older workload code must never masquerade as a
+    current number (load returns None), yet stays retrievable for
+    clearly-labeled context (allow_stale=True)."""
+    import bench
+
+    path = tmp_path / "TPU_CAPTURE.json"
+    path.write_text(json.dumps({
+        "workload_backend": "tpu", "mfu": 0.5,
+        "workload_fingerprint": "not-the-current-code",
+        "captured_at": "2026-01-01T00:00:00+00:00"}))
+    monkeypatch.setattr(bench, "CAPTURE_PATH", str(path))
+    assert bench.load_tpu_capture() is None
+    stale = bench.load_tpu_capture(allow_stale=True)
+    assert stale is not None and stale["mfu"] == 0.5
+    # a fingerprint-current capture loads normally
+    path.write_text(json.dumps({
+        "workload_backend": "tpu", "mfu": 0.5,
+        "workload_fingerprint": bench._workload_fingerprint()}))
+    assert bench.load_tpu_capture() is not None
