@@ -34,9 +34,15 @@ def main(argv=None) -> int:
     ap.add_argument("--remat", default="none",
                     choices=["none", "dots", "full"])
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="save train state here and resume from the "
+                         "latest step on start (elastic restart)")
+    ap.add_argument("--checkpoint-every", type=int, default=2)
     args = ap.parse_args(argv)
     if args.steps < 1:
         ap.error("--steps must be >= 1")
+    if args.checkpoint_every < 1:
+        ap.error("--checkpoint-every must be >= 1")
 
     import jax
 
@@ -72,16 +78,40 @@ def main(argv=None) -> int:
     params, opt_state, optimizer = init_sharded(
         jax.random.PRNGKey(args.seed), cfg, mesh)
     step = make_train_step(cfg, mesh, optimizer)
+
+    # elastic restart: a killed pod's replacement resumes from the last
+    # saved step — the workload-side analogue of the scheduler rebuilding
+    # from annotations (docs/design.md failure model)
+    start_step = 0
+    if args.checkpoint_dir:
+        from kubegpu_tpu.workload.checkpoint import (restore_checkpoint,
+                                                     save_checkpoint)
+
+        state, at = restore_checkpoint(
+            args.checkpoint_dir, {"params": params, "opt_state": opt_state})
+        if state is not None:
+            params, opt_state = state["params"], state["opt_state"]
+            start_step = at
+
     loader = make_loader(paths, args.batch, args.seq, seed=args.seed)
     loader_kind = type(loader).__name__
 
     losses = []
     t0 = time.perf_counter()
     try:
-        for i in range(args.steps):
+        # the loader stream is deterministic from (seed): fast-forward
+        # past the batches the checkpointed steps already consumed, so a
+        # resumed run CONTINUES the stream instead of re-training on them
+        for _ in range(start_step):
+            next(loader)
+        for i in range(start_step, start_step + args.steps):
             tokens = jax.numpy.asarray(next(loader))
             params, opt_state, loss = step(params, opt_state, tokens)
             losses.append(float(jax.device_get(loss)))
+            if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
+                save_checkpoint(args.checkpoint_dir,
+                                {"params": params, "opt_state": opt_state},
+                                step=i + 1)
     finally:
         loader.close()
     wall = time.perf_counter() - t0
@@ -89,6 +119,7 @@ def main(argv=None) -> int:
     print(json.dumps({
         "loader": loader_kind,
         "devices": len(mesh.devices.flatten()),
+        "resumed_from_step": start_step,
         "steps": args.steps,
         "first_loss": round(losses[0], 4),
         "last_loss": round(losses[-1], 4),

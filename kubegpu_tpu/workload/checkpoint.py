@@ -39,39 +39,54 @@ def save_checkpoint(path: str, state, step: int) -> str:
 
 
 def _save_numpy(path: str, state, step: int) -> str:
+    """Atomic: write into a temp dir, then rename — a pod SIGKILLed
+    mid-save must never leave a half-written ``step_N`` that the
+    replacement pod picks as latest and dies on (crash loop)."""
     full = os.path.join(path, f"step_{step}")
-    os.makedirs(full, exist_ok=True)
+    tmp = f"{full}.tmp-{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
     leaves, treedef = _flatten(state)
-    np.savez(os.path.join(full, "leaves.npz"),
+    np.savez(os.path.join(tmp, "leaves.npz"),
              **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
-    with open(os.path.join(full, "meta.json"), "w") as f:
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump({"step": step, "n_leaves": len(leaves)}, f)
+    if os.path.isdir(full):
+        import shutil
+
+        shutil.rmtree(full)
+    os.rename(tmp, full)
     return full
 
 
 def restore_checkpoint(path: str, like):
-    """Restore the latest ``step_*`` under ``path`` into the structure of
-    ``like``; returns (state, step) or (None, -1) when absent."""
+    """Restore the NEWEST readable ``step_*`` under ``path`` into the
+    structure of ``like``; returns (state, step) or (None, -1) when
+    absent. A corrupt/partial newest step (crashed writer, torn copy)
+    falls back to the next-older one instead of crash-looping the
+    replacement pod."""
     if not os.path.isdir(path):
         return None, -1
     steps = sorted(
         (int(d.split("_", 1)[1]), d) for d in os.listdir(path)
         if d.startswith("step_") and d.split("_", 1)[1].isdigit())
-    if not steps:
-        return None, -1
-    step, dirname = steps[-1]
-    full = os.path.join(path, dirname)
+    for step, dirname in reversed(steps):
+        full = os.path.join(path, dirname)
+        try:
+            npz = os.path.join(full, "leaves.npz")
+            if os.path.exists(npz):
+                data = np.load(npz)
+                leaves, treedef = _flatten(like)
+                restored = [jnp.asarray(data[f"leaf_{i}"])
+                            for i in range(len(leaves))]
+                return jax.tree.unflatten(treedef, restored), step
 
-    npz = os.path.join(full, "leaves.npz")
-    if os.path.exists(npz):
-        data = np.load(npz)
-        leaves, treedef = _flatten(like)
-        restored = [jnp.asarray(data[f"leaf_{i}"]) for i in range(len(leaves))]
-        return jax.tree.unflatten(treedef, restored), step
+            import orbax.checkpoint as ocp
 
-    import orbax.checkpoint as ocp
-
-    ckpt = ocp.StandardCheckpointer()
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype), like)
-    return ckpt.restore(full, abstract), step
+            ckpt = ocp.StandardCheckpointer()
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.asarray(x).dtype), like)
+            return ckpt.restore(full, abstract), step
+        except Exception:
+            continue  # unreadable step: try the next-older one
+    return None, -1
