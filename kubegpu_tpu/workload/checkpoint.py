@@ -11,6 +11,7 @@ have.
 from __future__ import annotations
 
 import json
+import logging
 import os
 
 import jax
@@ -83,10 +84,25 @@ def restore_checkpoint(path: str, like):
             import orbax.checkpoint as ocp
 
             ckpt = ocp.StandardCheckpointer()
-            abstract = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
-                                               jnp.asarray(x).dtype), like)
-            return ckpt.restore(full, abstract), step
+
+            def abstract(x):
+                # carry the live shardings so orbax restores each leaf
+                # straight onto the mesh layout `like` uses (without
+                # this it falls back to the saved-topology layout, which
+                # is wrong on a different mesh); sharding=None is the
+                # constructor's accepted default
+                return jax.ShapeDtypeStruct(
+                    jnp.shape(x), jnp.asarray(x).dtype,
+                    sharding=getattr(x, "sharding", None))
+
+            return ckpt.restore(full, jax.tree.map(abstract, like)), step
         except Exception:
-            continue  # unreadable step: try the next-older one
+            # unreadable step: fall back to the next-older one — but
+            # loudly, or a systematic failure (e.g. a mesh mismatch that
+            # fails EVERY step) would masquerade as "no checkpoint" and
+            # silently retrain from step 0
+            logging.getLogger(__name__).warning(
+                "checkpoint %s unreadable, trying older", full,
+                exc_info=True)
+            continue
     return None, -1

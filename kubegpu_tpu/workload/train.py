@@ -34,6 +34,16 @@ def init_sharded(rng, cfg: TransformerConfig, mesh, optimizer=None):
     init = jax.jit(partial(init_params, cfg=cfg), out_shardings=shardings)
     params = init(rng)
     opt_state = jax.jit(optimizer.init)(params)
+    # moment leaves inherit the params' NamedShardings, but scalar state
+    # (Adam's count) falls out of jit committed to device 0 — replicate
+    # it over the mesh so EVERY leaf's committed sharding is
+    # mesh-consistent (shard-aware checkpoint restore and the donated
+    # train step both rely on a single device set)
+    rep = NamedSharding(mesh, PartitionSpec())
+    opt_state = jax.tree.map(
+        lambda x: x if isinstance(getattr(x, "sharding", None),
+                                  NamedSharding)
+        else jax.device_put(x, rep), opt_state)
     return params, opt_state, optimizer
 
 
