@@ -77,8 +77,22 @@ def restore_checkpoint(path: str, like):
             if os.path.exists(npz):
                 data = np.load(npz)
                 leaves, treedef = _flatten(like)
-                restored = [jnp.asarray(data[f"leaf_{i}"])
-                            for i in range(len(leaves))]
+                if len(data.files) != len(leaves):
+                    raise ValueError(
+                        f"{full}: {len(data.files)} saved leaves vs "
+                        f"{len(leaves)} expected — different model config")
+                restored = []
+                for i, want in enumerate(leaves):
+                    got = data[f"leaf_{i}"]
+                    if np.shape(got) != jnp.shape(want):
+                        # e.g. a pre-GQA checkpoint against a GQA config:
+                        # fail HERE with the leaf named, not deep inside
+                        # a jitted train step
+                        raise ValueError(
+                            f"{full}: leaf {i} shape {np.shape(got)} != "
+                            f"expected {jnp.shape(want)} — checkpoint "
+                            "from a different model config")
+                    restored.append(jnp.asarray(got))
                 return jax.tree.unflatten(treedef, restored), step
 
             import orbax.checkpoint as ocp

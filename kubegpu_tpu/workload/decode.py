@@ -32,19 +32,28 @@ NEG_INF = -1e30
 
 def init_cache(cfg: TransformerConfig, batch: int, max_seq: int):
     """Zeroed per-layer KV cache: list of {"k","v"} of
-    ``[B, max_seq, H, D]`` in the compute dtype."""
+    ``[B, max_seq, KV_H, D]`` in the compute dtype. With GQA
+    (``cfg.n_kv_heads``) the cache is n_heads/kv_heads smaller — the
+    decode-bandwidth saving the variant exists for."""
     dt = cfg.compute_dtype()
-    shape = (batch, max_seq, cfg.n_heads, cfg.head_dim)
+    shape = (batch, max_seq, cfg.kv_heads, cfg.head_dim)
     return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
             for _ in range(cfg.n_layers)]
 
 
-def cache_pspecs(cfg: TransformerConfig):
-    """PartitionSpec pytree matching `init_cache`: batch on data, heads on
-    model (mirrors the qkv weight sharding)."""
+def cache_pspecs(cfg: TransformerConfig, mesh=None):
+    """PartitionSpec pytree matching `init_cache`: batch on data, KV
+    heads on model when they divide the model-axis size (mirrors the kv
+    weight sharding); a narrow GQA/MQA cache whose kv_heads the mesh
+    cannot split is replicated on that axis instead of crashing."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(spmd.AXIS_DATA, None, spmd.AXIS_MODEL, None)
+    head_axis = spmd.AXIS_MODEL
+    if mesh is not None:
+        tp = mesh.shape.get(spmd.AXIS_MODEL, 1)
+        if tp > 1 and cfg.kv_heads % tp:
+            head_axis = None
+    spec = P(spmd.AXIS_DATA, None, head_axis, None)
     return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
 
 
@@ -80,9 +89,9 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
             h = _rmsnorm(x, layer["ln1"])
             q = (h @ layer["wq"].astype(dt)).reshape(b, t, cfg.n_heads,
                                                      cfg.head_dim)
-            k = (h @ layer["wk"].astype(dt)).reshape(b, t, cfg.n_heads,
+            k = (h @ layer["wk"].astype(dt)).reshape(b, t, cfg.kv_heads,
                                                      cfg.head_dim)
-            v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.n_heads,
+            v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.kv_heads,
                                                      cfg.head_dim)
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
@@ -93,13 +102,28 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
             new_cache.append({"k": ck, "v": cv})
 
             # bf16 operands, f32 accumulation — MXU-native (see
-            # model._causal_attention)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
-                           preferred_element_type=jnp.float32) * scale
-            s = jnp.where(mask[None, None], s, NEG_INF)
-            p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), cv,
-                              preferred_element_type=jnp.float32)
+            # model._causal_attention). With GQA the query heads are
+            # GROUPED against the narrow cache (g = kv head, r = query
+            # head within the group) so the full-width K/V transient is
+            # never materialized — reading the cache narrow is the
+            # bandwidth saving the smaller cache exists for.
+            if cfg.kv_heads != cfg.n_heads:
+                rep = cfg.n_heads // cfg.kv_heads
+                qg = q.reshape(b, t, cfg.kv_heads, rep, cfg.head_dim)
+                s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck,
+                               preferred_element_type=jnp.float32) * scale
+                s = jnp.where(mask[None, None, None], s, NEG_INF)
+                p = jax.nn.softmax(s, axis=-1)
+                attn = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(dt), cv,
+                                  preferred_element_type=jnp.float32)
+                attn = attn.reshape(b, t, cfg.n_heads, cfg.head_dim)
+            else:
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                               preferred_element_type=jnp.float32) * scale
+                s = jnp.where(mask[None, None], s, NEG_INF)
+                p = jax.nn.softmax(s, axis=-1)
+                attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), cv,
+                                  preferred_element_type=jnp.float32)
             x = x + attn.astype(dt).reshape(b, t, -1) @ layer["wo"].astype(dt)
             x = constrain(x, spmd.AXIS_DATA, None, None)
 

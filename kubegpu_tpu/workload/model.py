@@ -61,9 +61,24 @@ class TransformerConfig:
     #            in backward (~+1 fwd of FLOPs, minimal HBM).
     remat: str = "none"
 
+    # Grouped-query attention: 0 = MHA (kv heads == query heads); a
+    # divisor of n_heads shares each K/V head across n_heads/n_kv_heads
+    # query heads — smaller KV projections and an n_heads/n_kv_heads
+    # smaller decode cache (decode is HBM-bandwidth-bound on TPU, so the
+    # cache size is the knob that matters).
+    n_kv_heads: int = 0
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(
+                f"n_kv_heads {kv} must divide n_heads {self.n_heads}")
+        return kv
 
     def compute_dtype(self):
         return jnp.dtype(self.dtype)
@@ -73,6 +88,7 @@ def init_params(rng, cfg: TransformerConfig) -> dict:
     """Parameter pytree; structure mirrors `spmd.param_pspecs` exactly."""
     k_embed, k_unembed, k_layers = jax.random.split(rng, 3)
     d, h, f = cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff
+    kv = cfg.kv_heads * cfg.head_dim  # GQA: K/V project to fewer heads
 
     def dense(key, shape):
         scale = (shape[0]) ** -0.5
@@ -81,12 +97,12 @@ def init_params(rng, cfg: TransformerConfig) -> dict:
     layers = []
     for i in range(cfg.n_layers):
         k = jax.random.fold_in(k_layers, i)
-        kq, kk, kv, ko, ku, kg, kd = jax.random.split(k, 7)
+        kq, kk, kv_key, ko, ku, kg, kd = jax.random.split(k, 7)
         layer = {
             "ln1": jnp.ones((d,), jnp.float32),
             "wq": dense(kq, (d, h)),
-            "wk": dense(kk, (d, h)),
-            "wv": dense(kv, (d, h)),
+            "wk": dense(kk, (d, kv)),
+            "wv": dense(kv_key, (d, kv)),
             "wo": dense(ko, (h, d)),
             "ln2": jnp.ones((d,), jnp.float32),
         }
@@ -142,6 +158,18 @@ def _causal_attention(q, k, v, scale: float):
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
+
+
+def _expand_kv(cfg: TransformerConfig, k, v):
+    """GQA: broadcast each K/V head across its n_heads/kv_heads query
+    group so every attention implementation (xla einsum, flash kernel,
+    ring, Ulysses) sees plain MHA tensors. The PARAMS and the decode
+    cache stay at kv_heads — the savings GQA exists for — only this
+    transient is full-width."""
+    if cfg.kv_heads == cfg.n_heads:
+        return k, v
+    rep = cfg.n_heads // cfg.kv_heads
+    return (jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2))
 
 
 def _resolve_attn_impl(cfg: TransformerConfig, seq_len: int) -> str:
@@ -211,10 +239,11 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
             b, t = x.shape[:2]
             h = _rmsnorm(x, layer["ln1"])
             q = (h @ layer["wq"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-            k = (h @ layer["wk"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
-            v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+            k = (h @ layer["wk"].astype(dt)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
+            v = (h @ layer["wv"].astype(dt)).reshape(b, t, cfg.kv_heads, cfg.head_dim)
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
+            k, v = _expand_kv(cfg, k, v)
             attn = checkpoint_name(attend(q, k, v), "attn_out")
             x = x + attn.reshape(b, t, -1) @ layer["wo"].astype(dt)
             x = constrain(x, spmd.AXIS_DATA, spmd.AXIS_SEQ, None)
