@@ -81,8 +81,12 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
         x = constrain(x, spmd.AXIS_DATA, None, None)
         positions = start_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
         # chunk position i attends cache positions <= start_pos + i
+        # (and, with a sliding window, only the newest window of them)
         kv_pos = jnp.arange(s_max)
-        mask = kv_pos[None, :] <= (start_pos + jnp.arange(t))[:, None]
+        q_pos = (start_pos + jnp.arange(t))[:, None]
+        mask = kv_pos[None, :] <= q_pos
+        if cfg.attn_window:
+            mask &= kv_pos[None, :] > q_pos - cfg.attn_window
 
         new_cache = []
         for layer, kv in zip(params["layers"], cache):

@@ -50,6 +50,10 @@ class _Cfg:
     block_q: int
     block_k: int
     interpret: bool
+    # sliding window: row q attends keys in (q-window, q]; 0 = unlimited.
+    # Fully-out-of-window k-blocks are skipped like fully-masked causal
+    # ones, so long sequences pay O(T*window), not O(T^2).
+    window: int = 0
 
 
 def _pick_block(t: int, cap: int = 128) -> int:
@@ -66,12 +70,40 @@ def _pos(off_ref, which: int, block_i: int, block: int, shape, axis: int):
 
 
 def _block_visible(cfg: _Cfg, off_ref, qi, ki):
-    """False iff the causal mask hides the whole (qi, ki) tile."""
-    if not cfg.causal:
+    """False iff the causal/window mask hides the whole (qi, ki) tile."""
+    if not cfg.causal and not cfg.window:
         return True
-    q_max = off_ref[0, 0] + (qi + 1) * cfg.block_q - 1
+    q_min = off_ref[0, 0] + qi * cfg.block_q
+    q_max = q_min + cfg.block_q - 1
     kv_min = off_ref[0, 1] + ki * cfg.block_k
-    return q_max >= kv_min
+    kv_max = kv_min + cfg.block_k - 1
+    vis = True
+    if cfg.causal or cfg.window:
+        # a window's upper bound IS the causal bound: keys newer than q
+        # are outside (q - window, q] by definition
+        vis = q_max >= kv_min
+    if cfg.window:
+        # the tile's newest key must still be inside the OLDEST query
+        # row's window (q - window, q]
+        vis = jnp.logical_and(vis, kv_max > q_min - cfg.window)
+    return vis
+
+
+def _tile_mask(cfg: _Cfg, off_ref, qi, ki):
+    """The (block_q, block_k) visibility mask at global positions, or
+    None when nothing is masked."""
+    if not cfg.causal and not cfg.window:
+        return None
+    shp = (cfg.block_q, cfg.block_k)
+    qpos = _pos(off_ref, 0, qi, cfg.block_q, shp, 0)
+    kpos = _pos(off_ref, 1, ki, cfg.block_k, shp, 1)
+    # window implies the causal upper bound — (q - window, q] excludes
+    # future keys by definition, with or without the causal flag
+    mask = qpos >= kpos if (cfg.causal or cfg.window) else \
+        jnp.ones(shp, jnp.bool_)
+    if cfg.window:
+        mask = jnp.logical_and(mask, kpos > qpos - cfg.window)
+    return mask
 
 
 def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -94,10 +126,8 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * cfg.scale
-        if cfg.causal:
-            shp = (cfg.block_q, cfg.block_k)
-            mask = (_pos(off_ref, 0, qi, cfg.block_q, shp, 0)
-                    >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
+        mask = _tile_mask(cfg, off_ref, qi, ki)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[...]
@@ -177,10 +207,8 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * cfg.scale
-        if cfg.causal:
-            shp = (cfg.block_q, cfg.block_k)
-            mask = (_pos(off_ref, 0, qi, cfg.block_q, shp, 0)
-                    >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
+        mask = _tile_mask(cfg, off_ref, qi, ki)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])
         do = do_ref[0, 0]
@@ -211,10 +239,8 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * cfg.scale
-        if cfg.causal:
-            shp = (cfg.block_q, cfg.block_k)
-            mask = (_pos(off_ref, 0, qi, cfg.block_q, shp, 0)
-                    >= _pos(off_ref, 1, ki, cfg.block_k, shp, 1))
+        mask = _tile_mask(cfg, off_ref, qi, ki)
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0, 0][:, :1])
         do = do_ref[0, 0]
@@ -341,21 +367,25 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention_with_lse(q, k, v, scale, *, q_offset=0, kv_offset=0,
                              causal=True, block_q=None, block_k=None,
-                             interpret=False):
+                             interpret=False, window=0):
     """Flash attention returning ``(out, lse)``.
 
     q: [B, Tq, H, D]; k, v: [B, Tk, H, D]. ``lse`` is [B, H, Tq] — the
     log-sum-exp of each row's visible scores, which makes partial results
     from disjoint K/V shards mergeable (`merge_partials`), the hook ring
     attention uses. Offsets may be traced ints (global positions =
-    offset + local index).
+    offset + local index). ``window`` > 0 restricts each row to the
+    newest ``window`` keys (sliding-window attention); fully-out-of-
+    window tiles are skipped, so cost is O(Tq * window).
     """
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     cfg = _Cfg(scale=float(scale), causal=bool(causal),
                block_q=block_q or _pick_block(tq),
                block_k=block_k or _pick_block(tk),
-               interpret=bool(interpret))
+               interpret=bool(interpret), window=int(window))
     if tq % cfg.block_q or tk % cfg.block_k:
         raise ValueError(f"seq lens ({tq}, {tk}) not divisible by blocks "
                          f"({cfg.block_q}, {cfg.block_k})")

@@ -61,12 +61,23 @@ class TransformerConfig:
     #            in backward (~+1 fwd of FLOPs, minimal HBM).
     remat: str = "none"
 
+    # Sliding-window attention: each position attends only the newest
+    # ``attn_window`` positions (0 = full causal). Single-shard paths
+    # (xla + flash kernel, which skips out-of-window tiles) — long-range
+    # information still flows across layers, Mistral-style. Not
+    # implemented for the cross-shard seq strategies (ring/Ulysses).
+    attn_window: int = 0
     # Grouped-query attention: 0 = MHA (kv heads == query heads); a
     # divisor of n_heads shares each K/V head across n_heads/n_kv_heads
     # query heads — smaller KV projections and an n_heads/n_kv_heads
     # smaller decode cache (decode is HBM-bandwidth-bound on TPU, so the
     # cache size is the knob that matters).
     n_kv_heads: int = 0
+
+    def __post_init__(self):
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window}")
 
     @property
     def head_dim(self) -> int:
@@ -142,8 +153,9 @@ def _rope(x, positions, theta: float):
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _causal_attention(q, k, v, scale: float):
-    """Single-shard fused causal attention ([B,T,H,D] layout).
+def _causal_attention(q, k, v, scale: float, window: int = 0):
+    """Single-shard fused causal attention ([B,T,H,D] layout);
+    ``window`` > 0 = sliding-window (newest ``window`` keys only).
 
     Operands stay in the compute dtype (bf16) with f32 ACCUMULATION
     (``preferred_element_type``) — the MXU's native mode. Casting inputs
@@ -153,6 +165,9 @@ def _causal_attention(q, k, v, scale: float):
                    preferred_element_type=jnp.float32) * scale
     t = q.shape[1]
     mask = jnp.tril(jnp.ones((t, t), bool))
+    if window:
+        pos = jnp.arange(t)
+        mask &= pos[None, :] > pos[:, None] - window
     s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
@@ -203,6 +218,10 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
         local_t = t if cfg.seq_impl == "ulysses" else t // seq_shards
         impl = _resolve_attn_impl(cfg, local_t)
         interpret = impl == "flash" and jax.default_backend() == "cpu"
+        if cfg.attn_window and use_ring:
+            raise NotImplementedError(
+                "attn_window is single-shard only; use a seq axis of 1 "
+                "(window already bounds the attention span)")
         if use_ring and cfg.seq_impl == "ulysses":
             from kubegpu_tpu.workload.ulysses import (
                 make_sharded_ulysses_attention)
@@ -218,8 +237,10 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
             from kubegpu_tpu.workload.kernels.flash import flash_attention
 
             return lambda q, k, v: flash_attention(
-                q, k, v, scale, interpret=interpret)
-        return lambda q, k, v: _causal_attention(q, k, v, scale)
+                q, k, v, scale, interpret=interpret,
+                window=cfg.attn_window)
+        return lambda q, k, v: _causal_attention(q, k, v, scale,
+                                                 window=cfg.attn_window)
 
     def constrain(x, *spec):
         if mesh is None:
