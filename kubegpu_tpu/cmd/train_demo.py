@@ -21,16 +21,25 @@ import time
 
 
 def main(argv=None) -> int:
+    from kubegpu_tpu.workload.presets import preset_names
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", nargs="*", default=None,
                     help="token shard paths (default: generate synthetic)")
+    ap.add_argument("--preset", default=None, choices=preset_names(),
+                    help="model family (workload/presets.py); size flags "
+                         "below override its dimensions")
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--batch", type=int, default=4)
-    ap.add_argument("--seq", type=int, default=128)
-    ap.add_argument("--vocab", type=int, default=512)
-    ap.add_argument("--d-model", type=int, default=128)
-    ap.add_argument("--n-layers", type=int, default=2)
-    ap.add_argument("--n-heads", type=int, default=4)
+    # size flags default to None so only EXPLICIT values override a
+    # preset's dimensions (without --preset they fall back to the
+    # defaults noted in each help string)
+    ap.add_argument("--seq", type=int, default=None, help="default 128")
+    ap.add_argument("--vocab", type=int, default=None, help="default 512")
+    ap.add_argument("--d-model", type=int, default=None,
+                    help="default 128 (d_ff follows at 4x)")
+    ap.add_argument("--n-layers", type=int, default=None, help="default 2")
+    ap.add_argument("--n-heads", type=int, default=None, help="default 4")
     ap.add_argument("--remat", default="none",
                     choices=["none", "dots", "full"])
     ap.add_argument("--seed", type=int, default=0)
@@ -60,6 +69,29 @@ def main(argv=None) -> int:
     from kubegpu_tpu.workload.model import TransformerConfig
     from kubegpu_tpu.workload.train import init_sharded, make_train_step
 
+    explicit = {"remat": args.remat}
+    if args.vocab is not None:
+        explicit["vocab"] = args.vocab
+    if args.d_model is not None:
+        explicit["d_model"] = args.d_model
+        explicit["d_ff"] = 4 * args.d_model
+    if args.n_layers is not None:
+        explicit["n_layers"] = args.n_layers
+    if args.n_heads is not None:
+        explicit["n_heads"] = args.n_heads
+    if args.seq is not None:
+        explicit["max_seq"] = args.seq
+    if args.preset:
+        from kubegpu_tpu.workload.presets import make_config
+
+        cfg = make_config(args.preset, **explicit)
+    else:
+        cfg = TransformerConfig(**{
+            **dict(vocab=512, d_model=128, n_heads=4, n_layers=2,
+                   d_ff=512, max_seq=128),
+            **explicit})
+    seq_len = args.seq if args.seq is not None else cfg.max_seq
+
     paths = args.data
     tmp = None
     if not paths:
@@ -67,13 +99,9 @@ def main(argv=None) -> int:
         rng = np.random.default_rng(args.seed)
         paths = [write_token_shard(
             os.path.join(tmp, f"shard{i}.kgtd"),
-            rng.integers(0, args.vocab, size=50_000, dtype=np.uint32))
+            rng.integers(0, cfg.vocab, size=50_000, dtype=np.uint32))
             for i in range(2)]
 
-    cfg = TransformerConfig(
-        vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
-        n_layers=args.n_layers, d_ff=4 * args.d_model,
-        max_seq=args.seq, remat=args.remat)
     mesh = spmd.mesh_from_env()
     params, opt_state, optimizer = init_sharded(
         jax.random.PRNGKey(args.seed), cfg, mesh)
@@ -93,7 +121,7 @@ def main(argv=None) -> int:
             params, opt_state = state["params"], state["opt_state"]
             start_step = at
 
-    loader = make_loader(paths, args.batch, args.seq, seed=args.seed)
+    loader = make_loader(paths, args.batch, seq_len, seed=args.seed)
     loader_kind = type(loader).__name__
 
     losses = []
@@ -123,7 +151,7 @@ def main(argv=None) -> int:
         "steps": args.steps,
         "first_loss": round(losses[0], 4),
         "last_loss": round(losses[-1], 4),
-        "tokens_per_s": round(args.steps * args.batch * args.seq / wall, 1),
+        "tokens_per_s": round(args.steps * args.batch * seq_len / wall, 1),
     }))
     return 0 if all(np.isfinite(losses)) else 1
 

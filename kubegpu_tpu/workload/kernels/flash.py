@@ -77,11 +77,10 @@ def _block_visible(cfg: _Cfg, off_ref, qi, ki):
     q_max = q_min + cfg.block_q - 1
     kv_min = off_ref[0, 1] + ki * cfg.block_k
     kv_max = kv_min + cfg.block_k - 1
-    vis = True
-    if cfg.causal or cfg.window:
-        # a window's upper bound IS the causal bound: keys newer than q
-        # are outside (q - window, q] by definition
-        vis = q_max >= kv_min
+    # past the early return at least one bound applies, and a window's
+    # upper bound IS the causal bound (keys newer than q are outside
+    # (q - window, q] by definition)
+    vis = q_max >= kv_min
     if cfg.window:
         # the tile's newest key must still be inside the OLDEST query
         # row's window (q - window, q]
@@ -97,10 +96,10 @@ def _tile_mask(cfg: _Cfg, off_ref, qi, ki):
     shp = (cfg.block_q, cfg.block_k)
     qpos = _pos(off_ref, 0, qi, cfg.block_q, shp, 0)
     kpos = _pos(off_ref, 1, ki, cfg.block_k, shp, 1)
-    # window implies the causal upper bound — (q - window, q] excludes
-    # future keys by definition, with or without the causal flag
-    mask = qpos >= kpos if (cfg.causal or cfg.window) else \
-        jnp.ones(shp, jnp.bool_)
+    # past the early return at least one bound applies, and window
+    # implies the causal upper bound — (q - window, q] excludes future
+    # keys by definition, with or without the causal flag
+    mask = qpos >= kpos
     if cfg.window:
         mask = jnp.logical_and(mask, kpos > qpos - cfg.window)
     return mask

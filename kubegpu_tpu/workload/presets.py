@@ -1,0 +1,47 @@
+"""Named model-family presets.
+
+One place that spells out the families the workload layer supports, at
+demo-able sizes — each is a `TransformerConfig` the train step, decode
+path, dryrun mesh, and `cmd/train_demo.py --preset` all accept:
+
+- ``dense``       — the flagship decoder-only transformer (MHA, SwiGLU);
+- ``gqa``         — grouped-query attention (narrow KV cache/projections);
+- ``windowed``    — sliding-window attention (Mistral-style long context:
+                    O(T*window) attention, range grows with depth);
+- ``moe``         — mixture-of-experts FFN, top-1 routed, experts sharded
+                    over the model axis (expert parallelism);
+- ``long-ring``   — ring-attention configuration for sequence-parallel
+                    meshes (seq axis > 1), full causal span;
+- ``long-ulysses``— Ulysses all-to-all sequence parallelism.
+
+The reference has no training runtime at all (SURVEY.md §0); these are
+the TPU build's workload families, every one exercised by tests.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.workload.model import TransformerConfig
+
+_BASE = dict(vocab=512, d_model=128, n_heads=8, n_layers=2, d_ff=384,
+             max_seq=512)
+
+PRESETS = {
+    "dense": dict(_BASE),
+    "gqa": dict(_BASE, n_kv_heads=2),
+    "windowed": dict(_BASE, attn_window=64),
+    "moe": dict(_BASE, n_experts=4),
+    "long-ring": dict(_BASE, seq_impl="ring"),
+    "long-ulysses": dict(_BASE, seq_impl="ulysses"),
+}
+
+
+def preset_names() -> list:
+    return sorted(PRESETS)
+
+
+def make_config(name: str, **overrides) -> TransformerConfig:
+    """Build a preset's config; keyword overrides win (e.g. d_model)."""
+    if name not in PRESETS:
+        raise KeyError(
+            f"unknown preset {name!r}; known: {', '.join(preset_names())}")
+    return TransformerConfig(**{**PRESETS[name], **overrides})
