@@ -135,7 +135,8 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
             if "moe" in layer:
                 from kubegpu_tpu.workload.moe import moe_ffn
 
-                ffn_out, _ = moe_ffn(layer["moe"], h, dt)
+                ffn_out, _ = moe_ffn(layer["moe"], h, dt,
+                                     top_k=cfg.moe_top_k)
                 x = x + ffn_out
             else:
                 up = h @ layer["w_up"].astype(dt)

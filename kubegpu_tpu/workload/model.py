@@ -47,9 +47,11 @@ class TransformerConfig:
     # "ring" = K/V ppermute ring (`ring.py`), "ulysses" = all-to-all
     # head/sequence reshard (`ulysses.py`). Both are exact.
     seq_impl: str = "ring"
-    # Mixture-of-experts FFN: 0 = dense; >0 replaces the FFN with top-1
+    # Mixture-of-experts FFN: 0 = dense; >0 replaces the FFN with top-k
     # routed experts sharded over the model axis (expert parallelism).
+    # moe_top_k=1 is Switch semantics, >1 Mixtral (renormalized combine).
     n_experts: int = 0
+    moe_top_k: int = 1
     moe_aux_weight: float = 0.01
     # Rematerialisation (activation checkpointing) per transformer layer —
     # the TPU trade of FLOPs for HBM (scaling-book recipe; the reference
@@ -78,6 +80,10 @@ class TransformerConfig:
         if self.attn_window < 0:
             raise ValueError(
                 f"attn_window must be >= 0, got {self.attn_window}")
+        if self.n_experts > 0 and not 1 <= self.moe_top_k <= self.n_experts:
+            raise ValueError(
+                f"moe_top_k {self.moe_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]")
 
     @property
     def head_dim(self) -> int:
@@ -273,7 +279,8 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
             if "moe" in layer:
                 from kubegpu_tpu.workload.moe import moe_ffn
 
-                ffn_out, aux = moe_ffn(layer["moe"], h, dt)
+                ffn_out, aux = moe_ffn(layer["moe"], h, dt,
+                                       top_k=cfg.moe_top_k)
                 x = x + ffn_out
             else:
                 up = h @ layer["w_up"].astype(dt)

@@ -5,11 +5,12 @@ rides the same tensor-parallel devices): expert weight tensors carry a
 leading expert dimension partitioned over ``model``, and GSPMD inserts the
 dispatch/combine collectives implied by the routing einsums.
 
-Routing is switch-style top-1 with a jitter-free softmax gate; compute is
-dense-over-experts (every expert runs on every token, selection by one-hot
-combine). That trades FLOPs for simplicity and static shapes — the
-capacity-factor dispatch kernel is a later optimization, not a semantic
-change.
+Routing is top-k over a jitter-free softmax gate: Switch-style top-1
+(raw gate weight) by default, Mixtral-style top-k with renormalized
+combine weights for ``top_k > 1``. Compute is dense-over-experts (every
+expert runs on every token, selection by the combine weights). That
+trades FLOPs for simplicity and static shapes — the capacity-factor
+dispatch kernel is a later optimization, not a semantic change.
 """
 
 from __future__ import annotations
@@ -45,31 +46,40 @@ def moe_pspecs(model_axis: str) -> dict:
     }
 
 
-def moe_ffn(params: dict, x, compute_dtype) -> tuple:
-    """Top-1 routed SwiGLU experts. Returns (output, aux_loss).
+def moe_ffn(params: dict, x, compute_dtype, top_k: int = 1) -> tuple:
+    """Top-k routed SwiGLU experts. Returns (output, aux_loss).
 
-    ``aux_loss`` is the standard load-balancing loss (mean gate fraction x
-    mean route fraction x n_experts), encouraging uniform expert load.
+    ``top_k == 1`` keeps Switch semantics exactly (output scaled by the
+    winner's RAW gate probability); ``top_k > 1`` uses Mixtral semantics
+    (combine weights renormalized over the selected experts).
+    ``aux_loss`` is the standard load-balancing loss (mean gate fraction
+    x mean route fraction x n_experts), encouraging uniform expert load.
     """
     gate_logits = x.astype(jnp.float32) @ params["router"]
-    gates = jax.nn.softmax(gate_logits, axis=-1)          # [B,T,E]
-    top1 = jnp.argmax(gates, axis=-1)                      # [B,T]
+    gates = jax.nn.softmax(gate_logits, axis=-1)           # [B,T,E]
     n_experts = gates.shape[-1]
-    one_hot = jax.nn.one_hot(top1, n_experts, dtype=gates.dtype)
-    top_gate = jnp.sum(gates * one_hot, axis=-1)           # [B,T]
+    if not 1 <= top_k <= n_experts:
+        raise ValueError(
+            f"top_k {top_k} must be in [1, n_experts={n_experts}]")
+    vals, idx = jax.lax.top_k(gates, top_k)                # [B,T,K]
+    if top_k > 1:
+        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    hot = jax.nn.one_hot(idx, n_experts, dtype=gates.dtype)  # [B,T,K,E]
+    combine = jnp.sum(hot * vals[..., None], axis=2)       # [B,T,E]
 
-    # dense-over-experts compute; combine by the routing one-hot
+    # dense-over-experts compute; combine by the routing weights
     up = jnp.einsum("btd,edf->btef", x, params["w_up"].astype(compute_dtype))
     gate = jax.nn.silu(
         jnp.einsum("btd,edf->btef", x, params["w_gate"].astype(compute_dtype)))
     expert_out = jnp.einsum("btef,efd->bted", up * gate,
                             params["w_down"].astype(compute_dtype))
     out = jnp.einsum("bted,bte->btd", expert_out,
-                     one_hot.astype(compute_dtype))
-    out = out * top_gate[..., None].astype(compute_dtype)
+                     combine.astype(compute_dtype))
 
-    # load-balancing aux loss (Switch Transformer eq. 4)
-    route_frac = one_hot.mean(axis=(0, 1))                 # [E]
+    # load-balancing aux loss (Switch Transformer eq. 4, normalized so
+    # the ideal-uniform value stays 1.0 for any k)
+    dispatch = jnp.sum(hot, axis=2)                        # [B,T,E] 0/1
+    route_frac = dispatch.mean(axis=(0, 1)) / top_k        # [E]
     gate_frac = gates.mean(axis=(0, 1))                    # [E]
     aux = n_experts * jnp.sum(route_frac * gate_frac)
     return out, aux

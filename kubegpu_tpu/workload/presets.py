@@ -8,8 +8,9 @@ path, dryrun mesh, and `cmd/train_demo.py --preset` all accept:
 - ``gqa``         — grouped-query attention (narrow KV cache/projections);
 - ``windowed``    — sliding-window attention (Mistral-style long context:
                     O(T*window) attention, range grows with depth);
-- ``moe``         — mixture-of-experts FFN, top-1 routed, experts sharded
-                    over the model axis (expert parallelism);
+- ``moe``         — mixture-of-experts FFN, Mixtral-style top-2 routed,
+                    experts sharded over the model axis (expert
+                    parallelism);
 - ``long-ring``   — ring-attention configuration for sequence-parallel
                     meshes (seq axis > 1), full causal span;
 - ``long-ulysses``— Ulysses all-to-all sequence parallelism.
@@ -29,7 +30,7 @@ PRESETS = {
     "dense": dict(_BASE),
     "gqa": dict(_BASE, n_kv_heads=2),
     "windowed": dict(_BASE, attn_window=64),
-    "moe": dict(_BASE, n_experts=4),
+    "moe": dict(_BASE, n_experts=4, moe_top_k=2),  # Mixtral-style top-2
     "long-ring": dict(_BASE, seq_impl="ring"),
     "long-ulysses": dict(_BASE, seq_impl="ulysses"),
 }

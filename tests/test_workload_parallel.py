@@ -168,3 +168,76 @@ def test_checkpoint_restore_empty(tmp_path):
 
     state, step = restore_checkpoint(str(tmp_path / "missing"), {"a": 1})
     assert state is None and step == -1
+
+
+def test_moe_top2_routing(cpu8):
+    """Mixtral-style top-2: combine weights renormalize over the selected
+    pair, output is the weighted mix of exactly two experts, and the
+    aux-loss ideal stays 1.0."""
+    from kubegpu_tpu.workload.moe import init_moe_params, moe_ffn
+
+    rng = jax.random.PRNGKey(0)
+    params = init_moe_params(rng, d_model=16, d_ff=32, n_experts=4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16), jnp.float32)
+    out2, aux2 = moe_ffn(params, x, jnp.float32, top_k=2)
+    assert out2.shape == x.shape and np.isfinite(np.asarray(out2)).all()
+    assert 1.0 <= float(aux2) <= 4.0
+
+    # top-2 must equal the hand-built weighted mix of the two winners
+    gates = jax.nn.softmax(x @ params["router"], axis=-1)
+    vals, idx = jax.lax.top_k(gates, 2)
+    w = vals / vals.sum(-1, keepdims=True)
+    up = jnp.einsum("btd,edf->btef", x, params["w_up"])
+    gate = jax.nn.silu(jnp.einsum("btd,edf->btef", x, params["w_gate"]))
+    eo = jnp.einsum("btef,efd->bted", up * gate, params["w_down"])
+    want = (jnp.take_along_axis(eo, idx[..., 0:1, None], axis=2)[:, :, 0]
+            * w[..., 0:1]
+            + jnp.take_along_axis(eo, idx[..., 1:2, None], axis=2)[:, :, 0]
+            * w[..., 1:2])
+    assert np.allclose(np.asarray(out2), np.asarray(want), atol=1e-5)
+
+
+def test_moe_top1_keeps_switch_semantics(cpu8):
+    """top_k=1 must scale by the winner's RAW gate probability (not a
+    renormalized 1.0) — exact Switch behavior, unchanged."""
+    from kubegpu_tpu.workload.moe import init_moe_params, moe_ffn
+
+    params = init_moe_params(jax.random.PRNGKey(0), 16, 32, 4)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16), jnp.float32)
+    out1, _ = moe_ffn(params, x, jnp.float32, top_k=1)
+    gates = jax.nn.softmax(x @ params["router"], axis=-1)
+    top1 = jnp.argmax(gates, -1)
+    raw = jnp.take_along_axis(gates, top1[..., None], -1)[..., 0]
+    up = jnp.einsum("btd,edf->btef", x, params["w_up"])
+    gate = jax.nn.silu(jnp.einsum("btd,edf->btef", x, params["w_gate"]))
+    eo = jnp.einsum("btef,efd->bted", up * gate, params["w_down"])
+    want = jnp.take_along_axis(
+        eo, top1[..., None, None], axis=2)[:, :, 0] * raw[..., None]
+    assert np.allclose(np.asarray(out1), np.asarray(want), atol=1e-5)
+
+
+def test_moe_top_k_validation(cpu8):
+    from kubegpu_tpu.workload.model import TransformerConfig
+    from kubegpu_tpu.workload.moe import init_moe_params, moe_ffn
+
+    params = init_moe_params(jax.random.PRNGKey(0), 16, 32, 4)
+    x = jnp.zeros((1, 2, 16), jnp.float32)
+    with pytest.raises(ValueError, match="top_k"):
+        moe_ffn(params, x, jnp.float32, top_k=5)
+    with pytest.raises(ValueError, match="moe_top_k"):
+        TransformerConfig(n_experts=4, moe_top_k=0)
+
+
+def test_moe_top2_trains_expert_parallel(cpu8):
+    from kubegpu_tpu.workload.model import TransformerConfig
+    from kubegpu_tpu.workload.spmd import make_mesh
+    from kubegpu_tpu.workload.train import init_sharded, make_train_step
+
+    mesh = make_mesh(8, dp=2, sp=1, tp=4)
+    cfg = TransformerConfig(vocab=32, d_model=32, n_heads=4, n_layers=1,
+                            d_ff=64, n_experts=4, moe_top_k=2)
+    params, opt_state, opt = init_sharded(jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 32)
+    _, _, loss = step(params, opt_state, tokens)
+    assert np.isfinite(float(loss))
