@@ -193,3 +193,34 @@ def test_auto_resolves_to_xla_on_cpu(cpu8):  # noqa: F811
 
     assert _resolve_attn_impl(TransformerConfig(), 1024) == "xla"
     assert _resolve_attn_impl(TransformerConfig(attn_impl="flash"), 77) == "flash"
+
+
+def test_pick_block_policy():
+    """v5e-tuned default blocks: as large as divides T, capped by a
+    VMEM-aware bound that halves as head_dim doubles past 128 (the
+    2048-block variants fail TPU compilation)."""
+    from kubegpu_tpu.workload.kernels.flash import _pick_block
+
+    assert _pick_block(2048) == 1024          # cap wins
+    assert _pick_block(8192) == 1024
+    assert _pick_block(1024) == 1024
+    assert _pick_block(256) == 256            # whole-T block below cap
+    assert _pick_block(1536) == 512           # largest divisor under cap
+    assert _pick_block(96) == 96              # non-power-of-two seq: one block
+    assert _pick_block(2048, head_dim=128) == 1024
+    assert _pick_block(2048, head_dim=256) == 512   # tiles scale with d
+    assert _pick_block(2048, head_dim=512) == 256
+    # divisibility invariant across a spread of lengths
+    for t in (8, 24, 128, 640, 1536, 4096, 12288):
+        b = _pick_block(t)
+        assert t % b == 0 and b <= 1024 or b == t
+
+
+def test_pick_block_non_pow2_head_dim():
+    """Non-power-of-two head dims must still produce a capped block, not
+    fall through to block=T (which VMEM-OOMs the TPU compile)."""
+    from kubegpu_tpu.workload.kernels.flash import _pick_block
+
+    assert _pick_block(8192, head_dim=192) == 512   # cap 682 -> 512
+    assert _pick_block(2048, head_dim=160) == 512   # cap 819 -> 512
+    assert _pick_block(2048, head_dim=320) == 256   # cap 409 -> 256
