@@ -390,16 +390,29 @@ def _is_oom(e):
 
 if preset == "tpu":
     # One model family auto-sized to the detected chip (VERDICT r3 next
-    # #1a): try the largest config whose estimate fits the budget, prove
-    # it with a dry lower().compile() + one executed step, and step down
-    # the ladder on OOM. d_model/L shrink only as a last resort so the
-    # headline number stays comparable across chips.
+    # #1a). Ladder measured on a real v5e (tools/tune_preset.py):
+    # d_model=2048 no-remat configs reach 119-125 TF/s (60-63% MFU)
+    # vs 77 TF/s for the old d=1024 remat-dots headline; order is
+    # best-measured-first with smaller fallbacks for smaller chips.
+    #
+    # The fit gate is compiled memory_analysis, NOT an executed-step OOM
+    # probe: on the axon runtime an oversized program does not raise —
+    # it silently spills to host memory and runs at ~5 TF/s (observed:
+    # a 14.5 GiB-footprint config "succeeded" at 7233 ms/step). Spilled
+    # allocations also poison every later allocation in the process, so
+    # the gate must reject BEFORE the first execution, and the margin
+    # below the nominal budget is deliberate (runtime reserves ~2 GiB;
+    # measured boundary: args+temp 12.9 GiB ran clean, 13.9 spilled).
     BASE = dict(vocab=8192, d_model=1024, n_heads=16, n_layers=8,
                 d_ff=4096, max_seq=2048)
+    BIG = dict(BASE, d_model=2048, d_ff=12288, n_layers=6)
     T = 2048
     CANDS = [
-        (dict(BASE), 16, "dots"),
-        (dict(BASE), 8, "dots"),
+        (dict(BIG), 4, "none"),                       # 125 TF/s on v5e
+        (dict(BIG, d_ff=8192, n_layers=8), 4, "none"),  # 122
+        (dict(BIG, d_ff=8192), 4, "none"),            # 119
+        (dict(BIG, d_ff=8192), 4, "dots"),            # 109
+        (dict(BASE), 8, "dots"),                      # 77
         (dict(BASE), 8, "full"),
         (dict(BASE), 4, "full"),
         (dict(BASE, d_model=768, n_heads=12, d_ff=3072, n_layers=6),
@@ -409,8 +422,8 @@ if preset == "tpu":
     steps, decode_iters, gen_len = 5, 2, 64
     compiled = None
     for ckw, B, remat_mode in CANDS:
-        if est_gb(ckw, B, T, remat_mode) > 0.9 * budget:
-            continue
+        if est_gb(ckw, B, T, remat_mode) > 1.6 * budget:
+            continue  # gross pre-filter only; the compile gate decides
         cfg = TransformerConfig(remat=remat_mode, **ckw)
         try:
             params, opt_state, optimizer = init_sharded(
@@ -419,7 +432,25 @@ if preset == "tpu":
             tokens = jax.random.randint(
                 jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab)
             t0 = time.perf_counter()
-            compiled = step.lower(params, opt_state, tokens).compile()
+            maybe = step.lower(params, opt_state, tokens).compile()
+            ma = maybe.memory_analysis()
+            if ma is not None:
+                # outputs are donated from the arguments, so the live
+                # footprint is args + temps; outputs alias.
+                fp_gb = (ma.argument_size_in_bytes
+                         + ma.temp_size_in_bytes) / 2**30
+                fits = fp_gb <= 0.82 * budget
+            else:
+                # no memory_analysis on this runtime: the conservative
+                # estimate is the only spill protection left, so apply
+                # it at the strict threshold (overestimates real use)
+                fits = est_gb(ckw, B, T, remat_mode) <= 0.9 * budget
+            if not fits:
+                params = opt_state = None
+                import gc
+                gc.collect()
+                continue
+            compiled = maybe
             params, opt_state, loss = compiled(params, opt_state, tokens)
             jax.block_until_ready(loss)
             compile_s = time.perf_counter() - t0
@@ -466,14 +497,10 @@ if not math.isfinite(loss_val):
     raise RuntimeError(f"train loss is {loss_val}: workload is broken")
 train_tok_s = B * T / train_s
 
-# Analytic model FLOPs per train step (fwd+bwd = 3x fwd matmul FLOPs):
-#   linear layers: 6 * tokens * (L*(4*d^2 + 3*d*dff) + d*vocab)
-#   attention scores+values, causal (the work the hardware must do):
-#   fwd 4*B*T^2*d*L * 0.5, fwd+bwd => 12*B*T^2*d*L * 0.5
-d, L, dff, V = cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab
-flops_linear = 6 * B * T * (L * (4 * d * d + 3 * d * dff) + d * V)
-flops_attn = 6 * B * T * T * d * L  # 12*B*T^2*d*L * 0.5 (causal)
-model_flops = flops_linear + flops_attn
+# Analytic model FLOPs per train step: shared formula (also ranks the
+# tune_preset.py candidates) so MFU can never diverge between tools.
+from kubegpu_tpu.workload.train import train_step_model_flops
+model_flops = train_step_model_flops(cfg, B, T)
 achieved_tflops = model_flops / train_s / 1e12
 peak = peak_for(kind) * ndev
 mfu = achieved_tflops / peak if backend == "tpu" else None
@@ -483,10 +510,24 @@ if mfu is not None and mfu >= 1.0:
         f"unphysical MFU {mfu:.2f} (achieved {achieved_tflops:.1f} TF/s "
         f"vs peak {peak:.1f}): timing sync is broken")
 
+gen = jax.jit(make_generate(cfg), static_argnums=(2,))
+prompt = tokens[:, :128]
+out = gen(params, prompt, gen_len)
+jax.device_get(out)  # compile + sync
+t0 = time.perf_counter()
+for _ in range(decode_iters):
+    out = gen(params, prompt, gen_len)
+jax.device_get(out)  # host transfer = the sync barrier
+decode_s = (time.perf_counter() - t0) / decode_iters
+decode_tok_s = B * gen_len / decode_s
+
 # Flash-kernel proof on real hardware (VERDICT r2 weak #5 / next #3):
 # compile the Pallas kernel non-interpret, check numerics against the
 # fused XLA attention on device, and A/B the full train step with the
-# other attention impl so the comparison is end-to-end.
+# other attention impl so the comparison is end-to-end. Runs LAST and
+# CONSUMES the donated (params, opt_state): at the d_model=2048 ladder
+# configs a copy of the optimizer state (~6.6 GiB) on top of the live
+# state exceeds HBM — copying here OOM'd the first r4 capture attempt.
 flash_ab = {}
 if backend == "tpu":
     import dataclasses
@@ -504,17 +545,14 @@ if backend == "tpu":
     jax.block_until_ready((of, orf))
     flash_ab["flash_max_abs_err"] = float(
         jnp.max(jnp.abs(of.astype(jnp.float32) - orf.astype(jnp.float32))))
+    del of, orf, q, k, v
     # end-to-end step-time A/B: same config, attention impl flipped.
-    # The train step donates (params, opt_state), so run the A/B on
-    # copies and chain through the returned state — the originals must
-    # stay live for the decode benchmark below.
     cur = _resolve_attn_impl(cfg, T)
     other = "xla" if cur == "flash" else "flash"
     cfg_b = dataclasses.replace(cfg, attn_impl=other)
     step_b = make_train_step(cfg_b, mesh, optimizer)
-    p_b = jax.tree.map(jnp.copy, params)
-    o_b = jax.tree.map(jnp.copy, opt_state)
-    p_b, o_b, loss_b = step_b(p_b, o_b, tokens)  # compile
+    p_b, o_b, loss_b = step_b(params, opt_state, tokens)  # compile
+    params = opt_state = None  # donated away; nothing below uses them
     float(jax.device_get(loss_b))
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -525,23 +563,13 @@ if backend == "tpu":
     flash_ab[f"train_step_ms_{cur}"] = round(train_s * 1e3, 3)
     flash_ab[f"train_step_ms_{other}"] = round(other_s * 1e3, 3)
 
-gen = jax.jit(make_generate(cfg), static_argnums=(2,))
-prompt = tokens[:, :128]
-out = gen(params, prompt, gen_len)
-jax.device_get(out)  # compile + sync
-t0 = time.perf_counter()
-for _ in range(decode_iters):
-    out = gen(params, prompt, gen_len)
-jax.device_get(out)  # host transfer = the sync barrier
-decode_s = (time.perf_counter() - t0) / decode_iters
-decode_tok_s = B * gen_len / decode_s
-
 from kubegpu_tpu.workload.model import _resolve_attn_impl
 out = {"workload_backend": backend,
        "workload_device_kind": kind,
        "workload_preset": preset,
        "workload_sizing": {"B": B, "T": T, "d_model": cfg.d_model,
-                           "n_layers": cfg.n_layers, "remat": cfg.remat,
+                           "d_ff": cfg.d_ff, "n_layers": cfg.n_layers,
+                           "remat": cfg.remat,
                            "hbm_budget_gb": round(hbm_budget_gb(kind), 2)},
        "attn_impl": _resolve_attn_impl(cfg, T),
        "train_step_ms": round(train_s * 1e3, 3),

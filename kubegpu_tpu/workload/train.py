@@ -73,3 +73,20 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer=None):
         out_shardings=(p_shard, None, None),
         donate_argnums=(0, 1),
     )
+
+
+def train_step_model_flops(cfg: TransformerConfig, batch: int,
+                           seq: int) -> int:
+    """Analytic model FLOPs for one train step (fwd + bwd = 3x the
+    forward matmul FLOPs) — the numerator of every MFU/TF-per-second
+    number this repo reports, kept in ONE place so the bench headline
+    (bench.py) and the preset tuner (tools/tune_preset.py) can never
+    rank candidates by divergent formulas:
+
+      linear layers: 6 * tokens * (L*(4*d^2 + 3*d*d_ff) + d*vocab)
+      attention, causal: fwd 4*B*T^2*d*L * 0.5 -> fwd+bwd 6*B*T^2*d*L
+    """
+    d, L, dff, V = cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab
+    flops_linear = 6 * batch * seq * (L * (4 * d * d + 3 * d * dff) + d * V)
+    flops_attn = 6 * batch * seq * seq * d * L
+    return flops_linear + flops_attn

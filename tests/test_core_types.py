@@ -48,3 +48,22 @@ def test_pod_clone_independent():
     c = pod.clone()
     c.running_containers["c"].requests["x"] = 9
     assert pod.running_containers["c"].requests["x"] == 1
+
+
+def test_utils_sorted_keys_deterministic():
+    """kubegpu_tpu.utils: determinism helpers (reference utils/utils.go:34-47,
+    maputils.go:43-68) — direct coverage; every allocator path relies on
+    sorted iteration for placement determinism."""
+    from kubegpu_tpu.utils import assign_nested, get_nested, sorted_keys
+
+    m = {"b": 1, "a": 2, "c": 3}
+    assert sorted_keys(m) == ["a", "b", "c"]
+    assert sorted_keys({}) == []
+
+    d = {}
+    assign_nested(d, ["x", "y", "z"], 7)
+    assign_nested(d, ["x", "w"], 1)
+    assert d == {"x": {"y": {"z": 7}, "w": 1}}
+    assert get_nested(d, ["x", "y", "z"]) == 7
+    assert get_nested(d, ["x", "missing"], default=-1) == -1
+    assert get_nested(d, ["x", "y", "z", "deeper"], default=None) is None

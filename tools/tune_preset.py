@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Try TPU workload candidate configs on the real chip and report
+step time + analytic achieved TFLOPs, so bench.py's CANDS ladder is
+ordered by measurement instead of guesswork.
+
+Usage: python tools/tune_preset.py  (runs the built-in candidate list)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from kubegpu_tpu.workload.model import TransformerConfig
+from kubegpu_tpu.workload.spmd import make_mesh
+from kubegpu_tpu.workload.train import init_sharded, make_train_step
+
+BASE = dict(vocab=8192, d_model=1024, n_heads=16, n_layers=8,
+            d_ff=4096, max_seq=2048)
+T = 2048
+
+CANDS = [
+    ("base B=8 dots", dict(BASE), 8, "dots"),
+    ("base B=8 none", dict(BASE), 8, "none"),
+    ("base B=16 dots", dict(BASE), 16, "dots"),
+    ("d2048 L6 B=4 dots", dict(BASE, d_model=2048, d_ff=8192, n_layers=6), 4, "dots"),
+    ("d2048 L6 B=8 full", dict(BASE, d_model=2048, d_ff=8192, n_layers=6), 8, "full"),
+    ("base B=32 full", dict(BASE), 32, "full"),
+]
+
+
+def model_flops(c, B):
+    """Same formula as the bench headline (train_step_model_flops) so
+    candidates are ranked by the metric they will be scored on."""
+    from kubegpu_tpu.workload.train import train_step_model_flops
+
+    return train_step_model_flops(TransformerConfig(**c), B, T)
+
+
+# Fraction of the nominal HBM budget a candidate's (args + temps)
+# footprint may use. Matches bench.py's gate: on the axon runtime an
+# oversized program does not raise — it silently spills to host memory,
+# runs at ~5 TF/s, AND poisons every later allocation in the process,
+# which would corrupt all subsequent candidates' measurements.
+SPILL_GATE_FRACTION = 0.82
+HBM_BUDGET_GB = 15.75  # v5e; override for other chips
+
+
+def main():
+    print(f"device={jax.devices()[0].device_kind}")
+    mesh = make_mesh(1, dp=1, sp=1, tp=1)
+    for name, ckw, B, remat in CANDS:
+        cfg = TransformerConfig(remat=remat, **ckw)
+        try:
+            params, opt_state, optimizer = init_sharded(
+                jax.random.PRNGKey(0), cfg, mesh)
+            step = make_train_step(cfg, mesh, optimizer)
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab)
+            t0 = time.perf_counter()
+            compiled = step.lower(params, opt_state, tokens).compile()
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                fp = (ma.argument_size_in_bytes
+                      + ma.temp_size_in_bytes) / 2**30
+                if fp > SPILL_GATE_FRACTION * HBM_BUDGET_GB:
+                    print(f"{name:22s} SKIPPED: footprint {fp:.1f} GiB "
+                          f"would spill (gate "
+                          f"{SPILL_GATE_FRACTION * HBM_BUDGET_GB:.1f})")
+                    continue
+            params, opt_state, loss = compiled(params, opt_state, tokens)
+            float(jax.device_get(loss))
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(5):
+                params, opt_state, loss = compiled(params, opt_state, tokens)
+            float(jax.device_get(loss))
+            dt = (time.perf_counter() - t0) / 5
+            tf = model_flops(ckw, B) / dt / 1e12
+            print(f"{name:22s} step {dt*1e3:8.2f} ms  {tf:6.1f} TF/s "
+                  f"mfu~{tf/197:.3f}  (compile {compile_s:.0f}s)")
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).replace("\n", " ")[:140]
+            print(f"{name:22s} FAILED {type(e).__name__}: {msg}")
+        finally:
+            params = opt_state = compiled = None
+            import gc
+            gc.collect()
+
+
+if __name__ == "__main__":
+    main()
