@@ -80,6 +80,14 @@ def hbm_budget_for_kind(kind_str: str) -> float:
     return 15.75  # conservative: smallest current part
 
 
+# Fraction of a chip's HBM budget the compiled (args + temps) footprint
+# may use before a candidate is rejected as a spill risk. Measured
+# boundary on the v5e: 12.9 GiB of 15.75 ran clean, 13.9 silently
+# spilled to host memory (~5 TF/s). Shared with tools/tune_preset.py so
+# the tuner and the bench ladder can never disagree about fit.
+SPILL_GATE_FRACTION = 0.82
+
+
 def make_pod(name, numchips, pod_requests=None, hbm=0):
     pi = PodInfo(name=name, requests=dict(pod_requests or {}))
     reqs = {grammar.RESOURCE_NUM_CHIPS: numchips}
@@ -352,7 +360,7 @@ preset = os.environ.get("KGTPU_BENCH_PRESET", "cpu")
 
 # Device tables live in bench.py proper (this script runs with the repo
 # root as cwd) so tests pin them against the committed device fixture.
-from bench import hbm_budget_for_kind, peak_for
+from bench import SPILL_GATE_FRACTION, hbm_budget_for_kind, peak_for
 
 def hbm_budget_gb(kind_str):
     # live memory_stats() when the runtime exposes it (axon returns
@@ -418,12 +426,18 @@ if preset == "tpu":
         (dict(BASE, d_model=768, n_heads=12, d_ff=3072, n_layers=6),
          4, "full"),
     ]
-    budget = hbm_budget_gb(kind) * ndev
+    per_chip_budget = hbm_budget_gb(kind)
+    budget = per_chip_budget * ndev
     steps, decode_iters, gen_len = 5, 2, 64
     compiled = None
+    ma_unavailable = False  # learned from the first compile
     for ckw, B, remat_mode in CANDS:
-        if est_gb(ckw, B, T, remat_mode) > 1.6 * budget:
+        pre = est_gb(ckw, B, T, remat_mode)
+        if pre > 1.6 * budget:
             continue  # gross pre-filter only; the compile gate decides
+        if ma_unavailable and pre > 0.9 * budget:
+            continue  # no compile gate on this runtime: don't pay a
+            # ~15 s compile for a candidate the strict estimate rejects
         cfg = TransformerConfig(remat=remat_mode, **ckw)
         try:
             params, opt_state, optimizer = init_sharded(
@@ -436,15 +450,18 @@ if preset == "tpu":
             ma = maybe.memory_analysis()
             if ma is not None:
                 # outputs are donated from the arguments, so the live
-                # footprint is args + temps; outputs alias.
+                # footprint is args + temps; outputs alias. These are
+                # PER-DEVICE sizes post-SPMD, so compare against ONE
+                # chip's budget, not the mesh total.
                 fp_gb = (ma.argument_size_in_bytes
                          + ma.temp_size_in_bytes) / 2**30
-                fits = fp_gb <= 0.82 * budget
+                fits = fp_gb <= SPILL_GATE_FRACTION * per_chip_budget
             else:
                 # no memory_analysis on this runtime: the conservative
                 # estimate is the only spill protection left, so apply
                 # it at the strict threshold (overestimates real use)
-                fits = est_gb(ckw, B, T, remat_mode) <= 0.9 * budget
+                ma_unavailable = True
+                fits = pre <= 0.9 * budget
             if not fits:
                 params = opt_state = None
                 import gc

@@ -84,6 +84,21 @@ class GenericScheduler:
         self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
                                         thread_name_prefix="fit")
 
+    def _parallel_map(self, fn, items):
+        """Order-preserving pool map in node-list chunks, not one task
+        per node: at 64+ nodes the per-task queue/lock overhead of
+        Executor.map dominated the (mostly GIL-serialized) per-node work
+        — ~9.7k futures per preemption bench run, ~0.6 s of pure
+        dispatch. One chunk per worker keeps the native-allocator calls
+        (which DO release the GIL) running concurrently."""
+        items = list(items)
+        n = max(1, -(-len(items) // self.parallelism))
+        chunks = [items[i:i + n] for i in range(0, len(items), n)]
+        out = []
+        for part in self._pool.map(lambda c: [fn(x) for x in c], chunks):
+            out.extend(part)
+        return out
+
     # ---- predicates --------------------------------------------------------
 
     _AUTO_META = object()  # sentinel: compute inter-pod metadata if needed
@@ -348,12 +363,12 @@ class GenericScheduler:
         pod_info_get = self._pod_info_provider(kube_pod)
         device_class = self._device_class(kube_pod, auto_topology)
         snaps: dict = {}
-        results = list(self._pool.map(
+        results = self._parallel_map(
             lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps,
                                               meta, pod_info_get,
                                               device_class, eq_gens.get(n),
                                               vol)),
-            names))
+            names)
         feasible = {n: score for n, ok, _, score in results if ok}
         failures = {n: reasons for n, ok, reasons, _ in results if not ok}
         for ext in self.extenders:
@@ -526,7 +541,7 @@ class GenericScheduler:
         # Victim search parallelized over nodes with the fit pool — each
         # worker simulates on its own snapshot (the reference runs this
         # 16-way too). min() over keys keeps selection deterministic.
-        results = [r for r in self._pool.map(eval_node, names)
+        results = [r for r in self._parallel_map(eval_node, names)
                    if r is not None]
         if not results:
             return None

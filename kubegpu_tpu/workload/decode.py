@@ -161,7 +161,15 @@ def make_generate(cfg: TransformerConfig, mesh=None,
 
     def generate(params, prompt, n_new: int):
         b, t0 = prompt.shape
-        cache = init_cache(cfg, b, max_seq)
+        # Size the cache to THIS call's horizon, not max_seq: prompt and
+        # n_new are static at trace time, so the cache (and with it
+        # every decode step's full-cache attention read — the HBM
+        # traffic that bounds decode on TPU) shrinks to the 128-aligned
+        # generation length. Masked positions contributed exactly zero,
+        # so tokens are unchanged; a longer horizon in a later call just
+        # traces a new program (same as any new static n_new).
+        horizon = min(max_seq, -(-(t0 + n_new) // 128) * 128)
+        cache = init_cache(cfg, b, horizon)
         logits, cache = step(params, cache, prompt, 0)
         first = jnp.argmax(logits[:, -1, :], axis=-1)
 

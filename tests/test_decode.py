@@ -119,3 +119,22 @@ def test_cache_pspecs_match_cache_structure(setup):
     assert jax.tree.structure(
         jax.tree.map(lambda _: 0, cache)) == jax.tree.structure(
         jax.tree.map(lambda _: 0, specs))
+
+
+def test_generate_horizon_independent_of_max_seq(setup):
+    """The cache is sized to the call's static generation horizon, not
+    cfg.max_seq (the beyond-horizon positions contributed exactly zero) —
+    tokens must be identical under a much larger max_seq."""
+    cfg, params, tokens = setup
+    big = small_cfg(max_seq=1024)  # same weights shape; only cache cap grows
+    out_small = jax.jit(make_generate(cfg), static_argnums=(2,))(
+        params, tokens, 6)
+    out_big = jax.jit(make_generate(big), static_argnums=(2,))(
+        params, tokens, 6)
+    np.testing.assert_array_equal(np.asarray(out_small), np.asarray(out_big))
+    # unaligned horizon (prompt 10 + 3 new = 13 -> rounds to 128, capped
+    # at max_seq 32) still decodes fine
+    out3 = jax.jit(make_generate(cfg), static_argnums=(2,))(
+        params, tokens, 3)
+    np.testing.assert_array_equal(np.asarray(out3[:, 0]),
+                                  np.asarray(out_small[:, 0]))

@@ -43,17 +43,18 @@ def model_flops(c, B):
     return train_step_model_flops(TransformerConfig(**c), B, T)
 
 
-# Fraction of the nominal HBM budget a candidate's (args + temps)
-# footprint may use. Matches bench.py's gate: on the axon runtime an
-# oversized program does not raise — it silently spills to host memory,
-# runs at ~5 TF/s, AND poisons every later allocation in the process,
-# which would corrupt all subsequent candidates' measurements.
-SPILL_GATE_FRACTION = 0.82
-HBM_BUDGET_GB = 15.75  # v5e; override for other chips
-
-
 def main():
-    print(f"device={jax.devices()[0].device_kind}")
+    # The spill gate (fraction AND budget) is bench.py's: on the axon
+    # runtime an oversized program does not raise — it silently spills
+    # to host memory, runs at ~5 TF/s, AND poisons every later
+    # allocation in the process, corrupting all subsequent candidates'
+    # measurements. Sharing bench's constants means the tuner can never
+    # recommend a ladder entry the bench gate would reject.
+    import bench
+
+    kind = str(getattr(jax.devices()[0], "device_kind", ""))
+    gate_gb = bench.SPILL_GATE_FRACTION * bench.hbm_budget_for_kind(kind)
+    print(f"device={kind} spill gate {gate_gb:.1f} GiB")
     mesh = make_mesh(1, dp=1, sp=1, tp=1)
     for name, ckw, B, remat in CANDS:
         cfg = TransformerConfig(remat=remat, **ckw)
@@ -69,10 +70,9 @@ def main():
             if ma is not None:
                 fp = (ma.argument_size_in_bytes
                       + ma.temp_size_in_bytes) / 2**30
-                if fp > SPILL_GATE_FRACTION * HBM_BUDGET_GB:
+                if fp > gate_gb:
                     print(f"{name:22s} SKIPPED: footprint {fp:.1f} GiB "
-                          f"would spill (gate "
-                          f"{SPILL_GATE_FRACTION * HBM_BUDGET_GB:.1f})")
+                          f"would spill (gate {gate_gb:.1f})")
                     continue
             params, opt_state, loss = compiled(params, opt_state, tokens)
             float(jax.device_get(loss))
