@@ -47,6 +47,15 @@ def main(argv=None) -> int:
                     help="save train state here and resume from the "
                          "latest step on start (elastic restart)")
     ap.add_argument("--checkpoint-every", type=int, default=2)
+    ap.add_argument("--generate", type=int, default=0, metavar="N",
+                    help="after training, decode N tokens from a prompt "
+                         "drawn from the data stream")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy (default); >0 samples")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="sample from the k highest-logit tokens")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="nucleus sampling mass (0, 1]")
     args = ap.parse_args(argv)
     if args.steps < 1:
         ap.error("--steps must be >= 1")
@@ -144,7 +153,7 @@ def main(argv=None) -> int:
         loader.close()
     wall = time.perf_counter() - t0
 
-    print(json.dumps({
+    out = {
         "loader": loader_kind,
         "devices": len(mesh.devices.flatten()),
         "resumed_from_step": start_step,
@@ -152,7 +161,21 @@ def main(argv=None) -> int:
         "first_loss": round(losses[0], 4),
         "last_loss": round(losses[-1], 4),
         "tokens_per_s": round(args.steps * args.batch * seq_len / wall, 1),
-    }))
+    }
+
+    if args.generate > 0:
+        from kubegpu_tpu.workload.decode import make_generate
+
+        gen = jax.jit(make_generate(cfg, mesh, temperature=args.temperature,
+                                    top_k=args.top_k, top_p=args.top_p),
+                      static_argnums=(2,))
+        # full batch (a dp-sharded mesh can't split batch 1); print row 0
+        prompt = tokens[:, :min(16, seq_len)]
+        toks = gen(params, prompt, args.generate,
+                   jax.random.PRNGKey(args.seed))
+        out["generated"] = np.asarray(toks)[0].tolist()
+
+    print(json.dumps(out))
     return 0 if all(np.isfinite(losses)) else 1
 
 

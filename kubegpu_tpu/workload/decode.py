@@ -151,16 +151,77 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
     return step
 
 
+def _select_token(logits, key, temperature: float, top_k: int,
+                  top_p: float):
+    """Pick the next token per batch row from ``logits [B, V]``.
+
+    ``temperature == 0`` is greedy argmax (no key needed). Otherwise
+    temperature-scaled sampling, optionally truncated to the ``top_k``
+    highest-logit tokens and/or the ``top_p`` nucleus (smallest set of
+    tokens whose probability mass reaches ``top_p``). Truncations are
+    implemented as logit thresholds so everything stays static-shaped
+    for the decode scan."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    z = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = lax.top_k(z, top_k)[0][:, -1:]  # k-th largest per row
+        z = jnp.where(z < kth, NEG_INF, z)
+    if top_p < 1.0:
+        z_sorted = jnp.sort(z, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(z_sorted, axis=-1)
+        # exclusive cumulative mass BEFORE each token: a token is kept
+        # while the mass of strictly-better tokens is < top_p, so the
+        # boundary token that crosses top_p is included (standard
+        # nucleus semantics) and the top-1 token can never be dropped.
+        cum = jnp.cumsum(probs, axis=-1) - probs
+        keep = cum < top_p
+        # threshold = smallest kept logit; mask everything below it
+        thr = jnp.min(jnp.where(keep, z_sorted, jnp.inf),
+                      axis=-1, keepdims=True)
+        z = jnp.where(z < thr, NEG_INF, z)
+    return jax.random.categorical(key, z, axis=-1)
+
+
 def make_generate(cfg: TransformerConfig, mesh=None,
-                  max_seq: int | None = None):
-    """Build ``generate(params, prompt, n_new) -> tokens [B, n_new]``:
-    greedy decoding as prefill + ONE `lax.scan` over decode steps, all
-    inside a single jit. ``n_new`` is static (it sizes the scan)."""
+                  max_seq: int | None = None, temperature: float = 0.0,
+                  top_k: int = 0, top_p: float = 1.0):
+    """Build ``generate(params, prompt, n_new[, rng]) -> tokens
+    [B, n_new]``: decoding as prefill + ONE `lax.scan` over decode
+    steps, all inside a single jit. ``n_new`` is static (it sizes the
+    scan). Sampling is configured here (static by construction):
+    ``temperature=0`` (default) is greedy; >0 samples, truncated by
+    ``top_k``/``top_p``, and ``generate`` then requires ``rng``."""
     max_seq = max_seq or cfg.max_seq
     step = make_forward_step(cfg, mesh)
+    sampling = temperature != 0.0
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not sampling and (top_k or top_p < 1.0):
+        raise ValueError(
+            "top_k/top_p truncate SAMPLING and are ignored by greedy "
+            "decode — set temperature > 0 to use them")
+    # k >= vocab keeps every token: same distribution, so clamp rather
+    # than let lax.top_k fail an obscure shape check at trace time
+    top_k = min(top_k, cfg.vocab)
 
-    def generate(params, prompt, n_new: int):
+    def generate(params, prompt, n_new: int, rng=None):
+        if sampling and rng is None:
+            raise ValueError("sampling decode needs an rng key")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # unused by greedy selection
         b, t0 = prompt.shape
+        if t0 + n_new > max_seq:
+            # beyond max_seq, dynamic_update_slice would CLAMP every
+            # later write to the last cache slot while RoPE positions
+            # keep advancing — silently corrupt output, so refuse
+            raise ValueError(
+                f"prompt ({t0}) + n_new ({n_new}) exceeds max_seq "
+                f"({max_seq}); raise max_seq= on make_generate")
         # Size the cache to THIS call's horizon, not max_seq: prompt and
         # n_new are static at trace time, so the cache (and with it
         # every decode step's full-cache attention read — the HBM
@@ -171,16 +232,20 @@ def make_generate(cfg: TransformerConfig, mesh=None,
         horizon = min(max_seq, -(-(t0 + n_new) // 128) * 128)
         cache = init_cache(cfg, b, horizon)
         logits, cache = step(params, cache, prompt, 0)
-        first = jnp.argmax(logits[:, -1, :], axis=-1)
+        first = _select_token(logits[:, -1, :], jax.random.fold_in(rng, 0),
+                              temperature, top_k, top_p)
 
-        def body(carry, _):
+        def body(carry, i):
             cache, token, pos = carry
             logits, cache = step(params, cache, token[:, None], pos)
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+            nxt = _select_token(logits[:, -1, :],
+                                jax.random.fold_in(rng, i),
+                                temperature, top_k, top_p)
             return (cache, nxt, pos + 1), token
 
         (_, last, _), toks = lax.scan(
-            body, (cache, first, jnp.int32(t0)), None, length=n_new - 1)
+            body, (cache, first, jnp.int32(t0)),
+            jnp.arange(1, n_new), length=n_new - 1)
         # toks: [n_new-1, B] of the fed-in tokens; append the final one
         out = jnp.concatenate(
             [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1) \

@@ -248,3 +248,26 @@ def test_train_demo_preset_flag():
     assert proc.returncode == 0, proc.stderr[-1500:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert np.isfinite(out["last_loss"])
+
+
+def test_train_demo_generate_sampling_flags(tmp_path):
+    """--generate decodes after training; identical seeds reproduce the
+    same sampled tokens (fold_in per step, keyed off --seed)."""
+    import json
+
+    env = {**{k: v for k, v in os.environ.items()
+              if k != "PALLAS_AXON_POOL_IPS"}, "JAX_PLATFORMS": "cpu"}
+    cmd = [sys.executable, "-m", "kubegpu_tpu.cmd.train_demo",
+           "--steps", "1", "--batch", "2", "--seq", "32",
+           "--d-model", "32", "--n-layers", "1",
+           "--generate", "5", "--temperature", "0.8", "--top-k", "10",
+           "--top-p", "0.9", "--seed", "7"]
+    runs = [subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=300, env=env, cwd=REPO)
+            for _ in range(2)]
+    outs = []
+    for r in runs:
+        assert r.returncode == 0, r.stderr[-1500:]
+        outs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    assert len(outs[0]["generated"]) == 5
+    assert outs[0]["generated"] == outs[1]["generated"]

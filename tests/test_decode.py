@@ -138,3 +138,88 @@ def test_generate_horizon_independent_of_max_seq(setup):
         params, tokens, 3)
     np.testing.assert_array_equal(np.asarray(out3[:, 0]),
                                   np.asarray(out_small[:, 0]))
+
+
+def test_sampling_top_k1_equals_greedy(setup):
+    """top_k=1 truncates to the single best token — any temperature must
+    then reproduce greedy exactly."""
+    cfg, params, tokens = setup
+    greedy = jax.jit(make_generate(cfg), static_argnums=(2,))(
+        params, tokens, 6)
+    k1 = jax.jit(make_generate(cfg, temperature=1.7, top_k=1),
+                 static_argnums=(2,))(
+        params, tokens, 6, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_sampling_tiny_top_p_equals_greedy(setup):
+    """A tiny nucleus keeps only the most-probable token (the boundary
+    token is always included, so top-1 can never be dropped)."""
+    cfg, params, tokens = setup
+    greedy = jax.jit(make_generate(cfg), static_argnums=(2,))(
+        params, tokens, 6)
+    p = jax.jit(make_generate(cfg, temperature=1.0, top_p=1e-6),
+                static_argnums=(2,))(
+        params, tokens, 6, jax.random.PRNGKey(4))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p))
+
+
+def test_sampling_deterministic_per_key_and_varies(setup):
+    cfg, params, tokens = setup
+    gen = jax.jit(make_generate(cfg, temperature=1.0),
+                  static_argnums=(2,))
+    a1 = gen(params, tokens, 8, jax.random.PRNGKey(0))
+    a2 = gen(params, tokens, 8, jax.random.PRNGKey(0))
+    b = gen(params, tokens, 8, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert not np.array_equal(np.asarray(a1), np.asarray(b))
+    # tokens stay in-vocab
+    assert int(np.asarray(a1).min()) >= 0
+    assert int(np.asarray(a1).max()) < cfg.vocab
+
+
+def test_sampling_requires_rng(setup):
+    cfg, params, tokens = setup
+    gen = make_generate(cfg, temperature=0.8)
+    with pytest.raises(ValueError, match="rng"):
+        gen(params, tokens, 4)
+
+
+def test_sampling_config_validation(setup):
+    cfg = setup[0]
+    with pytest.raises(ValueError, match="temperature"):
+        make_generate(cfg, temperature=-1.0)
+    with pytest.raises(ValueError, match="top_p"):
+        make_generate(cfg, temperature=1.0, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        make_generate(cfg, temperature=1.0, top_k=-2)
+
+
+def test_generate_rejects_overlong_horizon(setup):
+    """Beyond max_seq the cache writes would clamp to the last slot and
+    silently corrupt output — must refuse instead."""
+    cfg, params, tokens = setup
+    gen = make_generate(cfg)  # max_seq=32, prompt t0=10
+    with pytest.raises(ValueError, match="max_seq"):
+        gen(params, tokens, 30)
+
+
+def test_truncation_flags_require_sampling(setup):
+    cfg = setup[0]
+    with pytest.raises(ValueError, match="temperature"):
+        make_generate(cfg, top_k=5)
+    with pytest.raises(ValueError, match="temperature"):
+        make_generate(cfg, top_p=0.9)
+
+
+def test_top_k_clamped_to_vocab(setup):
+    """top_k >= vocab keeps every token (same distribution) — must not
+    die in lax.top_k's shape check."""
+    cfg, params, tokens = setup
+    gen = jax.jit(make_generate(cfg, temperature=1.0, top_k=10 * cfg.vocab),
+                  static_argnums=(2,))
+    out = gen(params, tokens, 4, jax.random.PRNGKey(0))
+    ref = jax.jit(make_generate(cfg, temperature=1.0),
+                  static_argnums=(2,))(params, tokens, 4,
+                                       jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
