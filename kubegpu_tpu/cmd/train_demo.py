@@ -40,6 +40,8 @@ def main(argv=None) -> int:
                     help="default 128 (d_ff follows at 4x)")
     ap.add_argument("--n-layers", type=int, default=None, help="default 2")
     ap.add_argument("--n-heads", type=int, default=None, help="default 4")
+    ap.add_argument("--accum-steps", type=int, default=1,
+                    help="gradient-accumulation microbatches per step")
     ap.add_argument("--remat", default="none",
                     choices=["none", "dots", "full"])
     ap.add_argument("--seed", type=int, default=0)
@@ -112,9 +114,26 @@ def main(argv=None) -> int:
             for i in range(2)]
 
     mesh = spmd.mesh_from_env()
+
+    # Build (and thereby validate) the generator BEFORE training: a bad
+    # flag combination must fail up front, not after the last step when
+    # an uncheckpointed session's params would be lost.
+    gen = None
+    if args.generate > 0:
+        from kubegpu_tpu.workload.decode import make_generate
+
+        gen = jax.jit(make_generate(cfg, mesh, temperature=args.temperature,
+                                    top_k=args.top_k, top_p=args.top_p),
+                      static_argnums=(2,))
+        prompt_len = min(16, seq_len)
+        if prompt_len + args.generate > cfg.max_seq:
+            ap.error(f"--generate {args.generate} + prompt {prompt_len} "
+                     f"exceeds the model's max_seq {cfg.max_seq}")
+
     params, opt_state, optimizer = init_sharded(
         jax.random.PRNGKey(args.seed), cfg, mesh)
-    step = make_train_step(cfg, mesh, optimizer)
+    step = make_train_step(cfg, mesh, optimizer,
+                           accum_steps=args.accum_steps)
 
     # elastic restart: a killed pod's replacement resumes from the last
     # saved step — the workload-side analogue of the scheduler rebuilding
@@ -163,12 +182,7 @@ def main(argv=None) -> int:
         "tokens_per_s": round(args.steps * args.batch * seq_len / wall, 1),
     }
 
-    if args.generate > 0:
-        from kubegpu_tpu.workload.decode import make_generate
-
-        gen = jax.jit(make_generate(cfg, mesh, temperature=args.temperature,
-                                    top_k=args.top_k, top_p=args.top_p),
-                      static_argnums=(2,))
+    if gen is not None:
         # full batch (a dp-sharded mesh can't split batch 1); print row 0
         prompt = tokens[:, :min(16, seq_len)]
         toks = gen(params, prompt, args.generate,

@@ -64,10 +64,12 @@ class TransformerConfig:
     remat: str = "none"
 
     # Sliding-window attention: each position attends only the newest
-    # ``attn_window`` positions (0 = full causal). Single-shard paths
-    # (xla + flash kernel, which skips out-of-window tiles) — long-range
-    # information still flows across layers, Mistral-style. Not
-    # implemented for the cross-shard seq strategies (ring/Ulysses).
+    # ``attn_window`` positions (0 = full causal). Works on every
+    # attention path — xla, the flash kernel (which skips fully-out-of-
+    # window tiles), and the cross-shard seq strategies (the ring masks
+    # each rotating block at global positions; Ulysses attends the full
+    # sequence locally) — long-range information still flows across
+    # layers, Mistral-style.
     attn_window: int = 0
     # Grouped-query attention: 0 = MHA (kv heads == query heads); a
     # divisor of n_heads shares each K/V head across n_heads/n_kv_heads
@@ -224,21 +226,19 @@ def make_forward_with_aux(cfg: TransformerConfig, mesh=None):
         local_t = t if cfg.seq_impl == "ulysses" else t // seq_shards
         impl = _resolve_attn_impl(cfg, local_t)
         interpret = impl == "flash" and jax.default_backend() == "cpu"
-        if cfg.attn_window and use_ring:
-            raise NotImplementedError(
-                "attn_window is single-shard only; use a seq axis of 1 "
-                "(window already bounds the attention span)")
         if use_ring and cfg.seq_impl == "ulysses":
             from kubegpu_tpu.workload.ulysses import (
                 make_sharded_ulysses_attention)
 
             return make_sharded_ulysses_attention(
                 mesh, spmd.AXIS_DATA, spmd.AXIS_SEQ, spmd.AXIS_MODEL, scale,
-                use_flash=impl == "flash", interpret=interpret)
+                use_flash=impl == "flash", interpret=interpret,
+                window=cfg.attn_window)
         if use_ring:
             return make_sharded_ring_attention(
                 mesh, spmd.AXIS_DATA, spmd.AXIS_SEQ, spmd.AXIS_MODEL, scale,
-                use_flash=impl == "flash", interpret=interpret)
+                use_flash=impl == "flash", interpret=interpret,
+                window=cfg.attn_window)
         if impl == "flash":
             from kubegpu_tpu.workload.kernels.flash import flash_attention
 

@@ -20,11 +20,15 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def ring_attention(q, k, v, axis_name: str, scale: float):
+def ring_attention(q, k, v, axis_name: str, scale: float,
+                   window: int = 0):
     """Causal multi-head attention with K/V rotating around ``axis_name``.
 
     q, k, v: per-shard blocks ``[B, T_local, H, D]`` (already RoPE'd with
-    global positions). Returns ``[B, T_local, H, D]``.
+    global positions). Returns ``[B, T_local, H, D]``. ``window`` > 0 =
+    sliding-window attention over GLOBAL positions (each row attends the
+    newest ``window`` keys), masked per rotating block exactly like the
+    single-shard path.
     """
     axis_size = lax.psum(1, axis_name)
     my_index = lax.axis_index(axis_name)
@@ -43,6 +47,8 @@ def ring_attention(q, k, v, axis_name: str, scale: float):
 
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)) * scale
         causal = q_pos[:, None] >= kv_pos[None, :]
+        if window:
+            causal &= kv_pos[None, :] > q_pos[:, None] - window
         s = jnp.where(causal[None, None, :, :], s, NEG_INF)
 
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -75,7 +81,7 @@ def ring_attention(q, k, v, axis_name: str, scale: float):
 
 
 def ring_flash_attention(q, k, v, axis_name: str, scale: float,
-                         interpret: bool = False):
+                         interpret: bool = False, window: int = 0):
     """Ring attention whose per-step block attend is the Pallas flash
     kernel (`kernels.flash`): each rotating K/V block is attended with
     global-position causal masking (offsets = shard indices × block len),
@@ -94,7 +100,8 @@ def ring_flash_attention(q, k, v, axis_name: str, scale: float,
         src = (my_index - r) % axis_size
         o_r, lse_r = flash_attention_with_lse(
             q, k_blk, v_blk, scale, q_offset=my_index * t_local,
-            kv_offset=src * t_local, causal=True, interpret=interpret)
+            kv_offset=src * t_local, causal=True, interpret=interpret,
+            window=window)
         return merge_partials(o, lse, o_r, lse_r)
 
     def step(carry, r):
@@ -118,7 +125,7 @@ def ring_flash_attention(q, k, v, axis_name: str, scale: float,
 def make_sharded_ring_attention(mesh, data_axis: str, seq_axis: str,
                                 model_axis: str, scale: float,
                                 use_flash: bool = False,
-                                interpret: bool = False):
+                                interpret: bool = False, window: int = 0):
     """shard_map wrapper: GSPMD handles the rest of the model; attention
     drops to per-shard code so the ring's ppermutes are explicit.
     ``use_flash`` swaps the per-step attend onto the Pallas kernel."""
@@ -129,8 +136,8 @@ def make_sharded_ring_attention(mesh, data_axis: str, seq_axis: str,
     def fn(q, k, v):
         if use_flash:
             return ring_flash_attention(q, k, v, seq_axis, scale,
-                                        interpret=interpret)
-        return ring_attention(q, k, v, seq_axis, scale)
+                                        interpret=interpret, window=window)
+        return ring_attention(q, k, v, seq_axis, scale, window=window)
 
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)

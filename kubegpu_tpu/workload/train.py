@@ -14,6 +14,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from kubegpu_tpu.workload import spmd
 from kubegpu_tpu.workload.model import TransformerConfig, init_params, make_loss_fn
@@ -47,13 +48,44 @@ def init_sharded(rng, cfg: TransformerConfig, mesh, optimizer=None):
     return params, opt_state, optimizer
 
 
-def make_train_step(cfg: TransformerConfig, mesh, optimizer=None):
-    """Jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+def make_train_step(cfg: TransformerConfig, mesh, optimizer=None,
+                    accum_steps: int = 1):
+    """Jitted ``step(params, opt_state, tokens) -> (params, opt_state, loss)``.
+
+    ``accum_steps`` > 1 = gradient accumulation: the batch is split into
+    that many equal microbatches, gradients are averaged over a
+    `lax.scan` of fwd+bwd passes, and ONE optimizer update applies —
+    the standard trade of step latency for effective batch sizes whose
+    activations exceed HBM. Equal microbatch sizes make the averaged
+    loss/grads exactly the full-batch mean (the loss is token-mean), so
+    accum_steps changes memory, not semantics."""
     optimizer = optimizer or default_optimizer()
     loss_fn = make_loss_fn(cfg, mesh)
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        else:
+            b = tokens.shape[0]
+            if b % accum_steps:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum_steps}")
+            micro = tokens.reshape(accum_steps, b // accum_steps,
+                                   *tokens.shape[1:])
+
+            def acc(carry, mb):
+                loss_sum, grads_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+                return (loss_sum + loss,
+                        jax.tree.map(jnp.add, grads_sum, grads)), None
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (loss, grads), _ = lax.scan(
+                acc, (jnp.zeros((), jnp.float32), zeros), micro)
+            loss = loss / accum_steps
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

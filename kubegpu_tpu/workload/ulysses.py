@@ -40,12 +40,15 @@ def _gather_heads(x, axis_name: str):
 
 
 def ulysses_attention(q, k, v, axis_name: str, scale: float,
-                      use_flash: bool = False, interpret: bool = False):
+                      use_flash: bool = False, interpret: bool = False,
+                      window: int = 0):
     """Exact causal attention over the ``axis_name``-sharded sequence.
 
     q, k, v: per-shard blocks ``[B, T_local, H, D]`` (already RoPE'd with
     global positions). Returns ``[B, T_local, H, D]``. Matches single-
-    shard causal attention bit-for-bit up to float tolerance.
+    shard causal attention bit-for-bit up to float tolerance. ``window``
+    passes straight to the full-sequence local attend (positions are
+    global after the all-to-all).
     """
     sp = lax.psum(1, axis_name)
     h = q.shape[2]
@@ -59,21 +62,23 @@ def ulysses_attention(q, k, v, axis_name: str, scale: float,
     if use_flash:
         from kubegpu_tpu.workload.kernels.flash import flash_attention
 
-        out = flash_attention(qg, kg, vg, scale, interpret=interpret)
+        out = flash_attention(qg, kg, vg, scale, interpret=interpret,
+                              window=window)
     else:
         # the single-shard fused attention is the ONE implementation both
         # seq_impl strategies must match; lazy import avoids a cycle
         # (model imports this module lazily too)
         from kubegpu_tpu.workload.model import _causal_attention
 
-        out = _causal_attention(qg, kg, vg, scale)
+        out = _causal_attention(qg, kg, vg, scale, window=window)
     return _gather_heads(out, axis_name)
 
 
 def make_sharded_ulysses_attention(mesh, data_axis: str, seq_axis: str,
                                    model_axis: str, scale: float,
                                    use_flash: bool = False,
-                                   interpret: bool = False):
+                                   interpret: bool = False,
+                                   window: int = 0):
     """shard_map wrapper mirroring `ring.make_sharded_ring_attention`:
     same in/out specs, so `model.py` can swap strategies freely."""
     from jax.sharding import PartitionSpec as P
@@ -82,7 +87,8 @@ def make_sharded_ulysses_attention(mesh, data_axis: str, seq_axis: str,
 
     def fn(q, k, v):
         return ulysses_attention(q, k, v, seq_axis, scale,
-                                 use_flash=use_flash, interpret=interpret)
+                                 use_flash=use_flash, interpret=interpret,
+                                 window=window)
 
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)

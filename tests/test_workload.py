@@ -206,3 +206,49 @@ def test_graft_dryrun_multichip(cpu8):
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_grad_accumulation_matches_full_batch(cpu8):
+    """accum_steps=2 must produce the same updated params and loss as the
+    plain full-batch step (equal microbatches + token-mean loss make the
+    averaged grads exactly the full-batch mean)."""
+    from kubegpu_tpu.workload.model import TransformerConfig
+    from kubegpu_tpu.workload.spmd import make_mesh
+    from kubegpu_tpu.workload.train import init_sharded, make_train_step
+
+    mesh = make_mesh(8, dp=2, sp=2, tp=2)
+    cfg = TransformerConfig(vocab=32, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, dtype="float32")
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 32)
+    outs = {}
+    for acc in (1, 2):
+        params, opt_state, optimizer = init_sharded(
+            jax.random.PRNGKey(0), cfg, mesh)
+        step = make_train_step(cfg, mesh, optimizer, accum_steps=acc)
+        params, _, loss = step(params, opt_state, tokens)
+        outs[acc] = (params, float(loss))
+    assert abs(outs[1][1] - outs[2][1]) < 1e-5
+    flat1 = jax.tree.leaves(outs[1][0])
+    flat2 = jax.tree.leaves(outs[2][0])
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_grad_accumulation_validation(cpu8):
+    from kubegpu_tpu.workload.model import TransformerConfig
+    from kubegpu_tpu.workload.spmd import make_mesh
+    from kubegpu_tpu.workload.train import init_sharded, make_train_step
+    import pytest as _pytest
+
+    mesh = make_mesh(8, dp=2, sp=2, tp=2)
+    cfg = TransformerConfig(vocab=32, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64)
+    with _pytest.raises(ValueError, match="accum_steps"):
+        make_train_step(cfg, mesh, accum_steps=0)
+    params, opt_state, optimizer = init_sharded(
+        jax.random.PRNGKey(0), cfg, mesh)
+    step = make_train_step(cfg, mesh, optimizer, accum_steps=3)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 32)
+    with _pytest.raises(ValueError, match="divisible"):
+        step(params, opt_state, tokens)
