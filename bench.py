@@ -101,8 +101,18 @@ def make_pod(name, numchips, pod_requests=None, hbm=0):
                                      "resources": {"requests": {"cpu": "1"}}}]}}
 
 
+_LIVE_CLUSTERS: list = []
+
+
 class Cluster:
     def __init__(self, inventories):
+        # Each Cluster's scheduler owns a 16-thread fit pool. Configs
+        # run back-to-back in one process, and dozens of leftover pools
+        # measurably skew the later latency configs (preempt p50 ran
+        # ~2x slower at the end of a full bench than standalone), so
+        # creating a cluster retires the previous one's pool first.
+        while _LIVE_CLUSTERS:
+            _LIVE_CLUSTERS.pop().close()
         self.api = InMemoryAPIServer()
         self.managers = {}
         for i, inv in enumerate(inventories):
@@ -118,6 +128,10 @@ class Cluster:
         ds = DevicesScheduler()
         ds.add_device(TPUScheduler())
         self.sched = Scheduler(self.api, ds)
+        _LIVE_CLUSTERS.append(self)
+
+    def close(self):
+        self.sched.stop()  # retires the fit pool; safe if never started
 
     def schedule_timed(self, pod) -> float | None:
         """Create + schedule one pod synchronously; returns latency seconds
@@ -293,6 +307,7 @@ def config_http():
     mem = InMemoryAPIServer()
     server, url = serve_api(mem)
     client = HTTPAPIClient(url)
+    sched = None
     try:
         for i in range(4):
             name = f"host{i}"
@@ -326,6 +341,8 @@ def config_http():
             sched.run_until_idle()
         return lat
     finally:
+        if sched is not None:
+            sched.stop()  # retire the fit pool like Cluster.close()
         client.close()
         server.shutdown()
 
@@ -838,6 +855,8 @@ def main():
     preempt_lat = config_preempt()
     per_config["preempt_64node_p50_ms"] = round(
         statistics.median(preempt_lat) * 1e3, 3)
+    while _LIVE_CLUSTERS:
+        _LIVE_CLUSTERS.pop().close()
     if not os.environ.get("KGTPU_BENCH_SKIP_WORKLOAD"):
         per_config.update(workload_metrics())
     result = {

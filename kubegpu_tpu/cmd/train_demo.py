@@ -119,13 +119,13 @@ def main(argv=None) -> int:
     # flag combination must fail up front, not after the last step when
     # an uncheckpointed session's params would be lost.
     gen = None
+    prompt_len = min(16, seq_len)
     if args.generate > 0:
         from kubegpu_tpu.workload.decode import make_generate
 
         gen = jax.jit(make_generate(cfg, mesh, temperature=args.temperature,
                                     top_k=args.top_k, top_p=args.top_p),
                       static_argnums=(2,))
-        prompt_len = min(16, seq_len)
         if prompt_len + args.generate > cfg.max_seq:
             ap.error(f"--generate {args.generate} + prompt {prompt_len} "
                      f"exceeds the model's max_seq {cfg.max_seq}")
@@ -184,7 +184,7 @@ def main(argv=None) -> int:
 
     if gen is not None:
         # full batch (a dp-sharded mesh can't split batch 1); print row 0
-        prompt = tokens[:, :min(16, seq_len)]
+        prompt = tokens[:, :prompt_len]
         toks = gen(params, prompt, args.generate,
                    jax.random.PRNGKey(args.seed))
         out["generated"] = np.asarray(toks)[0].tolist()

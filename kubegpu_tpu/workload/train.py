@@ -56,9 +56,12 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer=None,
     that many equal microbatches, gradients are averaged over a
     `lax.scan` of fwd+bwd passes, and ONE optimizer update applies —
     the standard trade of step latency for effective batch sizes whose
-    activations exceed HBM. Equal microbatch sizes make the averaged
-    loss/grads exactly the full-batch mean (the loss is token-mean), so
-    accum_steps changes memory, not semantics."""
+    activations exceed HBM. For dense configs equal microbatch sizes
+    make the averaged loss/grads exactly the full-batch mean (the loss
+    is token-mean). MoE configs are the usual approximation: the
+    load-balancing aux loss is nonlinear in the batch, so the averaged
+    per-microbatch aux differs slightly from the full-batch value —
+    the standard behavior of accumulated MoE training."""
     optimizer = optimizer or default_optimizer()
     loss_fn = make_loss_fn(cfg, mesh)
     if accum_steps < 1:
