@@ -42,6 +42,9 @@ def main(argv=None) -> int:
     ap.add_argument("--n-heads", type=int, default=None, help="default 4")
     ap.add_argument("--accum-steps", type=int, default=1,
                     help="gradient-accumulation microbatches per step")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="train rank-r LoRA adapters instead of the "
+                         "full model (0 = full fine-tune)")
     ap.add_argument("--remat", default="none",
                     choices=["none", "dots", "full"])
     ap.add_argument("--seed", type=int, default=0)
@@ -63,6 +66,8 @@ def main(argv=None) -> int:
         ap.error("--steps must be >= 1")
     if args.checkpoint_every < 1:
         ap.error("--checkpoint-every must be >= 1")
+    if args.lora_rank > 0 and args.accum_steps != 1:
+        ap.error("--accum-steps is not supported with --lora-rank")
 
     import jax
 
@@ -130,23 +135,48 @@ def main(argv=None) -> int:
             ap.error(f"--generate {args.generate} + prompt {prompt_len} "
                      f"exceeds the model's max_seq {cfg.max_seq}")
 
-    params, opt_state, optimizer = init_sharded(
-        jax.random.PRNGKey(args.seed), cfg, mesh)
-    step = make_train_step(cfg, mesh, optimizer,
-                           accum_steps=args.accum_steps)
+    lora = None
+    if args.lora_rank > 0:
+        # parameter-efficient fine-tune: adapters train, base is frozen
+        # (reproducible from --seed). init_optimizer=False skips the
+        # O(model) Adam moments entirely — optimizer state stays
+        # adapter-sized from the first allocation.
+        from kubegpu_tpu.workload.lora import (init_lora, merge_lora,
+                                               make_lora_train_step)
+
+        params, _, optimizer = init_sharded(
+            jax.random.PRNGKey(args.seed), cfg, mesh,
+            init_optimizer=False)
+        lora = init_lora(jax.random.PRNGKey(args.seed + 1), params,
+                         rank=args.lora_rank)
+        opt_state = optimizer.init(lora)
+        lora_step = make_lora_train_step(cfg, mesh, args.lora_rank,
+                                         optimizer)
+    else:
+        params, opt_state, optimizer = init_sharded(
+            jax.random.PRNGKey(args.seed), cfg, mesh)
+        step = make_train_step(cfg, mesh, optimizer,
+                               accum_steps=args.accum_steps)
 
     # elastic restart: a killed pod's replacement resumes from the last
     # saved step — the workload-side analogue of the scheduler rebuilding
-    # from annotations (docs/design.md failure model)
+    # from annotations (docs/design.md failure model). In LoRA mode the
+    # checkpoint carries the ADAPTERS (the base is reproducible from
+    # --seed), so resumable fine-tune state stays adapter-sized.
     start_step = 0
     if args.checkpoint_dir:
         from kubegpu_tpu.workload.checkpoint import (restore_checkpoint,
                                                      save_checkpoint)
 
-        state, at = restore_checkpoint(
-            args.checkpoint_dir, {"params": params, "opt_state": opt_state})
+        train_state = {"params": lora if lora is not None else params,
+                       "opt_state": opt_state}
+        state, at = restore_checkpoint(args.checkpoint_dir, train_state)
         if state is not None:
-            params, opt_state = state["params"], state["opt_state"]
+            if lora is not None:
+                lora = state["params"]
+            else:
+                params = state["params"]
+            opt_state = state["opt_state"]
             start_step = at
 
     loader = make_loader(paths, args.batch, seq_len, seed=args.seed)
@@ -162,12 +192,18 @@ def main(argv=None) -> int:
             next(loader)
         for i in range(start_step, start_step + args.steps):
             tokens = jax.numpy.asarray(next(loader))
-            params, opt_state, loss = step(params, opt_state, tokens)
+            if lora is not None:
+                lora, opt_state, loss = lora_step(lora, opt_state, params,
+                                                  tokens)
+            else:
+                params, opt_state, loss = step(params, opt_state, tokens)
             losses.append(float(jax.device_get(loss)))
             if args.checkpoint_dir and (i + 1) % args.checkpoint_every == 0:
-                save_checkpoint(args.checkpoint_dir,
-                                {"params": params, "opt_state": opt_state},
-                                step=i + 1)
+                save_checkpoint(
+                    args.checkpoint_dir,
+                    {"params": lora if lora is not None else params,
+                     "opt_state": opt_state},
+                    step=i + 1)
     finally:
         loader.close()
     wall = time.perf_counter() - t0
@@ -184,8 +220,10 @@ def main(argv=None) -> int:
 
     if gen is not None:
         # full batch (a dp-sharded mesh can't split batch 1); print row 0
+        gen_params = params if lora is None else \
+            merge_lora(params, lora, 1.0)  # matches the step's alpha/r = 1
         prompt = tokens[:, :prompt_len]
-        toks = gen(params, prompt, args.generate,
+        toks = gen(gen_params, prompt, args.generate,
                    jax.random.PRNGKey(args.seed))
         out["generated"] = np.asarray(toks)[0].tolist()
 

@@ -24,8 +24,15 @@ def default_optimizer(lr: float = 3e-4):
     return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01)
 
 
-def init_sharded(rng, cfg: TransformerConfig, mesh, optimizer=None):
-    """Initialize params (+ optimizer state) already laid out on the mesh."""
+def init_sharded(rng, cfg: TransformerConfig, mesh, optimizer=None,
+                 init_optimizer: bool = True):
+    """Initialize params (+ optimizer state) already laid out on the mesh.
+
+    ``init_optimizer=False`` returns ``opt_state=None`` without ever
+    materializing the O(model) moment tensors — LoRA fine-tuning keeps
+    only adapter-sized optimizer state, so allocating (then discarding)
+    full-model Adam moments would defeat the point and can OOM exactly
+    the large-model case adapters exist to fit."""
     optimizer = optimizer or default_optimizer()
     specs = spmd.param_pspecs(cfg)
     from jax.sharding import NamedSharding, PartitionSpec
@@ -34,6 +41,8 @@ def init_sharded(rng, cfg: TransformerConfig, mesh, optimizer=None):
                              is_leaf=lambda x: isinstance(x, PartitionSpec))
     init = jax.jit(partial(init_params, cfg=cfg), out_shardings=shardings)
     params = init(rng)
+    if not init_optimizer:
+        return params, None, optimizer
     opt_state = jax.jit(optimizer.init)(params)
     # moment leaves inherit the params' NamedShardings, but scalar state
     # (Adam's count) falls out of jit committed to device 0 — replicate
