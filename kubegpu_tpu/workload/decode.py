@@ -62,7 +62,12 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
     (logits, new_cache)``: process a chunk of ``tokens [B, T]`` whose
     first token sits at absolute position ``start_pos``, attending over
     everything cached so far plus the chunk itself. Used with T=prompt
-    length for prefill and T=1 for decode."""
+    length for prefill and T=1 for decode.
+
+    ``start_pos`` may be a scalar (whole batch at one depth — the
+    `make_generate` path) or a ``[B]`` vector of PER-ROW positions —
+    what continuous batching needs, where each slot sits at its own
+    generation depth (`kubegpu_tpu.workload.serve`)."""
 
     def constrain(x, *spec):
         if mesh is None:
@@ -77,16 +82,28 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
         b, t = tokens.shape
         s_max = cache[0]["k"].shape[1]
         scale = cfg.head_dim ** -0.5
+        start_pos = jnp.asarray(start_pos)
+        per_row = start_pos.ndim == 1
+        row_start = jnp.broadcast_to(start_pos, (b,))  # [B] either way
         x = params["embed"].astype(dt)[tokens]
         x = constrain(x, spmd.AXIS_DATA, None, None)
-        positions = start_pos + jnp.broadcast_to(jnp.arange(t), (b, t))
-        # chunk position i attends cache positions <= start_pos + i
+        positions = row_start[:, None] + jnp.arange(t)[None, :]
+        # chunk position i attends cache positions <= row_start + i
         # (and, with a sliding window, only the newest window of them)
         kv_pos = jnp.arange(s_max)
-        q_pos = (start_pos + jnp.arange(t))[:, None]
-        mask = kv_pos[None, :] <= q_pos
+        q_pos = row_start[:, None, None] + jnp.arange(t)[None, :, None]
+        mask = kv_pos[None, None, :] <= q_pos          # [B, T, S]
         if cfg.attn_window:
-            mask &= kv_pos[None, :] > q_pos - cfg.attn_window
+            mask &= kv_pos[None, None, :] > q_pos - cfg.attn_window
+
+        def update_cache(buf, new):
+            """Write the [B, T, ...] chunk at each row's own offset."""
+            if not per_row:
+                return lax.dynamic_update_slice(
+                    buf, new, (0, start_pos, 0, 0))
+            return jax.vmap(
+                lambda row_buf, row_new, p: lax.dynamic_update_slice(
+                    row_buf, row_new, (p, 0, 0)))(buf, new, row_start)
 
         new_cache = []
         for layer, kv in zip(params["layers"], cache):
@@ -99,10 +116,8 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
                                                      cfg.head_dim)
             q = _rope(q, positions, cfg.rope_theta)
             k = _rope(k, positions, cfg.rope_theta)
-            ck = lax.dynamic_update_slice(kv["k"], k.astype(dt),
-                                          (0, start_pos, 0, 0))
-            cv = lax.dynamic_update_slice(kv["v"], v.astype(dt),
-                                          (0, start_pos, 0, 0))
+            ck = update_cache(kv["k"], k.astype(dt))
+            cv = update_cache(kv["v"], v.astype(dt))
             new_cache.append({"k": ck, "v": cv})
 
             # bf16 operands, f32 accumulation — MXU-native (see
@@ -116,7 +131,7 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
                 qg = q.reshape(b, t, cfg.kv_heads, rep, cfg.head_dim)
                 s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck,
                                preferred_element_type=jnp.float32) * scale
-                s = jnp.where(mask[None, None, None], s, NEG_INF)
+                s = jnp.where(mask[:, None, None], s, NEG_INF)
                 p = jax.nn.softmax(s, axis=-1)
                 attn = jnp.einsum("bgrqk,bkgd->bqgrd", p.astype(dt), cv,
                                   preferred_element_type=jnp.float32)
@@ -124,7 +139,7 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
             else:
                 s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                                preferred_element_type=jnp.float32) * scale
-                s = jnp.where(mask[None, None], s, NEG_INF)
+                s = jnp.where(mask[:, None], s, NEG_INF)
                 p = jax.nn.softmax(s, axis=-1)
                 attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), cv,
                                   preferred_element_type=jnp.float32)
