@@ -166,6 +166,27 @@ def make_forward_step(cfg: TransformerConfig, mesh=None):
     return step
 
 
+def validate_sampling(cfg: TransformerConfig, temperature: float,
+                      top_k: int, top_p: float) -> int:
+    """Shared validation + clamp for every decode entry point
+    (`make_generate`, `serve.DecodeServer`): raises on out-of-range
+    values, rejects truncation flags under greedy (they would be
+    silently ignored), and returns ``top_k`` clamped to the vocab
+    (k >= vocab keeps every token — same distribution — so clamping
+    beats an obscure lax.top_k shape error at trace time)."""
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if temperature == 0.0 and (top_k or top_p < 1.0):
+        raise ValueError(
+            "top_k/top_p truncate SAMPLING and are ignored by greedy "
+            "decode — set temperature > 0 to use them")
+    return min(top_k, cfg.vocab)
+
+
 def _select_token(logits, key, temperature: float, top_k: int,
                   top_p: float):
     """Pick the next token per batch row from ``logits [B, V]``.
@@ -210,19 +231,7 @@ def make_generate(cfg: TransformerConfig, mesh=None,
     max_seq = max_seq or cfg.max_seq
     step = make_forward_step(cfg, mesh)
     sampling = temperature != 0.0
-    if temperature < 0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
-    if not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if top_k < 0:
-        raise ValueError(f"top_k must be >= 0, got {top_k}")
-    if not sampling and (top_k or top_p < 1.0):
-        raise ValueError(
-            "top_k/top_p truncate SAMPLING and are ignored by greedy "
-            "decode — set temperature > 0 to use them")
-    # k >= vocab keeps every token: same distribution, so clamp rather
-    # than let lax.top_k fail an obscure shape check at trace time
-    top_k = min(top_k, cfg.vocab)
+    top_k = validate_sampling(cfg, temperature, top_k, top_p)
 
     def generate(params, prompt, n_new: int, rng=None):
         if sampling and rng is None:

@@ -41,7 +41,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubegpu_tpu.workload.decode import (_select_token, init_cache,
-                                         make_forward_step)
+                                         make_forward_step,
+                                         validate_sampling)
 from kubegpu_tpu.workload.model import TransformerConfig
 
 
@@ -76,21 +77,16 @@ class DecodeServer:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: int | None = None,
                  prefill_buckets: tuple = (32, 128, 512), rng=None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
         self.cfg = cfg
         self.params = params
         self.slots = slots
         self.max_seq = max_seq or cfg.max_seq
         self.eos_id = eos_id
         self.temperature = float(temperature)
-        if self.temperature < 0:
-            raise ValueError("temperature must be >= 0")
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        if top_k < 0:
-            raise ValueError(f"top_k must be >= 0, got {top_k}")
-        if self.temperature == 0.0 and (top_k or top_p < 1.0):
-            raise ValueError("top_k/top_p need temperature > 0")
-        self.top_k = int(min(top_k, cfg.vocab))
+        self.top_k = int(validate_sampling(cfg, self.temperature, top_k,
+                                           top_p))
         self.top_p = float(top_p)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         # max_seq is always the terminal bucket: any prompt that fits the
@@ -147,7 +143,6 @@ class DecodeServer:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new} exceeds "
                 f"max_seq {self.max_seq}")
-        _bucket_for(len(prompt), self.buckets)  # fail fast, not at admit
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, list(prompt), max_new)

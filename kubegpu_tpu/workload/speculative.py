@@ -1,0 +1,144 @@
+"""Speculative decoding: draft-proposes, target-verifies, greedy-exact.
+
+The standard two-model speedup for autoregressive decoding: a small
+draft model proposes ``k`` tokens with cheap sequential steps, the large
+target model scores all of them in ONE forward pass (sequential decode
+becomes a parallel verify), and the longest agreeing prefix is accepted
+plus the target's own next token. With greedy selection the output is
+EXACTLY the target model's greedy sequence — acceptance only changes
+how many target forwards it takes, never the tokens (asserted by
+tests/test_speculative.py).
+
+TPU-static design: every device program has fixed shapes — the draft
+proposal is a ``k``-step `lax.scan`, the verify is one ``k+1``-token
+chunked forward (`make_forward_step`), and the data-dependent acceptance
+length only travels to the host as a scalar. Rejected positions leave
+stale K/V in both caches; that is safe for the same reason the serve
+loop's padded prefill is: position ``p`` is rewritten exactly when the
+real token at ``p`` is processed, and queries only attend positions
+that have been rewritten.
+
+The reference has no serving runtime at all (SURVEY.md §0); this module
+is part of the workload layer the TPU build ships beyond it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kubegpu_tpu.workload.decode import init_cache, make_forward_step
+from kubegpu_tpu.workload.model import TransformerConfig
+
+
+def make_speculative_generate(target_cfg: TransformerConfig,
+                              draft_cfg: TransformerConfig,
+                              k: int = 4, mesh=None,
+                              max_seq: int | None = None):
+    """Build ``generate(target_params, draft_params, prompt, n_new) ->
+    (tokens [B=1 row list], target_calls)``.
+
+    Greedy-only: greedy acceptance is exact, so sampling would need the
+    rejection-resampling scheme — out of scope here. ``k`` is the draft
+    lookahead per round. Both models must share the vocab.
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if target_cfg.vocab != draft_cfg.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    max_seq = max_seq or min(target_cfg.max_seq, draft_cfg.max_seq)
+    t_step = make_forward_step(target_cfg, mesh)
+    d_step = make_forward_step(draft_cfg, mesh)
+
+    def prefill(params, step, cache, prompt):
+        logits, cache = step(params, cache, prompt, 0)
+        return cache, jnp.argmax(logits[:, -1, :], axis=-1)
+
+    prefill_t = jax.jit(lambda p, c, x: prefill(p, t_step, c, x))
+    prefill_d = jax.jit(lambda p, c, x: prefill(p, d_step, c, x))
+
+    def draft_propose(params, cache, prev, token, pos):
+        """k greedy draft proposals from ``token`` at ``pos``.
+
+        The first step processes the 2-token chunk ``[prev, token]`` at
+        ``pos-1``: after a fully-accepted round the draft never
+        processed its own k-th proposal, leaving a K/V hole at exactly
+        ``pos-1`` — re-processing ``prev`` there fills the hole (and is
+        an idempotent rewrite when no hole exists). Without this, the
+        round after a full accept proposes against a zeroed cache row
+        and acceptance collapses."""
+        chunk = jnp.stack([prev, token], axis=1)        # [1, 2]
+        logits, cache = d_step(params, cache, chunk, pos - 1)
+        first = jnp.argmax(logits[:, -1, :], axis=-1)
+
+        def body(carry, _):
+            cache, tok, p = carry
+            logits, cache = d_step(params, cache, tok[:, None], p)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+            return (cache, nxt, p + 1), nxt
+
+        (cache, _, _), toks = lax.scan(
+            body, (cache, first, pos + 1), None, length=k - 1)
+        drafts = jnp.concatenate([first, toks[:, 0]]) if k > 1 else first
+        return cache, drafts  # [k]
+
+    draft_propose = jax.jit(draft_propose)
+
+    def verify(params, cache, chunk, pos):
+        """One target forward over ``chunk [1, k+1]`` (last accepted token
+        + k draft tokens) at ``pos``; returns the target's greedy token
+        AFTER each chunk position ([k+1]) and the number of accepted
+        draft tokens."""
+        logits, cache = t_step(params, cache, chunk, pos)
+        greedy = jnp.argmax(logits[0], axis=-1)           # [k+1]
+        drafts = chunk[0, 1:]                             # [k]
+        agree = drafts == greedy[:-1]
+        n_acc = jnp.argmin(jnp.concatenate(
+            [agree, jnp.array([False])]).astype(jnp.int32))
+        return cache, greedy, n_acc
+
+    verify = jax.jit(verify)
+
+    def generate(target_params, draft_params, prompt, n_new: int):
+        if n_new < 1:
+            raise ValueError(f"n_new must be >= 1, got {n_new}")
+        prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
+        t0 = prompt.shape[1]
+        if t0 + n_new + k + 1 > max_seq:
+            raise ValueError(
+                f"prompt ({t0}) + n_new ({n_new}) + lookahead ({k + 1}) "
+                f"exceeds max_seq ({max_seq})")
+        t_cache = init_cache(target_cfg, 1, max_seq)
+        d_cache = init_cache(draft_cfg, 1, max_seq)
+        t_cache, first = prefill_t(target_params, t_cache, prompt)
+        d_cache, _ = prefill_d(draft_params, d_cache, prompt)
+
+        out = [int(np.asarray(first)[0])]
+        pos = t0            # both caches hold [0, t0); `first` unprocessed
+        target_calls = 1
+        last = first        # [1] last accepted-but-unprocessed token
+        prev = prompt[:, -1]  # token at pos-1 (draft catch-up anchor)
+        while len(out) < n_new:
+            d_cache, drafts = draft_propose(draft_params, d_cache, prev,
+                                            last, jnp.int32(pos))
+            chunk = jnp.concatenate([last, drafts]).reshape(1, k + 1)
+            t_cache, greedy, n_acc = verify(target_params, t_cache, chunk,
+                                            jnp.int32(pos))
+            target_calls += 1
+            n_acc = int(n_acc)
+            greedy = np.asarray(greedy)
+            drafts_np = np.asarray(drafts)
+            # accepted draft tokens, then the target's own next token
+            # (the correction on mismatch; the bonus when all k agree)
+            new = [int(x) for x in drafts_np[:n_acc]] + [int(greedy[n_acc])]
+            out.extend(new)
+            pos += n_acc + 1
+            last = jnp.asarray([out[-1]], jnp.int32)
+            # next round's anchor = token at the new pos-1, which is
+            # chunk[0][n_acc] for every acceptance count
+            prev = chunk[:, n_acc]
+        return out[:n_new], target_calls
+
+    return generate

@@ -121,3 +121,9 @@ def test_prompt_beyond_configured_buckets_uses_max_seq_bucket(setup):
     rid = srv.submit(prompt, max_new=3)
     srv.run()
     assert srv.result(rid) == _greedy_reference(cfg, params, prompt, 3)
+
+
+def test_slots_validation(setup):
+    cfg, params = setup
+    with pytest.raises(ValueError, match="slots"):
+        DecodeServer(cfg, params, slots=0)

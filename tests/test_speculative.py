@@ -1,0 +1,83 @@
+"""Speculative decoding: greedy-exactness against the target model,
+fewer target calls when the draft agrees, and validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubegpu_tpu.workload.decode import make_generate
+from kubegpu_tpu.workload.model import TransformerConfig, init_params
+from kubegpu_tpu.workload.speculative import make_speculative_generate
+
+from tests.test_workload import cpu8  # noqa: F401  (fixture)
+
+
+def cfg_of(layers, seed_dim=32, **kw):
+    base = dict(vocab=64, d_model=seed_dim, n_heads=4, n_layers=layers,
+                d_ff=64, max_seq=128, attn_impl="xla", dtype="float32")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def models():
+    target_cfg = cfg_of(3)
+    draft_cfg = cfg_of(1)
+    target = init_params(jax.random.PRNGKey(0), target_cfg)
+    draft = init_params(jax.random.PRNGKey(7), draft_cfg)
+    return target_cfg, target, draft_cfg, draft
+
+
+def _target_greedy(cfg, params, prompt, n_new):
+    gen = jax.jit(make_generate(cfg), static_argnums=(2,))
+    return np.asarray(
+        gen(params, jnp.asarray([prompt], jnp.int32), n_new))[0].tolist()
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_exactly_matches_target_greedy(models, k):
+    """Whatever the draft proposes, the output is the target's greedy
+    sequence — acceptance changes speed, never tokens."""
+    target_cfg, target, draft_cfg, draft = models
+    gen = make_speculative_generate(target_cfg, draft_cfg, k=k)
+    prompt = [3, 1, 4, 1, 5]
+    want = _target_greedy(target_cfg, target, prompt, 12)
+    got, _ = gen(target, draft, prompt, 12)
+    assert got == want, (k, got, want)
+
+
+def test_perfect_draft_needs_few_target_calls(models):
+    """Draft == target accepts everything: target forwards ~ n_new/(k+1)
+    instead of n_new."""
+    target_cfg, target, _, _ = models
+    gen = make_speculative_generate(target_cfg, target_cfg, k=4)
+    prompt = [9, 8, 7]
+    n_new = 15
+    got, calls = gen(target, target, prompt, n_new)
+    assert got == _target_greedy(target_cfg, target, prompt, n_new)
+    # prefill + ceil((n_new-1)/(k+1)) rounds when everything is accepted
+    assert calls <= 1 + -(-(n_new - 1) // 5), calls
+
+
+def test_weak_draft_still_exact_and_bounded(models):
+    target_cfg, target, draft_cfg, draft = models
+    gen = make_speculative_generate(target_cfg, draft_cfg, k=2)
+    prompt = [1, 2]
+    n_new = 10
+    got, calls = gen(target, draft, prompt, n_new)
+    assert got == _target_greedy(target_cfg, target, prompt, n_new)
+    assert calls <= n_new  # never worse than one verify per token
+
+
+def test_validation(models):
+    target_cfg, target, draft_cfg, draft = models
+    with pytest.raises(ValueError, match="k must"):
+        make_speculative_generate(target_cfg, draft_cfg, k=0)
+    with pytest.raises(ValueError, match="vocab"):
+        make_speculative_generate(target_cfg, cfg_of(1, vocab=32))
+    gen = make_speculative_generate(target_cfg, draft_cfg, k=2)
+    with pytest.raises(ValueError, match="n_new"):
+        gen(target, draft, [1, 2], 0)
+    with pytest.raises(ValueError, match="max_seq"):
+        gen(target, draft, [1] * 120, 10)
