@@ -18,8 +18,13 @@ loop's padded prefill is: position ``p`` is rewritten exactly when the
 real token at ``p`` is processed, and queries only attend positions
 that have been rewritten.
 
-The reference has no serving runtime at all (SURVEY.md §0); this module
-is part of the workload layer the TPU build ships beyond it.
+Numerics: exactness vs `make_generate` holds bit-for-bit in float32
+(asserted by tests). On TPU in bfloat16 the (k+1)-chunk verify rounds
+differently than the reference's one-token steps (MXU results are
+shape-dependent), so near-tie argmaxes can flip — the same documented
+class as the serve loop's padded prefill and immaterial for trained
+models. Acceptance is unaffected: a self-draft run on a real v5e hit
+12 target calls for 48 tokens (ideal 11).
 """
 
 from __future__ import annotations
@@ -110,8 +115,14 @@ def make_speculative_generate(target_cfg: TransformerConfig,
             raise ValueError(
                 f"prompt ({t0}) + n_new ({n_new}) + lookahead ({k + 1}) "
                 f"exceeds max_seq ({max_seq})")
-        t_cache = init_cache(target_cfg, 1, max_seq)
-        d_cache = init_cache(draft_cfg, 1, max_seq)
+        # Horizon-sized caches, exactly as decode.make_generate: the
+        # full-cache attention read is the HBM traffic that bounds
+        # decode, and positions past this call's reach contribute zero.
+        # +k+1: verify may write up to k+1 positions past the last
+        # emitted token before truncation.
+        horizon = min(max_seq, -(-(t0 + n_new + k + 1) // 128) * 128)
+        t_cache = init_cache(target_cfg, 1, horizon)
+        d_cache = init_cache(draft_cfg, 1, horizon)
         t_cache, first = prefill_t(target_params, t_cache, prompt)
         d_cache, _ = prefill_d(draft_params, d_cache, prompt)
 
