@@ -1,0 +1,124 @@
+"""Serving demo binary: continuous-batching decode over synthetic requests.
+
+The serving counterpart of `cmd/train_demo.py`: builds a model (fresh
+from --seed, or restored from a train_demo --checkpoint-dir), submits a
+stream of synthetic requests with mixed prompt lengths, drives the
+slot-based `DecodeServer`, and prints one JSON line of stats. With
+--speculative, the same requests run through greedy speculative decoding
+with a smaller draft model instead.
+
+Examples:
+    python -m kubegpu_tpu.cmd.serve_demo --requests 8 --slots 4
+    python -m kubegpu_tpu.cmd.serve_demo --temperature 0.8 --top-p 0.9
+    python -m kubegpu_tpu.cmd.serve_demo --speculative --draft-layers 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=256, help="model max_seq")
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="restore params saved by train_demo (full "
+                         "fine-tune checkpoints only)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="greedy speculative decoding with a draft model")
+    ap.add_argument("--draft-layers", type=int, default=1)
+    ap.add_argument("--lookahead", type=int, default=4,
+                    help="draft tokens per speculative round (k)")
+    args = ap.parse_args(argv)
+    if args.requests < 1:
+        ap.error("--requests must be >= 1")
+    if args.speculative and args.temperature != 0.0:
+        ap.error("--speculative is greedy-only; drop --temperature")
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+    import numpy as np
+
+    from kubegpu_tpu.workload.model import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab=args.vocab, d_model=args.d_model,
+                            n_heads=args.n_heads, n_layers=args.n_layers,
+                            d_ff=4 * args.d_model, max_seq=args.seq)
+    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    if args.checkpoint_dir:
+        from kubegpu_tpu.workload.checkpoint import restore_checkpoint
+
+        state, at = restore_checkpoint(
+            args.checkpoint_dir, {"params": params, "opt_state": None})
+        if state is None:
+            ap.error(f"no checkpoint found in {args.checkpoint_dir}")
+        params = state["params"]
+
+    rng = np.random.default_rng(args.seed)
+    prompts = [[int(t) for t in rng.integers(1, cfg.vocab,
+                                             int(rng.integers(4, 24)))]
+               for _ in range(args.requests)]
+
+    t0 = time.perf_counter()
+    if args.speculative:
+        from kubegpu_tpu.workload.speculative import (
+            make_speculative_generate)
+
+        draft_cfg = TransformerConfig(
+            vocab=args.vocab, d_model=max(32, args.d_model // 4),
+            n_heads=args.n_heads, n_layers=args.draft_layers,
+            d_ff=args.d_model, max_seq=args.seq)
+        draft = init_params(jax.random.PRNGKey(args.seed + 1), draft_cfg)
+        gen = make_speculative_generate(cfg, draft_cfg, k=args.lookahead)
+        outs, calls = [], 0
+        for p in prompts:
+            out, c = gen(params, draft, p, args.max_new)
+            outs.append(out)
+            calls += c
+        stats = {"mode": "speculative", "target_calls": calls,
+                 "tokens": sum(len(o) for o in outs)}
+    else:
+        from kubegpu_tpu.workload.serve import DecodeServer
+
+        srv = DecodeServer(cfg, params, slots=args.slots,
+                           temperature=args.temperature, top_k=args.top_k,
+                           top_p=args.top_p,
+                           rng=jax.random.PRNGKey(args.seed))
+        rids = [srv.submit(p, max_new=args.max_new) for p in prompts]
+        srv.run()
+        outs = [srv.result(r) for r in rids]
+        stats = {"mode": "serve", "slots": args.slots,
+                 "tokens": sum(len(o) for o in outs)}
+    wall = time.perf_counter() - t0
+
+    stats.update({
+        "requests": args.requests,
+        "wall_s": round(wall, 2),
+        "tokens_per_s": round(stats["tokens"] / wall, 1),
+        "first_output": outs[0][:8],
+    })
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -127,3 +127,30 @@ def test_slots_validation(setup):
     cfg, params = setup
     with pytest.raises(ValueError, match="slots"):
         DecodeServer(cfg, params, slots=0)
+
+
+def test_serve_demo_cli(tmp_path):
+    """The serving binary runs both modes end to end."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**{k: v for k, v in os.environ.items()
+              if k != "PALLAS_AXON_POOL_IPS"}, "JAX_PLATFORMS": "cpu"}
+    base = [sys.executable, "-m", "kubegpu_tpu.cmd.serve_demo",
+            "--requests", "3", "--slots", "2", "--max-new", "5",
+            "--d-model", "32", "--n-layers", "1", "--seq", "64"]
+    r = subprocess.run(base, capture_output=True, text=True, timeout=300,
+                       env=env, cwd=repo)
+    assert r.returncode == 0, r.stderr[-1500:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["mode"] == "serve" and out["tokens"] == 15
+    r = subprocess.run(base + ["--speculative", "--lookahead", "2"],
+                       capture_output=True, text=True, timeout=300,
+                       env=env, cwd=repo)
+    assert r.returncode == 0, r.stderr[-1500:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["mode"] == "speculative" and out["tokens"] == 15
+    assert out["target_calls"] <= 15
