@@ -4,8 +4,9 @@ The serving counterpart of `cmd/train_demo.py`: builds a model (fresh
 from --seed, or restored from a train_demo --checkpoint-dir), submits a
 stream of synthetic requests with mixed prompt lengths, drives the
 slot-based `DecodeServer`, and prints one JSON line of stats. With
---speculative, the same requests run through greedy speculative decoding
-with a smaller draft model instead.
+--speculative, the same requests run through speculative decoding with a
+smaller auto-built draft model instead — greedy, or temperature-sampled
+when --temperature is set (full-softmax pair only; no --top-k/--top-p).
 
 Examples:
     python -m kubegpu_tpu.cmd.serve_demo --requests 8 --slots 4
@@ -39,15 +40,18 @@ def main(argv=None) -> int:
                     help="restore params saved by train_demo (full "
                          "fine-tune checkpoints only)")
     ap.add_argument("--speculative", action="store_true",
-                    help="greedy speculative decoding with a draft model")
+                    help="speculative decoding with a draft model "
+                         "(greedy, or sampled when --temperature is set)")
     ap.add_argument("--draft-layers", type=int, default=1)
     ap.add_argument("--lookahead", type=int, default=4,
                     help="draft tokens per speculative round (k)")
     args = ap.parse_args(argv)
     if args.requests < 1:
         ap.error("--requests must be >= 1")
-    if args.speculative and args.temperature != 0.0:
-        ap.error("--speculative is greedy-only; drop --temperature")
+    if args.speculative and (args.top_k or args.top_p < 1.0):
+        ap.error("--speculative sampling is temperature-only "
+                 "(no --top-k/--top-p; the exactness proof is for the "
+                 "full softmax pair)")
 
     import jax
 
@@ -88,10 +92,12 @@ def main(argv=None) -> int:
             n_heads=args.n_heads, n_layers=args.draft_layers,
             d_ff=args.d_model, max_seq=args.seq)
         draft = init_params(jax.random.PRNGKey(args.seed + 1), draft_cfg)
-        gen = make_speculative_generate(cfg, draft_cfg, k=args.lookahead)
+        gen = make_speculative_generate(cfg, draft_cfg, k=args.lookahead,
+                                        temperature=args.temperature)
         outs, calls = [], 0
-        for p in prompts:
-            out, c = gen(params, draft, p, args.max_new)
+        for i, p in enumerate(prompts):
+            out, c = gen(params, draft, p, args.max_new,
+                         jax.random.PRNGKey(args.seed + 100 + i))
             outs.append(out)
             calls += c
         stats = {"mode": "speculative", "target_calls": calls,

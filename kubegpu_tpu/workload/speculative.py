@@ -1,13 +1,21 @@
-"""Speculative decoding: draft-proposes, target-verifies, greedy-exact.
+"""Speculative decoding: draft-proposes, target-verifies, exact.
 
 The standard two-model speedup for autoregressive decoding: a small
 draft model proposes ``k`` tokens with cheap sequential steps, the large
 target model scores all of them in ONE forward pass (sequential decode
-becomes a parallel verify), and the longest agreeing prefix is accepted
-plus the target's own next token. With greedy selection the output is
-EXACTLY the target model's greedy sequence — acceptance only changes
-how many target forwards it takes, never the tokens (asserted by
-tests/test_speculative.py).
+becomes a parallel verify), and the longest accepted prefix is emitted
+plus one more token. Two modes, both exact:
+
+- **greedy** (``temperature == 0``): accept while the draft token equals
+  the target argmax; the output is EXACTLY the target model's greedy
+  sequence — acceptance only changes how many target forwards it takes,
+  never the tokens (asserted by tests/test_speculative.py);
+- **sampled** (``temperature > 0``): the rejection-resampling acceptance
+  rule (`accept_resample`) — accept draft ``d`` with probability
+  ``min(1, p(d)/q(d))``, resample the first rejection from
+  ``normalize(max(p - q, 0))`` — under which every emitted token is
+  distributed exactly as temperature-sampling the target, whatever the
+  draft proposes (asserted statistically).
 
 TPU-static design: every device program has fixed shapes — the draft
 proposal is a ``k``-step `lax.scan`, the verify is one ``k+1``-token
@@ -38,34 +46,95 @@ from kubegpu_tpu.workload.decode import init_cache, make_forward_step
 from kubegpu_tpu.workload.model import TransformerConfig
 
 
+def accept_resample(p_rows, q_rows, drafts, key):
+    """Rejection-resampling acceptance (the speculative-sampling rule).
+
+    ``p_rows [k+1, V]``: target distribution after each chunk position;
+    ``q_rows [k, V]``: the draft distribution each proposal was SAMPLED
+    from; ``drafts [k]``. Accepts draft ``i`` with probability
+    ``min(1, p_i(d_i) / q_i(d_i))``; on the first rejection emits a
+    sample from ``normalize(max(p_i - q_i, 0))``; when all ``k`` are
+    accepted emits a bonus sample from ``p_k``. Returns
+    ``(n_acc, extra_token)`` — the emitted round is
+    ``drafts[:n_acc] + [extra]``, and the theorem guarantees every
+    emitted token is distributed EXACTLY as sampling the target
+    (asserted statistically by tests/test_speculative.py)."""
+    k = drafts.shape[0]
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, (k,))
+    idx = jnp.arange(k)
+    p_d = p_rows[idx, drafts]
+    q_d = q_rows[idx, drafts]
+    accept = u * q_d < p_d                       # u < p/q with q > 0
+    n_acc = jnp.argmin(jnp.concatenate(
+        [accept, jnp.array([False])]).astype(jnp.int32))
+    # residual at the rejection point; plain p for the bonus position
+    q_pad = jnp.concatenate([q_rows, jnp.zeros_like(p_rows[:1])])
+    resid = jnp.maximum(p_rows[n_acc] - q_pad[n_acc], 0.0)
+    mass = jnp.sum(resid)
+    # p == q exactly cannot reject (u < 1), so mass > 0 on the reject
+    # path mathematically; guard the float edge by falling back to p
+    resid = jnp.where(mass > 1e-9, resid / jnp.maximum(mass, 1e-9),
+                      p_rows[n_acc])
+    extra = jax.random.categorical(kr, jnp.log(jnp.maximum(resid, 1e-30)))
+    return n_acc, extra
+
+
 def make_speculative_generate(target_cfg: TransformerConfig,
                               draft_cfg: TransformerConfig,
                               k: int = 4, mesh=None,
-                              max_seq: int | None = None):
-    """Build ``generate(target_params, draft_params, prompt, n_new) ->
-    (tokens [B=1 row list], target_calls)``.
+                              max_seq: int | None = None,
+                              temperature: float = 0.0):
+    """Build ``generate(target_params, draft_params, prompt, n_new[, rng])
+    -> (tokens [B=1 row list], target_calls)``.
 
-    Greedy-only: greedy acceptance is exact, so sampling would need the
-    rejection-resampling scheme — out of scope here. ``k`` is the draft
-    lookahead per round. Both models must share the vocab.
+    ``temperature == 0`` (default) is greedy speculative decoding —
+    output EXACTLY the target's greedy sequence. ``temperature > 0`` is
+    speculative SAMPLING with the rejection-resampling acceptance rule
+    (`accept_resample`): every emitted token is distributed exactly as
+    temperature-sampling the target, whatever the draft proposes
+    (top-k/top-p truncation is not offered here — the exactness proof
+    is for the full softmax pair). ``k`` is the draft lookahead per
+    round. Both models must share the vocab.
     """
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     if target_cfg.vocab != draft_cfg.vocab:
         raise ValueError("draft and target must share a vocabulary")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    sampling = temperature != 0.0
     max_seq = max_seq or min(target_cfg.max_seq, draft_cfg.max_seq)
     t_step = make_forward_step(target_cfg, mesh)
     d_step = make_forward_step(draft_cfg, mesh)
 
-    def prefill(params, step, cache, prompt):
+    def probs(logits):
+        return jax.nn.softmax(logits.astype(jnp.float32) / temperature,
+                              axis=-1)
+
+    def prefill(params, step, cache, prompt, key):
         logits, cache = step(params, cache, prompt, 0)
-        return cache, jnp.argmax(logits[:, -1, :], axis=-1)
+        if sampling:
+            tok = jax.random.categorical(
+                key, logits[:, -1, :].astype(jnp.float32) / temperature)
+        else:
+            tok = jnp.argmax(logits[:, -1, :], axis=-1)
+        return cache, tok
 
-    prefill_t = jax.jit(lambda p, c, x: prefill(p, t_step, c, x))
-    prefill_d = jax.jit(lambda p, c, x: prefill(p, d_step, c, x))
+    prefill_t = jax.jit(lambda p, c, x, s: prefill(p, t_step, c, x, s))
+    prefill_d = jax.jit(lambda p, c, x, s: prefill(p, d_step, c, x, s))
 
-    def draft_propose(params, cache, prev, token, pos):
-        """k greedy draft proposals from ``token`` at ``pos``.
+    def pick(logits, key):
+        """Next token (and its full distribution row when sampling)."""
+        if sampling:
+            p = probs(logits)
+            return jax.random.categorical(key, jnp.log(p)), p
+        return jnp.argmax(logits, axis=-1), None
+
+    def draft_propose(params, cache, prev, token, pos, key):
+        """k draft proposals (greedy or sampled) from ``token`` at
+        ``pos``; when sampling, also the ``[k, V]`` distributions each
+        proposal was drawn from (the acceptance rule needs them).
 
         The first step processes the 2-token chunk ``[prev, token]`` at
         ``pos-1``: after a fully-accepted round the draft never
@@ -76,27 +145,35 @@ def make_speculative_generate(target_cfg: TransformerConfig,
         and acceptance collapses."""
         chunk = jnp.stack([prev, token], axis=1)        # [1, 2]
         logits, cache = d_step(params, cache, chunk, pos - 1)
-        first = jnp.argmax(logits[:, -1, :], axis=-1)
+        first, q0 = pick(logits[:, -1, :], jax.random.fold_in(key, 0))
 
-        def body(carry, _):
+        def body(carry, i):
             cache, tok, p = carry
             logits, cache = d_step(params, cache, tok[:, None], p)
-            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
-            return (cache, nxt, p + 1), nxt
+            nxt, q = pick(logits[:, -1, :], jax.random.fold_in(key, i))
+            out = (nxt, q[0]) if sampling else (nxt, jnp.zeros(()))
+            return (cache, nxt, p + 1), out
 
-        (cache, _, _), toks = lax.scan(
-            body, (cache, first, pos + 1), None, length=k - 1)
+        (cache, _, _), (toks, qs) = lax.scan(
+            body, (cache, first, pos + 1), jnp.arange(1, k))
         drafts = jnp.concatenate([first, toks[:, 0]]) if k > 1 else first
-        return cache, drafts  # [k]
+        if sampling:
+            q_rows = jnp.concatenate([q0, qs]) if k > 1 else q0
+        else:
+            q_rows = jnp.zeros(())
+        return cache, drafts, q_rows  # [k], [k, V]
 
     draft_propose = jax.jit(draft_propose)
 
     def verify(params, cache, chunk, pos):
-        """One target forward over ``chunk [1, k+1]`` (last accepted token
-        + k draft tokens) at ``pos``; returns the target's greedy token
-        AFTER each chunk position ([k+1]) and the number of accepted
-        draft tokens."""
+        """One target forward over ``chunk [1, k+1]`` (last accepted
+        token + k draft tokens) at ``pos``. Greedy: returns the target's
+        greedy token after each position and the agreeing-prefix length.
+        Sampling: returns the target's ``[k+1, V]`` distributions (the
+        acceptance happens with the q_rows in `accept_resample`)."""
         logits, cache = t_step(params, cache, chunk, pos)
+        if sampling:
+            return cache, probs(logits[0]), jnp.int32(0)
         greedy = jnp.argmax(logits[0], axis=-1)           # [k+1]
         drafts = chunk[0, 1:]                             # [k]
         agree = drafts == greedy[:-1]
@@ -105,10 +182,16 @@ def make_speculative_generate(target_cfg: TransformerConfig,
         return cache, greedy, n_acc
 
     verify = jax.jit(verify)
+    accept_jit = jax.jit(accept_resample)
 
-    def generate(target_params, draft_params, prompt, n_new: int):
+    def generate(target_params, draft_params, prompt, n_new: int,
+                 rng=None):
         if n_new < 1:
             raise ValueError(f"n_new must be >= 1, got {n_new}")
+        if sampling and rng is None:
+            raise ValueError("sampled speculative decode needs an rng key")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)  # unused by greedy selection
         prompt = jnp.asarray(prompt, jnp.int32).reshape(1, -1)
         t0 = prompt.shape[1]
         if t0 + n_new + k + 1 > max_seq:
@@ -123,27 +206,38 @@ def make_speculative_generate(target_cfg: TransformerConfig,
         horizon = min(max_seq, -(-(t0 + n_new + k + 1) // 128) * 128)
         t_cache = init_cache(target_cfg, 1, horizon)
         d_cache = init_cache(draft_cfg, 1, horizon)
-        t_cache, first = prefill_t(target_params, t_cache, prompt)
-        d_cache, _ = prefill_d(draft_params, d_cache, prompt)
+        t_cache, first = prefill_t(target_params, t_cache, prompt,
+                                   jax.random.fold_in(rng, 0))
+        d_cache, _ = prefill_d(draft_params, d_cache, prompt,
+                               jax.random.fold_in(rng, 1))
 
         out = [int(np.asarray(first)[0])]
         pos = t0            # both caches hold [0, t0); `first` unprocessed
         target_calls = 1
         last = first        # [1] last accepted-but-unprocessed token
         prev = prompt[:, -1]  # token at pos-1 (draft catch-up anchor)
+        rounds = 0
         while len(out) < n_new:
-            d_cache, drafts = draft_propose(draft_params, d_cache, prev,
-                                            last, jnp.int32(pos))
+            rounds += 1
+            rkey = jax.random.fold_in(rng, 1 + rounds)
+            d_cache, drafts, q_rows = draft_propose(
+                draft_params, d_cache, prev, last, jnp.int32(pos), rkey)
             chunk = jnp.concatenate([last, drafts]).reshape(1, k + 1)
-            t_cache, greedy, n_acc = verify(target_params, t_cache, chunk,
-                                            jnp.int32(pos))
+            t_cache, tout, n_acc = verify(target_params, t_cache, chunk,
+                                          jnp.int32(pos))
             target_calls += 1
-            n_acc = int(n_acc)
-            greedy = np.asarray(greedy)
+            if sampling:
+                n_acc, extra = accept_jit(
+                    tout, q_rows, drafts,
+                    jax.random.fold_in(rkey, 10_000))
+                n_acc = int(n_acc)
+                extra_tok = int(np.asarray(extra))
+            else:
+                n_acc = int(n_acc)
+                extra_tok = int(np.asarray(tout)[n_acc])
             drafts_np = np.asarray(drafts)
-            # accepted draft tokens, then the target's own next token
-            # (the correction on mismatch; the bonus when all k agree)
-            new = [int(x) for x in drafts_np[:n_acc]] + [int(greedy[n_acc])]
+            # accepted draft tokens, then the correction-or-bonus token
+            new = [int(x) for x in drafts_np[:n_acc]] + [extra_tok]
             out.extend(new)
             pos += n_acc + 1
             last = jnp.asarray([out[-1]], jnp.int32)

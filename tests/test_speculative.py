@@ -81,3 +81,60 @@ def test_validation(models):
         gen(target, draft, [1, 2], 0)
     with pytest.raises(ValueError, match="max_seq"):
         gen(target, draft, [1] * 120, 10)
+
+
+def test_sampled_self_draft_accepts_everything(models):
+    """With draft == target, p == q so u*q < p always accepts: sampled
+    speculative needs the same few target calls as greedy."""
+    target_cfg, target, _, _ = models
+    gen = make_speculative_generate(target_cfg, target_cfg, k=4,
+                                    temperature=1.0)
+    n_new = 15
+    got, calls = gen(target, target, [9, 8, 7], n_new,
+                     jax.random.PRNGKey(0))
+    assert len(got) == n_new
+    assert calls <= 1 + -(-(n_new - 1) // 5), calls
+
+
+def test_sampled_deterministic_per_key_and_needs_rng(models):
+    target_cfg, target, draft_cfg, draft = models
+    gen = make_speculative_generate(target_cfg, draft_cfg, k=2,
+                                    temperature=0.9)
+    a = gen(target, draft, [1, 2, 3], 8, jax.random.PRNGKey(5))[0]
+    b = gen(target, draft, [1, 2, 3], 8, jax.random.PRNGKey(5))[0]
+    c = gen(target, draft, [1, 2, 3], 8, jax.random.PRNGKey(6))[0]
+    assert a == b
+    assert a != c or a != gen(target, draft, [1, 2, 3], 8,
+                              jax.random.PRNGKey(7))[0]
+    with pytest.raises(ValueError, match="rng"):
+        gen(target, draft, [1, 2, 3], 8)
+    with pytest.raises(ValueError, match="temperature"):
+        make_speculative_generate(target_cfg, draft_cfg, temperature=-1.0)
+
+
+def test_accept_resample_emits_target_distribution():
+    """The theorem behind speculative sampling: whatever q proposes, the
+    FIRST emitted token of a round is distributed exactly as p[0].
+    Checked empirically over many keys against a deliberately skewed
+    draft distribution."""
+    from kubegpu_tpu.workload.speculative import accept_resample
+
+    rng = np.random.default_rng(0)
+    V, k, N = 5, 3, 4000
+    p = rng.dirichlet(np.ones(V), size=k + 1).astype(np.float32)
+    q = rng.dirichlet(np.ones(V) * 0.3, size=k).astype(np.float32)
+    p_rows, q_rows = jnp.asarray(p), jnp.asarray(q)
+
+    accept = jax.jit(accept_resample)
+    counts = np.zeros(V)
+    for i in range(N):
+        key = jax.random.PRNGKey(i)
+        kd, ka = jax.random.split(key)
+        d0 = jax.random.categorical(kd, jnp.log(q_rows))  # [k] proposals
+        n_acc, extra = accept(p_rows, q_rows, d0, ka)
+        first = int(d0[0]) if int(n_acc) >= 1 else int(extra)
+        counts[first] += 1
+    emp = counts / N
+    # ~4000 samples: binomial std < 0.008 per bin; 4 sigma tolerance
+    np.testing.assert_allclose(emp, p[0], atol=0.033,
+                               err_msg=f"emp={emp} want={p[0]}")
