@@ -154,3 +154,21 @@ def test_serve_demo_cli(tmp_path):
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["mode"] == "speculative" and out["tokens"] == 15
     assert out["target_calls"] <= 15
+
+
+def test_serve_on_sharded_mesh_matches_single_device(setup, cpu8):  # noqa: F811
+    """DecodeServer(mesh=...) shards the decode batch/heads; tokens must
+    equal the single-device server's."""
+    from kubegpu_tpu.workload.spmd import make_mesh
+
+    cfg, params = setup
+    mesh = make_mesh(8, dp=2, sp=1, tp=4)
+    reqs = [([1, 2, 3], 4), ([4, 5], 4)]
+
+    def run(**kw):
+        srv = DecodeServer(cfg, params, slots=2, prefill_buckets=(8,), **kw)
+        rids = [srv.submit(p, max_new=n) for p, n in reqs]
+        srv.run()
+        return [srv.result(r) for r in rids]
+
+    assert run(mesh=mesh) == run()
