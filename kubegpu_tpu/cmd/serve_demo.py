@@ -68,14 +68,26 @@ def main(argv=None) -> int:
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             d_ff=4 * args.d_model, max_seq=args.seq)
     params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    restored_step = None
     if args.checkpoint_dir:
         from kubegpu_tpu.workload.checkpoint import restore_checkpoint
+        from kubegpu_tpu.workload.train import default_optimizer
 
+        # train_demo saves {params, opt_state}; the restore template must
+        # match that structure leaf-for-leaf or every step reads as
+        # corrupt. eval_shape builds the optimizer-state skeleton without
+        # materializing the O(model) Adam moments we're about to discard.
+        opt_template = jax.eval_shape(default_optimizer().init, params)
         state, at = restore_checkpoint(
-            args.checkpoint_dir, {"params": params, "opt_state": None})
+            args.checkpoint_dir,
+            {"params": params, "opt_state": opt_template})
         if state is None:
-            ap.error(f"no checkpoint found in {args.checkpoint_dir}")
+            ap.error(f"no readable checkpoint in {args.checkpoint_dir} "
+                     "(serve_demo restores full fine-tune checkpoints "
+                     "saved by train_demo)")
         params = state["params"]
+        restored_step = at
+        del state  # drop the restored Adam moments before serving
 
     rng = np.random.default_rng(args.seed)
     prompts = [[int(t) for t in rng.integers(1, cfg.vocab,
@@ -116,6 +128,8 @@ def main(argv=None) -> int:
                  "tokens": sum(len(o) for o in outs)}
     wall = time.perf_counter() - t0
 
+    if restored_step is not None:
+        stats["restored_step"] = restored_step
     stats.update({
         "requests": args.requests,
         "wall_s": round(wall, 2),

@@ -105,8 +105,11 @@ def restore_checkpoint(path: str, like):
                 # this it falls back to the saved-topology layout, which
                 # is wrong on a different mesh); sharding=None is the
                 # constructor's accepted default
+                dt = getattr(x, "dtype", None)
+                if dt is None:
+                    dt = jnp.asarray(x).dtype
                 return jax.ShapeDtypeStruct(
-                    jnp.shape(x), jnp.asarray(x).dtype,
+                    jnp.shape(x), dt,
                     sharding=getattr(x, "sharding", None))
 
             return ckpt.restore(full, jax.tree.map(abstract, like)), step

@@ -151,8 +151,18 @@ class DecodeServer:
         return rid
 
     def result(self, rid: int) -> list | None:
-        req = self._requests[rid]
-        return list(req.out) if req.done else None
+        """Tokens of a finished request (None while in flight). Reading a
+        finished result EVICTS it — a long-running server must not retain
+        every request it ever served; re-reading a consumed rid raises."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise KeyError(
+                f"unknown request id {rid} (never submitted, or its "
+                "result was already read)")
+        if not req.done:
+            return None
+        del self._requests[rid]
+        return list(req.out)
 
     @property
     def pending(self) -> int:

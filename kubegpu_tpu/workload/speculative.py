@@ -121,8 +121,10 @@ def make_speculative_generate(target_cfg: TransformerConfig,
             tok = jnp.argmax(logits[:, -1, :], axis=-1)
         return cache, tok
 
-    prefill_t = jax.jit(lambda p, c, x, s: prefill(p, t_step, c, x, s))
-    prefill_d = jax.jit(lambda p, c, x, s: prefill(p, d_step, c, x, s))
+    prefill_t = jax.jit(lambda p, c, x, s: prefill(p, t_step, c, x, s),
+                        donate_argnums=(1,))
+    prefill_d = jax.jit(lambda p, c, x, s: prefill(p, d_step, c, x, s),
+                        donate_argnums=(1,))
 
     def pick(logits, key):
         """Next token (and its full distribution row when sampling)."""
@@ -163,7 +165,11 @@ def make_speculative_generate(target_cfg: TransformerConfig,
             q_rows = jnp.zeros(())
         return cache, drafts, q_rows  # [k], [k, V]
 
-    draft_propose = jax.jit(draft_propose)
+    # donate the caches: both loops rebind the returned cache, and an
+    # undonated copy per round is pure overhead on the HBM-bandwidth-
+    # bound decode path this module exists to speed up (serve.py donates
+    # for the same reason)
+    draft_propose = jax.jit(draft_propose, donate_argnums=(1,))
 
     def verify(params, cache, chunk, pos):
         """One target forward over ``chunk [1, k+1]`` (last accepted
@@ -181,7 +187,7 @@ def make_speculative_generate(target_cfg: TransformerConfig,
             [agree, jnp.array([False])]).astype(jnp.int32))
         return cache, greedy, n_acc
 
-    verify = jax.jit(verify)
+    verify = jax.jit(verify, donate_argnums=(1,))
     accept_jit = jax.jit(accept_resample)
 
     def generate(target_params, draft_params, prompt, n_new: int,

@@ -157,6 +157,34 @@ def test_train_demo_resume_continues_data_stream(tmp_path):
     assert np.array_equal(next(resumed), stream[3])
 
 
+def test_train_demo_checkpoint_serves(tmp_path):
+    """The advisor's round-4 medium: serve_demo --checkpoint-dir must
+    actually restore what train_demo saved (params + opt_state on disk;
+    the serve side discards opt_state)."""
+    import json
+
+    env = {**{k: v for k, v in os.environ.items()
+              if k != "PALLAS_AXON_POOL_IPS"}, "JAX_PLATFORMS": "cpu"}
+    size = ["--seq", "64", "--vocab", "64", "--d-model", "32",
+            "--n-layers", "1", "--n-heads", "4"]
+    train = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.cmd.train_demo",
+         "--steps", "2", "--batch", "2", *size,
+         "--checkpoint-dir", str(tmp_path / "ckpt"),
+         "--checkpoint-every", "2"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert train.returncode == 0, train.stderr[-1500:]
+    serve = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.cmd.serve_demo",
+         "--requests", "2", "--max-new", "4", *size,
+         "--checkpoint-dir", str(tmp_path / "ckpt")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert serve.returncode == 0, serve.stderr[-1500:]
+    out = json.loads(serve.stdout.strip().splitlines()[-1])
+    assert out["restored_step"] == 2
+    assert out["tokens"] == 2 * 4
+
+
 def test_train_demo_rejects_zero_steps():
     env = {**{k: v for k, v in os.environ.items()
               if k != "PALLAS_AXON_POOL_IPS"}, "JAX_PLATFORMS": "cpu"}

@@ -69,6 +69,23 @@ def test_late_submission_joins_running_batch(setup):
     assert srv.result(r2) == _greedy_reference(cfg, params, [9, 8, 7], 4)
 
 
+def test_result_evicts_and_rejects_unknown_rid(setup):
+    """A long-running server must not retain every request it ever served:
+    reading a finished result evicts it, and unknown/consumed rids raise a
+    named error instead of a bare KeyError."""
+    cfg, params = setup
+    srv = DecodeServer(cfg, params, slots=1, prefill_buckets=(8,))
+    rid = srv.submit([5, 6], max_new=3)
+    assert srv.result(rid) is None          # in flight: no eviction
+    srv.run()
+    assert len(srv.result(rid)) == 3
+    assert not srv._requests                 # evicted after the read
+    with pytest.raises(KeyError, match="already read"):
+        srv.result(rid)
+    with pytest.raises(KeyError, match="unknown request id 999"):
+        srv.result(999)
+
+
 def test_eos_frees_slot_early(setup):
     cfg, params = setup
     # discover what greedy emits first, then declare THAT token the EOS

@@ -54,7 +54,9 @@ def main():
 
     kind = str(getattr(jax.devices()[0], "device_kind", ""))
     gate_gb = bench.SPILL_GATE_FRACTION * bench.hbm_budget_for_kind(kind)
-    print(f"device={kind} spill gate {gate_gb:.1f} GiB")
+    peak_tf = bench.peak_for(kind)
+    print(f"device={kind} spill gate {gate_gb:.1f} GiB "
+          f"peak {peak_tf:.0f} TF/s")
     mesh = make_mesh(1, dp=1, sp=1, tp=1)
     for name, ckw, B, remat in CANDS:
         cfg = TransformerConfig(remat=remat, **ckw)
@@ -84,7 +86,7 @@ def main():
             dt = (time.perf_counter() - t0) / 5
             tf = model_flops(ckw, B) / dt / 1e12
             print(f"{name:22s} step {dt*1e3:8.2f} ms  {tf:6.1f} TF/s "
-                  f"mfu~{tf/197:.3f}  (compile {compile_s:.0f}s)")
+                  f"mfu~{tf/peak_tf:.3f}  (compile {compile_s:.0f}s)")
         except Exception as e:  # noqa: BLE001
             msg = str(e).replace("\n", " ")[:140]
             print(f"{name:22s} FAILED {type(e).__name__}: {msg}")
