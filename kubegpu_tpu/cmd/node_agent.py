@@ -55,6 +55,9 @@ def main(argv=None) -> int:
     parser.add_argument("--cri-port", type=int, default=None,
                         help="serve the CRI rewrite endpoint on this "
                              "loopback TCP port (0 = ephemeral)")
+    parser.add_argument("--launch-log-dir", default=None,
+                        help="directory for supervised workloads' "
+                             "stdout/stderr (default: discard)")
     parser.add_argument("--config", default=None)
     args = parser.parse_args(argv)
     common.merge_flags(args, common.load_config(args.config),
@@ -77,14 +80,19 @@ def main(argv=None) -> int:
                         extra_status=lambda: adv.patch_count > 0)
 
     cri_server = None
+    supervisor = None
     if args.cri_socket or args.cri_port is not None:
         from kubegpu_tpu.runtime.hook import TPURuntimeHook
+        from kubegpu_tpu.runtime.launcher import WorkloadSupervisor
         from kubegpu_tpu.runtime.server import CRIHookServer
 
         hook = TPURuntimeHook(client, mgr)
+        supervisor = WorkloadSupervisor(api=client,
+                                        log_dir=args.launch_log_dir)
         cri_server = CRIHookServer(
             hook, unix_socket=args.cri_socket,
-            port=None if args.cri_socket else args.cri_port)
+            port=None if args.cri_socket else args.cri_port,
+            supervisor=supervisor)
         cri_server.start()
         where = args.cri_socket or f"127.0.0.1:{cri_server.port}"
         print(f"cri-hook serving on {where}", flush=True)
@@ -94,8 +102,12 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
+    # server first: no new launches may arrive once the supervisor has
+    # begun killing containers, or they'd orphan un-reaped
     if cri_server is not None:
         cri_server.stop()
+    if supervisor is not None:
+        supervisor.shutdown()
     adv.stop()
     return 0
 

@@ -19,6 +19,17 @@ one:
        start, `docker_container.go:58-60`)
     -> 404 when the pod is unknown to the API server
 
+With a `WorkloadSupervisor` attached, the server also owns the create-
+AND-START path the reference's shim has (`docker_container.go:95-99`:
+rewrite, then `DockerService.CreateContainer` actually runs it):
+
+    POST /v1/launch-container   {pod, container, config, command: [...]}
+    -> 200 {"config": ..., "id", "pid"}     (rewrite + spawn, supervised)
+    GET  /v1/container-status?id=...        -> the container record
+    GET  /v1/containers                     -> all records
+    POST /v1/stop-container     {"id": ...} -> SIGTERM/SIGKILL, record
+    POST /v1/remove-container   {"id": ...} -> evict an exited record
+
 The server shares the node agent's DevicesManager, so discovery happens
 once per process, not once per container create (the CLI's old behavior).
 """
@@ -68,10 +79,12 @@ class CRIHookServer:
     """Serve `TPURuntimeHook.create_container` over a local endpoint."""
 
     def __init__(self, hook, unix_socket: str | None = None,
-                 port: int | None = None, host: str = "127.0.0.1"):
+                 port: int | None = None, host: str = "127.0.0.1",
+                 supervisor=None):
         if (unix_socket is None) == (port is None):
             raise ValueError("exactly one of unix_socket / port required")
         self.hook = hook
+        self.supervisor = supervisor
         self.unix_socket = unix_socket
         self.requests_served = 0
         self._count_lock = threading.Lock()
@@ -93,16 +106,66 @@ class CRIHookServer:
                 if self.path == "/healthz":
                     self._reply(200, {"ok": True,
                                       "served": outer.requests_served})
+                elif self.path == "/v1/containers":
+                    if outer.supervisor is None:
+                        self._reply(501, {"error": "no supervisor attached"})
+                    else:
+                        self._reply(200,
+                                    {"containers": outer.supervisor.list()})
+                elif self.path.startswith("/v1/container-status"):
+                    if outer.supervisor is None:
+                        self._reply(501, {"error": "no supervisor attached"})
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    cid = (parse_qs(urlparse(self.path).query).get("id")
+                           or [""])[0]
+                    try:
+                        self._reply(200, outer.supervisor.status(cid))
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
                 else:
                     self._reply(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/v1/create-container":
-                    self._reply(404, {"error": "not found"})
-                    return
                 try:
                     length = int(self.headers.get("Content-Length") or 0)
                     req = json.loads(self.rfile.read(length) or b"{}")
+                except Exception as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                if self.path == "/v1/create-container":
+                    self._create(req, launch=False)
+                elif self.path == "/v1/launch-container":
+                    self._create(req, launch=True)
+                elif self.path == "/v1/stop-container":
+                    if outer.supervisor is None:
+                        self._reply(501, {"error": "no supervisor attached"})
+                        return
+                    try:
+                        self._reply(200, outer.supervisor.stop(
+                            req.get("id") or ""))
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
+                elif self.path == "/v1/remove-container":
+                    if outer.supervisor is None:
+                        self._reply(501, {"error": "no supervisor attached"})
+                        return
+                    try:
+                        outer.supervisor.remove(req.get("id") or "")
+                        self._reply(200, {"removed": req.get("id")})
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
+                    except RuntimeError as e:
+                        self._reply(409, {"error": str(e)})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def _create(self, req: dict, launch: bool):
+                if launch and outer.supervisor is None:
+                    self._reply(501, {"error": "no supervisor attached"})
+                    return
+                try:
                     cfg = outer.hook.create_container(
                         req.get("pod") or "", req.get("container") or "",
                         req.get("config") or {})
@@ -115,9 +178,22 @@ class CRIHookServer:
                 except Exception as e:  # config must never crash the agent
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                body = {"config": cfg}
+                if launch:
+                    try:
+                        cont = outer.supervisor.launch(
+                            req.get("pod") or "", req.get("container") or "",
+                            cfg, req.get("command") or [])
+                    except Exception as e:
+                        # malformed command/envs must yield a JSON error,
+                        # not a dropped connection
+                        self._reply(400, {"error": f"launch failed: "
+                                          f"{type(e).__name__}: {e}"})
+                        return
+                    body.update({"id": cont.cid, "pid": cont.proc.pid})
                 with outer._count_lock:
                     outer.requests_served += 1
-                self._reply(200, {"config": cfg})
+                self._reply(200, body)
 
         if unix_socket is not None:
             self._server = _UnixHTTPServer(unix_socket, Handler)

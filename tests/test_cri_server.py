@@ -12,6 +12,7 @@ from kubegpu_tpu.core.types import ContainerInfo, PodInfo
 from kubegpu_tpu.node.fake import FakeTPUBackend, v5p_host_inventory
 from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
 from kubegpu_tpu.runtime.hook import AllocationMismatch, TPURuntimeHook
+from kubegpu_tpu.runtime.launcher import WorkloadSupervisor
 from kubegpu_tpu.runtime.server import (CRIHookServer,
                                         request_create_container)
 
@@ -95,6 +96,155 @@ def test_served_healthz_counts(served):
     with urllib.request.urlopen(f"{url}/healthz", timeout=5) as resp:
         health = json.loads(resp.read())
     assert health["ok"] and health["served"] == 1
+
+
+@pytest.fixture
+def launch_served(tmp_path):
+    api = InMemoryAPIServer()
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(FakeTPUBackend(v5p_host_inventory())))
+    mgr.start()
+    sup = WorkloadSupervisor(api=api, log_dir=str(tmp_path / "logs"))
+    server = CRIHookServer(TPURuntimeHook(api, mgr), port=0, supervisor=sup)
+    server.start()
+    yield api, f"http://127.0.0.1:{server.port}", tmp_path
+    sup.shutdown()
+    server.stop()
+
+
+def post_path(url, path, body):
+    req = urllib.request.Request(
+        f"{url}{path}", json.dumps(body).encode(),
+        {"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _launch(url, body):
+    return post_path(url, "/v1/launch-container", body)
+
+
+def _get(url, path):
+    try:
+        with urllib.request.urlopen(f"{url}{path}", timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_launch_runs_process_with_injected_env(launch_served):
+    """The create-AND-start path (`docker_container.go:95-99`): the
+    spawned process really runs under the rewritten config's env, and its
+    exit is tracked and reported to the API server."""
+    import sys
+    import time
+
+    api, url, tmp = launch_served
+    allocated_pod(api)
+    out = str(tmp / "env.json")
+    code, body = _launch(url, {
+        "pod": "job", "container": "main", "config": {},
+        "command": [sys.executable, "-c",
+                    "import json, os; json.dump("
+                    "{k: v for k, v in os.environ.items() "
+                    "if k.startswith('TPU_')}, open(%r, 'w'))" % out]})
+    assert code == 200 and body["id"] and body["pid"] > 0
+    cid = body["id"]
+    for _ in range(100):
+        code, st = _get(url, f"/v1/container-status?id={cid}")
+        if st["state"] == "exited":
+            break
+        time.sleep(0.05)
+    assert st["state"] == "exited" and st["exit_code"] == 0
+    env = json.load(open(out))
+    assert len(env["TPU_VISIBLE_CHIPS"].split(",")) == 2
+    assert env["TPU_PROCESS_BOUNDS"]
+    # lifecycle reported through the API server (the system's transport)
+    from kubegpu_tpu.runtime.launcher import STATUS_ANNOTATION_KEY
+
+    ann = api.get_pod("job")["metadata"]["annotations"]
+    reported = json.loads(ann[STATUS_ANNOTATION_KEY])["main"]
+    assert reported["state"] == "exited" and reported["exit_code"] == 0
+
+
+def test_stop_container_terminates(launch_served):
+    import sys
+    import time
+
+    api, url, _ = launch_served
+    allocated_pod(api, "j3")
+    code, body = _launch(url, {
+        "pod": "j3", "container": "main", "config": {},
+        "command": [sys.executable, "-c", "import time; time.sleep(600)"]})
+    assert code == 200
+    cid = body["id"]
+    code, st = _get(url, f"/v1/container-status?id={cid}")
+    assert st["state"] == "running"
+    code, st = post_path(url, "/v1/stop-container", {"id": cid})
+    assert code == 200 and st["state"] == "exited"
+    assert st["exit_code"] != 0  # killed, not clean exit
+    code, listing = _get(url, "/v1/containers")
+    assert [c["id"] for c in listing["containers"]] == [cid]
+    # stopping an unknown id is a 404, not a crash
+    code, _ = post_path(url, "/v1/stop-container", {"id": "nope"})
+    assert code == 404
+    # RemoveContainer analogue: exited records are evictable
+    code, _ = post_path(url, "/v1/remove-container", {"id": cid})
+    assert code == 200
+    _, listing = _get(url, "/v1/containers")
+    assert listing["containers"] == []
+
+
+def test_remove_running_container_refused(launch_served):
+    import sys
+
+    api, url, _ = launch_served
+    allocated_pod(api, "j5")
+    _, body = _launch(url, {
+        "pod": "j5", "container": "main", "config": {},
+        "command": [sys.executable, "-c", "import time; time.sleep(600)"]})
+    code, _ = post_path(url, "/v1/remove-container", {"id": body["id"]})
+    assert code == 409  # running: stop first, as in the CRI contract
+    post_path(url, "/v1/stop-container", {"id": body["id"]})
+
+
+def test_launch_malformed_request_is_400(launch_served):
+    """Malformed envs/command must produce a JSON error, not a dropped
+    connection (the handler thread must never crash)."""
+    api, url, _ = launch_served
+    allocated_pod(api, "j6")
+    code, body = _launch(url, {"pod": "j6", "container": "main",
+                               "config": {}, "command": "not-a-list"})
+    assert code == 400 and "launch failed" in body["error"]
+
+
+def test_launch_without_supervisor_is_501(served):
+    api, url = served
+    allocated_pod(api, "j4")
+    code, body = _launch(url, {"pod": "j4", "container": "main",
+                               "config": {}, "command": ["true"]})
+    assert code == 501
+
+
+def test_launch_refuses_mismatched_allocation(launch_served):
+    """A launch request still goes through the rewrite gate: allocation
+    mismatch refuses to START (409), nothing is spawned."""
+    api, url, _ = launch_served
+    pi = PodInfo(name="badl", node_name="host0")
+    pi.running_containers["main"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: 2})
+    meta = {"name": "badl"}
+    codec.pod_info_to_annotation(meta, pi)
+    api.create_pod({"metadata": meta,
+                    "spec": {"containers": [{"name": "main"}]}})
+    code, _ = _launch(url, {"pod": "badl", "container": "main",
+                            "config": {}, "command": ["true"]})
+    assert code == 409
+    _, listing = _get(url, "/v1/containers")
+    assert listing["containers"] == []
 
 
 def test_unix_socket_roundtrip(tmp_path):
