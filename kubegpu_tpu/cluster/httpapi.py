@@ -124,8 +124,15 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
                 self._send(404, {"error": str(e)})
             except Conflict as e:
                 self._send(409, {"error": str(e)})
+            except (BrokenPipeError, ConnectionResetError):
+                # client hung up mid-reply (e.g. a watcher killed during
+                # its long-poll); there is nobody left to answer
+                pass
             except Exception as e:  # noqa: BLE001
-                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                try:
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
 
         def _dispatch(self, method, parts, query):
             if parts == ["healthz"]:

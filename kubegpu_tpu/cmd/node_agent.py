@@ -37,6 +37,10 @@ def main(argv=None) -> int:
     parser.add_argument("--api", default="http://127.0.0.1:8070")
     parser.add_argument("--node-name", default=None,
                         help="defaults to the hostname, like kubelet")
+    parser.add_argument("--node-address", default=None,
+                        help="routable address advertised for this node "
+                             "(gang coordinators resolve through it); "
+                             "defaults to the host's primary IP")
     parser.add_argument("--backend", default="native",
                         choices=["native", "fake-v5p", "fake-single"])
     parser.add_argument("--sysfs-root", default="/sys/class")
@@ -61,8 +65,8 @@ def main(argv=None) -> int:
     parser.add_argument("--config", default=None)
     args = parser.parse_args(argv)
     common.merge_flags(args, common.load_config(args.config),
-                       ["api", "node_name", "backend", "sysfs_root",
-                        "cri_socket", "cri_port"])
+                       ["api", "node_name", "node_address", "backend",
+                        "sysfs_root", "cri_socket", "cri_port"])
 
     node_name = args.node_name or socket.gethostname()
     client = HTTPAPIClient(args.api)
@@ -72,9 +76,24 @@ def main(argv=None) -> int:
         except KeyError:
             client.create_node({"metadata": {"name": node_name}})
 
+    address = args.node_address
+    if not address:
+        # the routable primary IP, via a connected UDP socket (no packet
+        # is sent). gethostbyname(hostname) is wrong here: stock
+        # /etc/hosts maps the hostname to 127.0.1.1, and advertising a
+        # loopback address cluster-wide would make every remote gang
+        # member dial itself. On failure advertise nothing — the hook
+        # then falls back to the node name, which may resolve.
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect(("10.255.255.255", 1))
+            address = probe.getsockname()[0]
+            probe.close()
+        except OSError:
+            address = None
     mgr = build_manager(args.backend, args.sysfs_root,
                         args.device_plugins_dir)
-    adv = DeviceAdvertiser(client, mgr, node_name)
+    adv = DeviceAdvertiser(client, mgr, node_name, address=address)
     adv.start(interval_s=args.advertise_interval, retry_s=args.retry_interval)
     common.serve_health(args.healthz_port,
                         extra_status=lambda: adv.patch_count > 0)

@@ -40,6 +40,11 @@ def main(argv=None) -> int:
                     help="default 128 (d_ff follows at 4x)")
     ap.add_argument("--n-layers", type=int, default=None, help="default 2")
     ap.add_argument("--n-heads", type=int, default=None, help="default 4")
+    ap.add_argument("--dp", type=int, default=None,
+                    help="mesh data-parallel extent (with --sp/--tp; "
+                         "default: auto-factor the visible devices)")
+    ap.add_argument("--sp", type=int, default=None)
+    ap.add_argument("--tp", type=int, default=None)
     ap.add_argument("--accum-steps", type=int, default=1,
                     help="gradient-accumulation microbatches per step")
     ap.add_argument("--lora-rank", type=int, default=0,
@@ -81,6 +86,11 @@ def main(argv=None) -> int:
     import numpy as np
 
     from kubegpu_tpu.workload import spmd
+
+    # Gang-scheduled pods join one jax.distributed process group before
+    # ANY other jax call: the runtime hook injected the coordinator/rank
+    # env alongside TPU_VISIBLE_CHIPS (no-op for single-process runs).
+    multiproc = spmd.distributed_init_from_env()
     from kubegpu_tpu.workload.data import make_loader, write_token_shard
     from kubegpu_tpu.workload.model import TransformerConfig
     from kubegpu_tpu.workload.train import init_sharded, make_train_step
@@ -118,13 +128,28 @@ def main(argv=None) -> int:
             rng.integers(0, cfg.vocab, size=50_000, dtype=np.uint32))
             for i in range(2)]
 
-    mesh = spmd.mesh_from_env()
+    if args.dp or args.sp or args.tp:
+        if not (args.dp and args.sp and args.tp):
+            ap.error("--dp/--sp/--tp must be given together")
+        want = args.dp * args.sp * args.tp
+        if multiproc and want != len(jax.devices()):
+            # a sub-mesh is fine single-process; across processes it
+            # would strand whole ranks outside the mesh and crash the
+            # first global array mid-run instead of failing here
+            ap.error(f"--dp*--sp*--tp = {want} but the process group has "
+                     f"{len(jax.devices())} devices")
+        mesh = spmd.make_mesh(want, dp=args.dp, sp=args.sp, tp=args.tp)
+    else:
+        mesh = spmd.mesh_from_env()
 
     # Build (and thereby validate) the generator BEFORE training: a bad
     # flag combination must fail up front, not after the last step when
     # an uncheckpointed session's params would be lost.
     gen = None
     prompt_len = min(16, seq_len)
+    if args.generate > 0 and multiproc:
+        ap.error("--generate is single-process only (decode slices the "
+                 "batch outside jit, which a cross-process array forbids)")
     if args.generate > 0:
         from kubegpu_tpu.workload.decode import make_generate
 
@@ -191,7 +216,10 @@ def main(argv=None) -> int:
         for _ in range(start_step):
             next(loader)
         for i in range(start_step, start_step + args.steps):
-            tokens = jax.numpy.asarray(next(loader))
+            # every process streams the SAME deterministic global batch;
+            # global_batch shards it over the mesh's data axis (the only
+            # correct multi-process feed; a plain asarray single-process)
+            tokens = spmd.global_batch(mesh, np.asarray(next(loader)))
             if lora is not None:
                 lora, opt_state, loss = lora_step(lora, opt_state, params,
                                                   tokens)
@@ -215,6 +243,9 @@ def main(argv=None) -> int:
         "steps": args.steps,
         "first_loss": round(losses[0], 4),
         "last_loss": round(losses[-1], 4),
+        # full-precision per-step losses: lets a gang run be checked
+        # bit-for-bit against its single-process twin
+        "losses_full": losses,
         "tokens_per_s": round(args.steps * args.batch * seq_len / wall, 1),
     }
 
@@ -227,7 +258,13 @@ def main(argv=None) -> int:
                    jax.random.PRNGKey(args.seed))
         out["generated"] = np.asarray(toks)[0].tolist()
 
-    print(json.dumps(out))
+    if multiproc:
+        out["processes"] = jax.process_count()
+        out["process_id"] = jax.process_index()
+    # one JSON line per JOB: in a gang every rank computes identical
+    # replicated losses, so rank 0 speaks for the group
+    if jax.process_index() == 0 or not multiproc:
+        print(json.dumps(out))
     return 0 if all(np.isfinite(losses)) else 1
 
 

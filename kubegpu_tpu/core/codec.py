@@ -21,6 +21,11 @@ from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
 
 NODE_ANNOTATION_KEY = "node.alpha/DeviceInformation"
 POD_ANNOTATION_KEY = "pod.alpha/DeviceInformation"
+# Routable address of the node agent's host, advertised alongside the
+# inventory. The runtime hook resolves a gang's coordinator node through
+# this when building TPU_COORDINATOR_ADDRESS (node NAMES are cluster
+# identifiers, not necessarily resolvable hostnames).
+NODE_ADDRESS_ANNOTATION = "node.alpha/Address"
 
 # Kubernetes quantity suffixes -> multiplier. Serialized pods carry requests
 # as quantity strings ("500m", "1Gi"); the reference reads them through

@@ -18,10 +18,12 @@ DEFAULT_RETRY_S = 5.0
 
 
 class DeviceAdvertiser:
-    def __init__(self, client, dev_mgr, node_name: str):
+    def __init__(self, client, dev_mgr, node_name: str,
+                 address: str | None = None):
         self.client = client
         self.dev_mgr = dev_mgr
         self.node_name = node_name
+        self.address = address
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.patch_count = 0
@@ -34,6 +36,9 @@ class DeviceAdvertiser:
         self.dev_mgr.update_node_info(info)
         meta: dict = {}
         codec.node_info_to_annotation(meta, info)
+        if self.address:
+            meta.setdefault("annotations", {})[
+                codec.NODE_ADDRESS_ANNOTATION] = self.address
         self.client.patch_node_metadata(self.node_name, meta)
         self.patch_count += 1
 

@@ -37,6 +37,37 @@ class TPURuntimeHook:
     def _is_tpu_device(path: str) -> bool:
         return any(path.startswith(p) for p in TPU_DEVICE_PREFIXES)
 
+    def _gang_process_env(self, kube_pod: dict) -> dict:
+        """Env for the gang's process contract, if the scheduler wrote one.
+
+        Turns the `GANG_PROCESS_ANNOTATION` blob into the three variables
+        `workload.spmd.distributed_init_from_env` consumes, resolving the
+        coordinator NODE to a routable address through the node's
+        advertised `NODE_ADDRESS_ANNOTATION` (falling back to the node
+        name, which suffices when node names are resolvable hostnames)."""
+        import json
+
+        from kubegpu_tpu.scheduler.gang import GANG_PROCESS_ANNOTATION
+
+        raw = ((kube_pod.get("metadata") or {}).get("annotations") or {}).get(
+            GANG_PROCESS_ANNOTATION)
+        if not raw:
+            return {}
+        gp = json.loads(raw)
+        node = gp["coordinator_node"]
+        addr = node
+        try:
+            node_obj = self.api.get_node(node)
+            addr = ((node_obj.get("metadata") or {}).get("annotations")
+                    or {}).get(codec.NODE_ADDRESS_ANNOTATION) or node
+        except Exception:
+            pass  # unadvertised node: the name itself may resolve
+        return {
+            "TPU_PROCESS_COUNT": str(gp["count"]),
+            "TPU_PROCESS_ID": str(gp["rank"]),
+            "TPU_COORDINATOR_ADDRESS": f"{addr}:{gp['coordinator_port']}",
+        }
+
     def create_container(self, pod_name: str, container_name: str,
                          config: dict) -> dict:
         """Rewrite one container config before the runtime sees it."""
@@ -63,6 +94,7 @@ class TPURuntimeHook:
                 f"chips but annotation allocates {allocated_chips}")
 
         volumes, device_paths, env = self.dev_mgr.allocate_devices(pod_info, cont)
+        env.update(self._gang_process_env(kube_pod))
         for path in device_paths:
             devices.append({"container_path": path, "host_path": path,
                             "permissions": "mrw"})

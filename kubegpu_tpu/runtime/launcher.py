@@ -81,6 +81,7 @@ class WorkloadSupervisor:
         self.log_dir = log_dir
         self._containers: dict[str, Container] = {}
         self._lock = threading.Lock()
+        self._report_lock = threading.Lock()
         self._reaper: threading.Thread | None = None
         self._stop = threading.Event()
 
@@ -225,15 +226,19 @@ class WorkloadSupervisor:
     def _report(self, cont: Container) -> None:
         if self.api is None:
             return
-        try:
-            pod = self.api.get_pod(cont.pod)
-            ann = ((pod.get("metadata") or {}).get("annotations") or {})
-            statuses = json.loads(ann.get(STATUS_ANNOTATION_KEY) or "{}")
-            statuses[cont.container] = cont.status()
-            self.api.update_pod_annotations(
-                cont.pod, {STATUS_ANNOTATION_KEY: json.dumps(
-                    statuses, sort_keys=True)})
-        except Exception:
-            # the API server being briefly away must not take down a
-            # running workload; the advertiser loop has the same stance
-            pass
+        # serialized: the annotation update is read-modify-write over a
+        # SHARED per-pod blob, and concurrent reports for two containers
+        # of one pod would lose the slower writer's entry forever
+        with self._report_lock:
+            try:
+                pod = self.api.get_pod(cont.pod)
+                ann = ((pod.get("metadata") or {}).get("annotations") or {})
+                statuses = json.loads(ann.get(STATUS_ANNOTATION_KEY) or "{}")
+                statuses[cont.container] = cont.status()
+                self.api.update_pod_annotations(
+                    cont.pod, {STATUS_ANNOTATION_KEY: json.dumps(
+                        statuses, sort_keys=True)})
+            except Exception:
+                # the API server being briefly away must not take down a
+                # running workload; the advertiser loop has the same stance
+                pass

@@ -102,26 +102,30 @@ class CRIHookServer:
                 self.end_headers()
                 self.wfile.write(blob)
 
+            def _supervisor(self):
+                """The attached supervisor, or reply 501 and return None."""
+                if outer.supervisor is None:
+                    self._reply(501, {"error": "no supervisor attached"})
+                return outer.supervisor
+
             def do_GET(self):
                 if self.path == "/healthz":
                     self._reply(200, {"ok": True,
                                       "served": outer.requests_served})
                 elif self.path == "/v1/containers":
-                    if outer.supervisor is None:
-                        self._reply(501, {"error": "no supervisor attached"})
-                    else:
-                        self._reply(200,
-                                    {"containers": outer.supervisor.list()})
+                    sup = self._supervisor()
+                    if sup is not None:
+                        self._reply(200, {"containers": sup.list()})
                 elif self.path.startswith("/v1/container-status"):
-                    if outer.supervisor is None:
-                        self._reply(501, {"error": "no supervisor attached"})
+                    sup = self._supervisor()
+                    if sup is None:
                         return
                     from urllib.parse import parse_qs, urlparse
 
                     cid = (parse_qs(urlparse(self.path).query).get("id")
                            or [""])[0]
                     try:
-                        self._reply(200, outer.supervisor.status(cid))
+                        self._reply(200, sup.status(cid))
                     except KeyError as e:
                         self._reply(404, {"error": str(e)})
                 else:
@@ -139,20 +143,19 @@ class CRIHookServer:
                 elif self.path == "/v1/launch-container":
                     self._create(req, launch=True)
                 elif self.path == "/v1/stop-container":
-                    if outer.supervisor is None:
-                        self._reply(501, {"error": "no supervisor attached"})
+                    sup = self._supervisor()
+                    if sup is None:
                         return
                     try:
-                        self._reply(200, outer.supervisor.stop(
-                            req.get("id") or ""))
+                        self._reply(200, sup.stop(req.get("id") or ""))
                     except KeyError as e:
                         self._reply(404, {"error": str(e)})
                 elif self.path == "/v1/remove-container":
-                    if outer.supervisor is None:
-                        self._reply(501, {"error": "no supervisor attached"})
+                    sup = self._supervisor()
+                    if sup is None:
                         return
                     try:
-                        outer.supervisor.remove(req.get("id") or "")
+                        sup.remove(req.get("id") or "")
                         self._reply(200, {"removed": req.get("id")})
                     except KeyError as e:
                         self._reply(404, {"error": str(e)})
@@ -162,8 +165,7 @@ class CRIHookServer:
                     self._reply(404, {"error": "not found"})
 
             def _create(self, req: dict, launch: bool):
-                if launch and outer.supervisor is None:
-                    self._reply(501, {"error": "no supervisor attached"})
+                if launch and self._supervisor() is None:
                     return
                 try:
                     cfg = outer.hook.create_container(

@@ -906,6 +906,11 @@ class Scheduler:
             # whole gang once the cluster changes
             self.queue.add_unschedulable(kube_pod)
             return
+        # Write each member's process contract (rank/count/coordinator)
+        # so the runtime hook can hand the gang a jax.distributed mesh.
+        from kubegpu_tpu.scheduler.gang import annotate_gang_processes
+
+        annotate_gang_processes(members, assignment, gang, api=self.api)
         # Pin every member, then validate each against its host through the
         # full predicate stack (HBM floors, core resources) — the planner
         # only reasons about chips and must not bypass feasibility.

@@ -26,6 +26,83 @@ from kubegpu_tpu.utils import sorted_keys
 RESOURCE_GANG = "alpha.tpu/gang"
 RESOURCE_GANG_SIZE = "alpha.tpu/gang-size"
 
+# Per-pod process contract the scheduler writes alongside the pinned
+# allocation: one JSON blob {gang, rank, count, coordinator_node,
+# coordinator_port}. The runtime hook turns it into the
+# TPU_PROCESS_ID / TPU_PROCESS_COUNT / TPU_COORDINATOR_ADDRESS env that
+# `workload.spmd.distributed_init_from_env` consumes — the wire protocol
+# that lets N scheduled pods form ONE jax.distributed mesh.
+GANG_PROCESS_ANNOTATION = "pod.alpha/GangProcess"
+GANG_PORT_BASE = 28000
+GANG_PORT_SPAN = 2048
+
+
+def gang_coordinator_port(gang: int, used: set | frozenset = frozenset()) -> int:
+    """Deterministic per-gang coordinator port, skipping ``used`` ports.
+
+    Starts at ``BASE + gang % SPAN`` and linearly probes: two live gangs
+    whose ids are congruent mod SPAN (or a port already claimed on the
+    coordinator host) must not collide — a second coordinator on the
+    same port would either fail to bind or absorb the other gang's
+    workers with a mismatched process count."""
+    start = int(gang) % GANG_PORT_SPAN
+    for i in range(GANG_PORT_SPAN):
+        port = GANG_PORT_BASE + (start + i) % GANG_PORT_SPAN
+        if port not in used:
+            return port
+    raise RuntimeError(f"all {GANG_PORT_SPAN} gang coordinator ports in use")
+
+
+def coordinator_ports_in_use(api, coordinator_node: str) -> set:
+    """Ports already promised to live gangs coordinated on ``node`` —
+    read from existing pods' process-contract annotations, so the claim
+    survives a scheduler restart exactly like every other decision (the
+    API server is the checkpoint, SURVEY.md §6)."""
+    import json
+
+    used = set()
+    try:
+        pods = api.list_pods()
+    except Exception:
+        return used
+    for pod in pods:
+        raw = ((pod.get("metadata") or {}).get("annotations") or {}).get(
+            GANG_PROCESS_ANNOTATION)
+        if not raw:
+            continue
+        try:
+            gp = json.loads(raw)
+        except ValueError:
+            continue
+        if gp.get("coordinator_node") == coordinator_node:
+            used.add(int(gp.get("coordinator_port", 0)))
+    return used
+
+
+def annotate_gang_processes(members: list, assignment: dict,
+                            gang: int, api=None) -> None:
+    """Write each member's process contract into its annotations.
+
+    Rank order is the sorted member-name order (the same determinism
+    rule as everything else); the coordinator is rank 0's node."""
+    import json
+
+    names = sorted(m["metadata"]["name"] for m in members)
+    ranks = {name: i for i, name in enumerate(names)}
+    coordinator_node = assignment[names[0]][0]
+    used = coordinator_ports_in_use(api, coordinator_node) if api else set()
+    port = gang_coordinator_port(gang, used)
+    for member in members:
+        name = member["metadata"]["name"]
+        ann = member.setdefault("metadata", {}).setdefault("annotations", {})
+        ann[GANG_PROCESS_ANNOTATION] = json.dumps({
+            "gang": int(gang),
+            "rank": ranks[name],
+            "count": len(names),
+            "coordinator_node": coordinator_node,
+            "coordinator_port": port,
+        }, sort_keys=True)
+
 
 def gang_key(kube_pod: dict):
     """(gang id, size) from the pod annotation, or None.

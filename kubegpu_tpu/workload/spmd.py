@@ -61,12 +61,67 @@ def make_mesh(n_devices: int | None = None, dp: int | None = None,
     return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_MODEL))
 
 
-def mesh_from_env(env: dict | None = None):
-    """Mesh for the chips this container was allocated (runtime-hook env)."""
+def distributed_init_from_env(env: dict | None = None) -> bool:
+    """Form the cross-host process group the runtime hook described.
+
+    The other half of the placement contract (SURVEY.md §2.9: "hand an
+    8-chip JAX job an ICI-contiguous slice with correct chip
+    visibility"): a gang-scheduled pod's hook-rewritten config carries
+
+    - ``TPU_COORDINATOR_ADDRESS`` — host:port of the gang's rank-0 pod
+    - ``TPU_PROCESS_COUNT``      — number of pods in the gang
+    - ``TPU_PROCESS_ID``         — this pod's rank (gang member order)
+
+    and calling `jax.distributed.initialize` with exactly those values
+    joins every member into ONE JAX process group, so ``jax.devices()``
+    becomes the global slice and `make_mesh` lays the mesh over all of
+    it. Returns True when a multi-process group was formed; single-
+    process runs (env absent or count 1) return False untouched, so
+    every workload binary can call this unconditionally."""
     env = env if env is not None else os.environ
+    addr = env.get("TPU_COORDINATOR_ADDRESS", "")
+    count = int(env.get("TPU_PROCESS_COUNT", "1") or 1)
+    if not addr or count <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=count,
+        process_id=int(env.get("TPU_PROCESS_ID", "0") or 0))
+    return True
+
+
+def mesh_from_env(env: dict | None = None):
+    """Mesh for the chips this container was allocated (runtime-hook env).
+
+    In a multi-process group (after `distributed_init_from_env`) the
+    env names only LOCAL chips; the mesh must span the whole gang's
+    devices, so the global device count wins there."""
+    env = env if env is not None else os.environ
+    import jax
+
+    if jax.process_count() > 1:
+        return make_mesh(len(jax.devices()))
     visible = env.get("TPU_VISIBLE_CHIPS", "")
     n = len([c for c in visible.split(",") if c]) if visible else None
     return make_mesh(n)
+
+
+def global_batch(mesh, np_batch):
+    """Shard one host-replicated numpy batch over the mesh's data axis.
+
+    Every process holds the SAME full global batch (deterministic
+    loaders seeded identically — the loader contract); each device
+    materializes only its slice. Single-process this is a plain
+    device_put; multi-process it is the only correct way to feed a jit
+    whose arguments span processes (a process-local ``jnp.asarray``
+    cannot be addressed by a global sharding)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, batch_pspec())
+    return jax.make_array_from_callback(
+        np.shape(np_batch), sharding, lambda idx: np_batch[idx])
 
 
 def batch_pspec():

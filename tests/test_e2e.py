@@ -47,7 +47,8 @@ class TPUHost:
         self.dev_mgr = DevicesManager()
         self.dev_mgr.add_device(TPUDeviceManager(self.backend))
         self.dev_mgr.start()
-        self.advertiser = DeviceAdvertiser(api, self.dev_mgr, name)
+        self.advertiser = DeviceAdvertiser(api, self.dev_mgr, name,
+                                           address="127.0.0.1")
         self.advertiser.advertise_once()
         self.hook = TPURuntimeHook(api, self.dev_mgr)
 
