@@ -347,6 +347,45 @@ def config_http():
         server.shutdown()
 
 
+def config_gang_preempt():
+    """VERDICT r4 #2: slice defragmentation at 64 hosts. The 256-chip
+    mesh is fully occupied by low-priority singles; each iteration
+    submits a high-priority 4-pod gang (16 contiguous chips) that can
+    only place by evicting the cheapest block's owners, and measures the
+    full buffer->plan-fail->block-victim-search->evict->nominate->retry->
+    bind cycle. Freed chips are refilled between iterations so every
+    gang must preempt."""
+    origins = [(x, y, 0) for y in range(0, 16, 2) for x in range(0, 16, 2)]
+    c = Cluster([v5p_host_inventory(host_origin=o, mesh_dims=(16, 16, 1))
+                 for o in origins])
+    for i in range(64):
+        for j in range(2):
+            c.api.create_pod(make_pod(f"low{i}-{j}", 2))
+    c.sched.run_until_idle()
+    lat = []
+    for k in range(3):
+        names = [f"gp{k}-{i}" for i in range(4)]
+        t0 = time.perf_counter()
+        for nm in names:
+            pod = make_pod(nm, 4, pod_requests={RESOURCE_GANG: 900 + k,
+                                                RESOURCE_GANG_SIZE: 4})
+            pod["spec"]["priority"] = 100
+            c.api.create_pod(pod)
+        c.sched.run_until_idle()
+        t1 = time.perf_counter()
+        for nm in names:
+            assert c.api.get_pod(nm)["spec"].get("nodeName"), \
+                f"gang pod {nm} failed to place via preemption"
+        lat.append((t1 - t0) / 4.0)  # per-pod share of the gang commit
+        for nm in names:
+            c.api.delete_pod(nm)
+        # refill the freed block so the next gang must preempt again
+        for j in range(8):
+            c.api.create_pod(make_pod(f"relow{k}-{j}", 2))
+        c.sched.run_until_idle()
+    return lat
+
+
 def config6_scale():
     """Beyond the BASELINE set: a 64-host / 256-chip cluster under a
     sustained mixed-size pod stream — scheduler throughput at cluster
@@ -922,6 +961,9 @@ def main():
     preempt_lat = config_preempt()
     per_config["preempt_64node_p50_ms"] = round(
         statistics.median(preempt_lat) * 1e3, 3)
+    gang_preempt_lat = config_gang_preempt()
+    per_config["gang_preempt_64node_p50_ms"] = round(
+        statistics.median(gang_preempt_lat) * 1e3, 3)
     while _LIVE_CLUSTERS:
         _LIVE_CLUSTERS.pop().close()
     if not os.environ.get("KGTPU_BENCH_SKIP_WORKLOAD"):

@@ -117,6 +117,17 @@ def chip_id_from_path(path: str) -> str | None:
     return m.group(1) if m else None
 
 
+def chip_prefix_from_path(path: str) -> str | None:
+    """The ``.../tpu/<chip-id>`` prefix of a chips-leaf path, or None.
+
+    The gang preemption planner keys chip OWNERSHIP by this prefix: a
+    bound pod's ``allocate_from`` values name the same prefixes the node
+    advertises, so (node, prefix) identifies a physical chip."""
+    if chip_id_from_path(path) is None:
+        return None
+    return path[: path.rfind("/")]
+
+
 def coords_from_chip_id(chip_id: str) -> tuple | None:
     """Chip ids encode mesh coordinates as dot-separated ints, e.g. ``1.0.3``."""
     parts = chip_id.split(".")

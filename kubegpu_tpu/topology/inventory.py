@@ -18,15 +18,18 @@ _NEG_BITS = (1, 3, 5)
 
 
 class ChipEntry:
-    __slots__ = ("coords", "prefix", "node_name", "free", "links", "hbm_free")
+    __slots__ = ("coords", "prefix", "node_name", "free", "links",
+                 "hbm_free", "hbm_total")
 
-    def __init__(self, coords, prefix, node_name, free, links, hbm_free):
+    def __init__(self, coords, prefix, node_name, free, links, hbm_free,
+                 hbm_total=0):
         self.coords = coords
         self.prefix = prefix        # resource path prefix (.../tpu/<id>)
         self.node_name = node_name
         self.free = free
         self.links = links          # enumLinks bitmask (0 when absent)
         self.hbm_free = hbm_free    # allocatable - used HBM bytes
+        self.hbm_total = hbm_total  # allocatable HBM (what eviction frees)
 
 
 def collect_chips(node_infos: dict) -> list:
@@ -45,12 +48,12 @@ def collect_chips(node_infos: dict) -> list:
             links = node_ex.allocatable.get(
                 f"{prefix}/{grammar.LINKS_SUFFIX}", 0)
             hbm_path = f"{prefix}/{grammar.HBM_SUFFIX}"
-            hbm_free = (node_ex.allocatable.get(hbm_path, 0)
-                        - node_ex.used.get(hbm_path, 0))
+            hbm_total = node_ex.allocatable.get(hbm_path, 0)
+            hbm_free = hbm_total - node_ex.used.get(hbm_path, 0)
             chips.append(ChipEntry(
                 coords=coords, prefix=prefix, node_name=node_name,
                 free=node_ex.used.get(res, 0) == 0, links=int(links),
-                hbm_free=hbm_free))
+                hbm_free=hbm_free, hbm_total=hbm_total))
     return chips
 
 
