@@ -5,8 +5,9 @@ from --seed, or restored from a train_demo --checkpoint-dir), submits a
 stream of synthetic requests with mixed prompt lengths, drives the
 slot-based `DecodeServer`, and prints one JSON line of stats. With
 --speculative, the same requests run through speculative decoding with a
-smaller auto-built draft model instead — greedy, or temperature-sampled
-when --temperature is set (full-softmax pair only; no --top-k/--top-p).
+smaller auto-built draft model instead — greedy, or sampled when
+--temperature is set (with --top-k/--top-p both distributions are
+truncated and renormalized; the acceptance rule stays exact).
 
 Examples:
     python -m kubegpu_tpu.cmd.serve_demo --requests 8 --slots 4
@@ -42,16 +43,16 @@ def main(argv=None) -> int:
     ap.add_argument("--speculative", action="store_true",
                     help="speculative decoding with a draft model "
                          "(greedy, or sampled when --temperature is set)")
+    ap.add_argument("--spec-server", action="store_true",
+                    help="speculative mode INSIDE the continuous-batching "
+                         "server: per-slot draft proposals, one batched "
+                         "verify")
     ap.add_argument("--draft-layers", type=int, default=1)
     ap.add_argument("--lookahead", type=int, default=4,
                     help="draft tokens per speculative round (k)")
     args = ap.parse_args(argv)
     if args.requests < 1:
         ap.error("--requests must be >= 1")
-    if args.speculative and (args.top_k or args.top_p < 1.0):
-        ap.error("--speculative sampling is temperature-only "
-                 "(no --top-k/--top-p; the exactness proof is for the "
-                 "full softmax pair)")
 
     import jax
 
@@ -94,18 +95,22 @@ def main(argv=None) -> int:
                                              int(rng.integers(4, 24)))]
                for _ in range(args.requests)]
 
-    t0 = time.perf_counter()
-    if args.speculative:
-        from kubegpu_tpu.workload.speculative import (
-            make_speculative_generate)
-
+    draft_cfg = draft = None
+    if args.speculative or args.spec_server:
         draft_cfg = TransformerConfig(
             vocab=args.vocab, d_model=max(32, args.d_model // 4),
             n_heads=args.n_heads, n_layers=args.draft_layers,
             d_ff=args.d_model, max_seq=args.seq)
         draft = init_params(jax.random.PRNGKey(args.seed + 1), draft_cfg)
+
+    t0 = time.perf_counter()
+    if args.speculative:
+        from kubegpu_tpu.workload.speculative import (
+            make_speculative_generate)
+
         gen = make_speculative_generate(cfg, draft_cfg, k=args.lookahead,
-                                        temperature=args.temperature)
+                                        temperature=args.temperature,
+                                        top_k=args.top_k, top_p=args.top_p)
         outs, calls = [], 0
         for i, p in enumerate(prompts):
             out, c = gen(params, draft, p, args.max_new,
@@ -120,11 +125,14 @@ def main(argv=None) -> int:
         srv = DecodeServer(cfg, params, slots=args.slots,
                            temperature=args.temperature, top_k=args.top_k,
                            top_p=args.top_p,
-                           rng=jax.random.PRNGKey(args.seed))
+                           rng=jax.random.PRNGKey(args.seed),
+                           draft_params=draft, draft_cfg=draft_cfg,
+                           lookahead=args.lookahead)
         rids = [srv.submit(p, max_new=args.max_new) for p in prompts]
         srv.run()
         outs = [srv.result(r) for r in rids]
-        stats = {"mode": "serve", "slots": args.slots,
+        stats = {"mode": "spec-serve" if args.spec_server else "serve",
+                 "slots": args.slots,
                  "tokens": sum(len(o) for o in outs)}
     wall = time.perf_counter() - t0
 

@@ -1159,6 +1159,8 @@ class Scheduler:
         Returns an assignment dict when an entirely-free block was found
         (place immediately, no eviction), True when victims were evicted
         and the block nominated (requeue and retry), False otherwise."""
+        from kubegpu_tpu.scheduler.gang import gang_key
+
         try:
             pods = self.api.list_pods()
         except Exception:
@@ -1166,6 +1168,8 @@ class Scheduler:
         pods_by_name: dict = {}
         owners: dict = {}
         may_evict: set = set()
+        gang_of: dict = {}       # bound pod -> its gang id
+        gang_members: dict = {}  # gang id -> bound member names
         member_names = {m["metadata"]["name"] for m in members}
         for p in pods:
             name = p["metadata"]["name"]
@@ -1186,11 +1190,29 @@ class Scheduler:
                     prefix = grammar.chip_prefix_from_path(path)
                     if prefix is not None:
                         owners[(node, prefix)] = name
+            gk = gang_key(p)
+            if gk is not None:
+                gang_of[name] = gk[0]
+                gang_members.setdefault(gk[0], set()).add(name)
             if _pod_priority(p) < gang_prio:
                 may_evict.add(name)
         if not may_evict:
             return False
         pdb_state = self.generic._pdb_state()
+
+        def closure(victim_names) -> frozenset | None:
+            """Expand victims to whole bound gangs: evicting one member
+            of a running gang strands its siblings mid-collective, so
+            the eviction unit is the gang. None = some closure member is
+            not evictable (higher priority) — the block is forbidden."""
+            out = set(victim_names)
+            for n in victim_names:
+                g = gang_of.get(n)
+                if g is not None:
+                    out |= gang_members[g]
+            if not out <= may_evict:
+                return None
+            return frozenset(out)
 
         def cost(victim_names: frozenset):
             if not victim_names:
@@ -1198,7 +1220,10 @@ class Scheduler:
                 # be negative, so no 4-tuple sentinel is safely minimal;
                 # a shorter tuple with a unique first element is)
                 return (-1,)
-            victims = [pods_by_name[n] for n in victim_names]
+            full = closure(victim_names)
+            if full is None:
+                return None
+            victims = [pods_by_name[n] for n in full]
             violating, _ = GenericScheduler._split_by_pdb_violation(
                 victims, pdb_state)
             prios = [_pod_priority(v) for v in victims]
@@ -1215,7 +1240,10 @@ class Scheduler:
             # assignment straight back — retrying plan() would fail the
             # same way and ping-pong forever
             return assignment
-        for victim_name in sorted(victim_names):
+        full_victims = closure(victim_names)
+        if full_victims is None:  # defensive: cost() already forbade this
+            return False
+        for victim_name in sorted(full_victims):
             metrics.PREEMPTION_VICTIMS.inc()
             self._event(victim_name, "Normal", "Preempted",
                         f"by gang of {sorted(member_names)} "

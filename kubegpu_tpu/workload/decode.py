@@ -187,19 +187,10 @@ def validate_sampling(cfg: TransformerConfig, temperature: float,
     return min(top_k, cfg.vocab)
 
 
-def _select_token(logits, key, temperature: float, top_k: int,
-                  top_p: float):
-    """Pick the next token per batch row from ``logits [B, V]``.
-
-    ``temperature == 0`` is greedy argmax (no key needed). Otherwise
-    temperature-scaled sampling, optionally truncated to the ``top_k``
-    highest-logit tokens and/or the ``top_p`` nucleus (smallest set of
-    tokens whose probability mass reaches ``top_p``). Truncations are
-    implemented as logit thresholds so everything stays static-shaped
-    for the decode scan."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    z = logits.astype(jnp.float32) / temperature
+def _truncate_logits(z, top_k: int, top_p: float):
+    """Apply top-k / nucleus truncation to scaled logits ``z [B, V]``
+    (masked tokens go to NEG_INF). Shared by direct sampling and
+    speculative decoding so both see the IDENTICAL truncated support."""
     if top_k:
         kth = lax.top_k(z, top_k)[0][:, -1:]  # k-th largest per row
         z = jnp.where(z < kth, NEG_INF, z)
@@ -216,6 +207,35 @@ def _select_token(logits, key, temperature: float, top_k: int,
         thr = jnp.min(jnp.where(keep, z_sorted, jnp.inf),
                       axis=-1, keepdims=True)
         z = jnp.where(z < thr, NEG_INF, z)
+    return z
+
+
+def truncated_probs(logits, temperature: float, top_k: int, top_p: float):
+    """The exact distribution `_select_token` samples from:
+    temperature-scaled softmax truncated to the top-k/nucleus support
+    and RENORMALIZED, per row. Speculative sampling runs its acceptance
+    rule on these for BOTH target and draft — the standard
+    truncate-and-renormalize construction under which the
+    rejection-resampling theorem stays exact for the truncated target."""
+    z = _truncate_logits(logits.astype(jnp.float32) / temperature,
+                         top_k, top_p)
+    return jax.nn.softmax(z, axis=-1)
+
+
+def _select_token(logits, key, temperature: float, top_k: int,
+                  top_p: float):
+    """Pick the next token per batch row from ``logits [B, V]``.
+
+    ``temperature == 0`` is greedy argmax (no key needed). Otherwise
+    temperature-scaled sampling, optionally truncated to the ``top_k``
+    highest-logit tokens and/or the ``top_p`` nucleus (smallest set of
+    tokens whose probability mass reaches ``top_p``). Truncations are
+    implemented as logit thresholds so everything stays static-shaped
+    for the decode scan."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    z = _truncate_logits(logits.astype(jnp.float32) / temperature,
+                         top_k, top_p)
     return jax.random.categorical(key, z, axis=-1)
 
 

@@ -39,9 +39,10 @@ from dataclasses import dataclass, field
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from kubegpu_tpu.workload.decode import (_select_token, init_cache,
-                                         make_forward_step,
+                                         make_forward_step, truncated_probs,
                                          validate_sampling)
 from kubegpu_tpu.workload.model import TransformerConfig
 
@@ -70,15 +71,27 @@ class DecodeServer:
     drives admission + decoding until done. Greedy by default; sampling
     via ``temperature``/``top_k``/``top_p`` + ``rng`` like
     `make_generate`.
+
+    With ``draft_params``/``draft_cfg`` the server decodes
+    SPECULATIVELY per slot: each step proposes ``lookahead`` draft
+    tokens for every slot, verifies all slots in one batched target
+    forward, and emits each slot's accepted prefix plus one token —
+    greedy-exact, and distribution-exact under sampling (both target
+    and draft rows truncated-and-renormalized, `speculative.py`'s
+    acceptance rule vmapped over slots).
     """
 
     def __init__(self, cfg: TransformerConfig, params, slots: int = 4,
                  max_seq: int | None = None, mesh=None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: int | None = None,
-                 prefill_buckets: tuple = (32, 128, 512), rng=None):
+                 prefill_buckets: tuple = (32, 128, 512), rng=None,
+                 draft_params=None, draft_cfg: TransformerConfig | None = None,
+                 lookahead: int = 4):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("draft_params and draft_cfg go together")
         self.cfg = cfg
         self.params = params
         self.slots = slots
@@ -132,6 +145,111 @@ class DecodeServer:
 
         self._decode = jax.jit(decode, donate_argnums=(1,))
 
+        # -- speculative mode: a draft model proposes k tokens per slot,
+        # the target verifies every slot's chunk in ONE batched forward
+        self.spec = draft_params is not None
+        if self.spec:
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError("draft and target must share a vocabulary")
+            if lookahead < 1 or lookahead + 2 > min(self.buckets):
+                # idle slots ride along writing garbage K/V at positions
+                # 0..k; the admit prefill overwrites [0, bucket), so the
+                # smallest bucket bounds the lookahead
+                raise ValueError(
+                    f"lookahead must be in [1, {min(self.buckets) - 2}] "
+                    f"(smallest prefill bucket {min(self.buckets)})")
+            self.k = lookahead
+            self.draft_params = draft_params
+            self._dstep = make_forward_step(draft_cfg, mesh)
+            self.dcache = init_cache(draft_cfg, slots, self.max_seq)
+            self.prev = np.zeros(slots, np.int32)   # token at pos-1
+            sampling = self.temperature != 0.0
+            k = self.k
+
+            def pick(logits, key):
+                """[S, V] -> sampled/greedy token (and its truncated
+                distribution row when sampling)."""
+                if sampling:
+                    p = truncated_probs(logits, self.temperature,
+                                        self.top_k, self.top_p)
+                    return jax.random.categorical(
+                        key, jnp.log(jnp.maximum(p, 1e-30))), p
+                return jnp.argmax(logits, axis=-1), jnp.zeros(())
+
+            def spec_propose(dparams, dcache, prev, tok, pos, key):
+                """k draft tokens per slot. First step reprocesses
+                [prev, tok] at pos-1: after a fully-accepted round the
+                draft never saw its own k-th proposal (K/V hole at
+                pos-1); re-writing prev there fills it, idempotently
+                otherwise — same catch-up trick as
+                speculative.draft_propose, batched."""
+                chunk = jnp.stack([prev, tok], axis=1)         # [S, 2]
+                start = jnp.maximum(pos - 1, 0)
+                logits, dcache = self._dstep(dparams, dcache, chunk, start)
+                first, q0 = pick(logits[:, -1, :],
+                                 jax.random.fold_in(key, 0))
+
+                def body(carry, i):
+                    dcache, t, p = carry
+                    logits, dcache = self._dstep(dparams, dcache,
+                                                 t[:, None], p)
+                    nxt, q = pick(logits[:, -1, :],
+                                  jax.random.fold_in(key, i))
+                    return (dcache, nxt, p + 1), (nxt, q)
+
+                (dcache, _, _), (toks, qs) = lax.scan(
+                    body, (dcache, first, pos + 1), jnp.arange(1, k))
+                drafts = first[:, None] if k == 1 else jnp.concatenate(
+                    [first[:, None], jnp.swapaxes(toks, 0, 1)], axis=1)
+                if sampling:
+                    q_rows = q0[:, None] if k == 1 else jnp.concatenate(
+                        [q0[:, None], jnp.moveaxis(qs, 0, 1)], axis=1)
+                else:
+                    q_rows = jnp.zeros(())
+                return dcache, drafts.astype(jnp.int32), q_rows
+
+            def spec_verify(params, cache, chunk, pos, key, q_rows):
+                """One batched target forward over every slot's
+                [last, d1..dk] chunk; per-slot acceptance. Greedy
+                ignores ``q_rows`` (pass a dummy scalar)."""
+                logits, cache = self._fstep(params, cache, chunk, pos)
+                s = chunk.shape[0]
+                if sampling:
+                    from kubegpu_tpu.workload.speculative import \
+                        accept_resample
+
+                    p_rows = truncated_probs(
+                        logits.reshape(s * (k + 1), -1), self.temperature,
+                        self.top_k, self.top_p).reshape(s, k + 1, -1)
+                    n_acc, extra = jax.vmap(accept_resample)(
+                        p_rows, q_rows, chunk[:, 1:],
+                        jax.random.split(key, s))
+                    return cache, n_acc, extra
+                greedy = jnp.argmax(logits, axis=-1)       # [S, k+1]
+                agree = chunk[:, 1:] == greedy[:, :-1]
+                n_acc = jnp.argmin(jnp.concatenate(
+                    [agree, jnp.zeros((s, 1), bool)],
+                    axis=1).astype(jnp.int32), axis=1)
+                extra = jnp.take_along_axis(
+                    greedy, n_acc[:, None], axis=1)[:, 0]
+                return cache, n_acc, extra
+
+            self._spec_propose = jax.jit(spec_propose, donate_argnums=(1,))
+            self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
+
+            def dprefill(dparams, dcache, tokens, slot):
+                small = init_cache(draft_cfg, 1, tokens.shape[1])
+                _, small = self._dstep(dparams, small, tokens, 0)
+                new_cache = []
+                for big, sm in zip(dcache, small):
+                    new_cache.append({
+                        kk: jax.lax.dynamic_update_slice(
+                            big[kk], sm[kk], (slot, 0, 0, 0))
+                        for kk in ("k", "v")})
+                return new_cache
+
+            self._dprefill = jax.jit(dprefill, donate_argnums=(1,))
+
     # -- public API ----------------------------------------------------------
 
     def submit(self, prompt, max_new: int) -> int:
@@ -139,10 +257,14 @@ class DecodeServer:
             raise ValueError("empty prompt")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
-        if len(prompt) + max_new > self.max_seq:
+        # speculative verify may write k+1 positions past the last
+        # emitted token before truncation — reserve the headroom
+        headroom = (self.k + 1) if self.spec else 0
+        if len(prompt) + max_new + headroom > self.max_seq:
             raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new} exceeds "
-                f"max_seq {self.max_seq}")
+                f"prompt {len(prompt)} + max_new {max_new}"
+                + (f" + lookahead headroom {headroom}" if headroom else "")
+                + f" exceeds max_seq {self.max_seq}")
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid, list(prompt), max_new)
@@ -169,14 +291,17 @@ class DecodeServer:
         return len(self._queue) + sum(r is not None for r in self.slot_req)
 
     def step(self) -> int:
-        """Admit what fits, decode one token for every active slot.
-        Returns the number of active slots stepped."""
+        """Admit what fits, decode for every active slot — one token per
+        step, or up to ``lookahead + 1`` in speculative mode. Returns
+        the number of active slots stepped."""
         while self._free and self._queue:
             self._admit(self._free.pop(0), self._queue.pop(0))
         active = [s for s in range(self.slots)
                   if self.slot_req[s] is not None]
         if not active:
             return 0
+        if self.spec:
+            return self._spec_step(active)
         key = jax.random.fold_in(self.rng, self._tick)
         self._tick += 1
         self.cache, nxt = self._decode(
@@ -192,6 +317,45 @@ class DecodeServer:
             if (self.eos_id is not None and tok == self.eos_id) or \
                     len(req.out) >= req.max_new:
                 self._finish(s)
+        return len(active)
+
+    def _spec_step(self, active: list) -> int:
+        """One speculative round for the whole batch: k draft proposals
+        per slot, one batched target verify, per-slot acceptance."""
+        key = jax.random.fold_in(self.rng, self._tick)
+        self._tick += 1
+        kd, kv = jax.random.split(key)
+        self.dcache, drafts, q_rows = self._spec_propose(
+            self.draft_params, self.dcache, jnp.asarray(self.prev),
+            jnp.asarray(self.tok), jnp.asarray(self.pos), kd)
+        chunk = jnp.concatenate(
+            [jnp.asarray(self.tok)[:, None], drafts], axis=1)
+        self.cache, n_acc, extra = self._spec_verify(
+            self.params, self.cache, chunk, jnp.asarray(self.pos), kv,
+            q_rows)
+        n_acc = np.asarray(n_acc)
+        extra = np.asarray(extra)
+        chunk_np = np.asarray(chunk)
+        for s in active:
+            req = self.slot_req[s]
+            n = int(n_acc[s])
+            # the round's tokens: n accepted drafts + correction/bonus
+            new = [int(x) for x in chunk_np[s, 1:n + 1]] + [int(extra[s])]
+            emitted = []
+            for t in new:
+                emitted.append(t)
+                if (self.eos_id is not None and t == self.eos_id) or \
+                        len(req.out) + len(emitted) >= req.max_new:
+                    break
+            req.out.extend(emitted)
+            if (self.eos_id is not None and self.eos_id in emitted) or \
+                    len(req.out) >= req.max_new:
+                self._finish(s)
+            else:
+                # full round emitted: advance exactly n+1 positions
+                self.pos[s] += n + 1
+                self.prev[s] = int(chunk_np[s, n])
+                self.tok[s] = emitted[-1]
         return len(active)
 
     def run(self, max_steps: int = 10_000) -> None:
@@ -220,6 +384,11 @@ class DecodeServer:
         self.slot_req[slot] = req
         self.tok[slot] = first
         self.pos[slot] = n
+        if self.spec:
+            self.dcache = self._dprefill(
+                self.draft_params, self.dcache, jnp.asarray(padded),
+                jnp.int32(slot))
+            self.prev[slot] = req.prompt[-1]  # draft catch-up anchor
         if (self.eos_id is not None and first == self.eos_id) or \
                 len(req.out) >= req.max_new:
             self._finish(slot)
@@ -230,4 +399,6 @@ class DecodeServer:
         self.slot_req[slot] = None
         self.pos[slot] = 0
         self.tok[slot] = 0
+        if self.spec:
+            self.prev[slot] = 0
         self._free.append(slot)

@@ -15,7 +15,11 @@ plus one more token. Two modes, both exact:
   ``min(1, p(d)/q(d))``, resample the first rejection from
   ``normalize(max(p - q, 0))`` — under which every emitted token is
   distributed exactly as temperature-sampling the target, whatever the
-  draft proposes (asserted statistically).
+  draft proposes (asserted statistically). With ``top_k``/``top_p``,
+  BOTH target and draft distributions are truncated-and-renormalized
+  (`decode.truncated_probs`) before the same rule: the theorem holds
+  for any proposal, so emitted tokens are distributed exactly as the
+  truncated target — i.e. exactly `make_generate`'s sampling.
 
 TPU-static design: every device program has fixed shapes — the draft
 proposal is a ``k``-step `lax.scan`, the verify is one ``k+1``-token
@@ -42,7 +46,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from kubegpu_tpu.workload.decode import init_cache, make_forward_step
+from kubegpu_tpu.workload.decode import (_select_token, init_cache,
+                                         make_forward_step, truncated_probs,
+                                         validate_sampling)
 from kubegpu_tpu.workload.model import TransformerConfig
 
 
@@ -84,7 +90,8 @@ def make_speculative_generate(target_cfg: TransformerConfig,
                               draft_cfg: TransformerConfig,
                               k: int = 4, mesh=None,
                               max_seq: int | None = None,
-                              temperature: float = 0.0):
+                              temperature: float = 0.0,
+                              top_k: int = 0, top_p: float = 1.0):
     """Build ``generate(target_params, draft_params, prompt, n_new[, rng])
     -> (tokens [B=1 row list], target_calls)``.
 
@@ -92,33 +99,35 @@ def make_speculative_generate(target_cfg: TransformerConfig,
     output EXACTLY the target's greedy sequence. ``temperature > 0`` is
     speculative SAMPLING with the rejection-resampling acceptance rule
     (`accept_resample`): every emitted token is distributed exactly as
-    temperature-sampling the target, whatever the draft proposes
-    (top-k/top-p truncation is not offered here — the exactness proof
-    is for the full softmax pair). ``k`` is the draft lookahead per
-    round. Both models must share the vocab.
+    temperature-sampling the target, whatever the draft proposes. With
+    ``top_k``/``top_p`` both target and draft rows are truncated and
+    renormalized (`decode.truncated_probs`) before the same rule, which
+    keeps the acceptance distribution-exact for the TRUNCATED target —
+    exactly what `make_generate` samples. ``k`` is the draft lookahead
+    per round. Both models must share the vocab.
     """
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     if target_cfg.vocab != draft_cfg.vocab:
         raise ValueError("draft and target must share a vocabulary")
-    if temperature < 0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    top_k = validate_sampling(target_cfg, temperature, top_k, top_p)
     sampling = temperature != 0.0
     max_seq = max_seq or min(target_cfg.max_seq, draft_cfg.max_seq)
     t_step = make_forward_step(target_cfg, mesh)
     d_step = make_forward_step(draft_cfg, mesh)
 
     def probs(logits):
-        return jax.nn.softmax(logits.astype(jnp.float32) / temperature,
-                              axis=-1)
+        """[B?, V] -> truncated-and-renormalized sampling distribution
+        (the full softmax when top_k/top_p are off)."""
+        squeeze = logits.ndim == 1
+        rows = logits[None, :] if squeeze else logits
+        out = truncated_probs(rows, temperature, top_k, top_p)
+        return out[0] if squeeze else out
 
     def prefill(params, step, cache, prompt, key):
         logits, cache = step(params, cache, prompt, 0)
-        if sampling:
-            tok = jax.random.categorical(
-                key, logits[:, -1, :].astype(jnp.float32) / temperature)
-        else:
-            tok = jnp.argmax(logits[:, -1, :], axis=-1)
+        tok = _select_token(logits[:, -1, :], key, temperature, top_k,
+                            top_p)
         return cache, tok
 
     prefill_t = jax.jit(lambda p, c, x, s: prefill(p, t_step, c, x, s),
@@ -144,7 +153,11 @@ def make_speculative_generate(target_cfg: TransformerConfig,
         ``pos-1`` — re-processing ``prev`` there fills the hole (and is
         an idempotent rewrite when no hole exists). Without this, the
         round after a full accept proposes against a zeroed cache row
-        and acceptance collapses."""
+        and acceptance collapses.
+
+        NOTE: `serve.DecodeServer._spec_propose` is this function's
+        batched (per-slot) twin — any change to the catch-up logic or
+        the q-row plumbing must be mirrored there."""
         chunk = jnp.stack([prev, token], axis=1)        # [1, 2]
         logits, cache = d_step(params, cache, chunk, pos - 1)
         first, q0 = pick(logits[:, -1, :], jax.random.fold_in(key, 0))

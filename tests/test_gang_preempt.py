@@ -178,6 +178,22 @@ def test_gang_preemption_is_pdb_aware():
     assert not alive(api, "fair")     # the unprotected blocker paid
 
 
+def test_gang_preemption_evicts_whole_victim_gang():
+    """Evicting one member of a bound gang would strand its siblings
+    mid-collective: the eviction unit is the WHOLE gang, and the cost
+    accounts for every member."""
+    api, hosts, sched = slice_cluster([(0, 0, 0), (2, 0, 0)], (4, 2, 1))
+    low = submit_gang(api, 50, 2, numchips=4, priority=0, prefix="low")
+    sched.run_until_idle()
+    assert all(api.get_pod(n)["spec"].get("nodeName") for n in low)
+    hi = submit_gang(api, 51, 2, numchips=4, priority=10, prefix="big")
+    sched.run_until_idle()
+    coords = bound_coords(api, hosts, hi)
+    assert all(v is not None for v in coords.values()), coords
+    # no stranded sibling: BOTH low-gang members are gone
+    assert not alive(api, low[0]) and not alive(api, low[1])
+
+
 def test_planner_respects_reserved_room():
     """plan() must not hand a gang the chips a nominated preemptor is
     owed: with the whole cluster free but every chip reserved, the gang

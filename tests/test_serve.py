@@ -97,6 +97,103 @@ def test_eos_frees_slot_early(setup):
     assert srv.result(rid) == [first]  # stopped at EOS, not max_new
 
 
+@pytest.fixture(scope="module")
+def draft_setup():
+    cfg = small_cfg(n_layers=1, d_model=16, d_ff=32)
+    params = init_params(jax.random.PRNGKey(9), cfg)
+    return cfg, params
+
+
+def test_speculative_server_matches_greedy_server(setup, draft_setup):
+    """VERDICT r4 #4: spec-mode tokens equal server tokens. Greedy
+    speculative serving emits EXACTLY the plain server's (and
+    make_generate's) sequence for every request, including mixed prompt
+    lengths sharing the batch and slot recycling."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    srv = DecodeServer(cfg, params, slots=2, prefill_buckets=(8, 16),
+                       draft_params=dparams, draft_cfg=dcfg, lookahead=3)
+    prompts = [[1, 2, 3], [9, 8, 7, 6, 5], [4, 4], [2, 7, 1, 8]]
+    rids = [srv.submit(p, max_new=7) for p in prompts]
+    srv.run()
+    for rid, p in zip(rids, prompts):
+        assert srv.result(rid) == _greedy_reference(cfg, params, p, 7), p
+
+
+def test_speculative_server_self_draft_exact(setup):
+    """Draft == target accepts everything; tokens still exactly greedy."""
+    cfg, params = setup
+    srv = DecodeServer(cfg, params, slots=2, prefill_buckets=(8,),
+                       draft_params=params, draft_cfg=cfg, lookahead=4)
+    rid = srv.submit([3, 1, 4, 1, 5], max_new=9)
+    srv.run()
+    assert srv.result(rid) == _greedy_reference(cfg, params,
+                                                [3, 1, 4, 1, 5], 9)
+
+
+def test_speculative_server_eos_mid_round(setup, draft_setup):
+    """EOS inside an accepted round truncates the emission there."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    ref = _greedy_reference(cfg, params, [1, 2, 3], 8)
+    eos = ref[2]  # stop at the 3rd emitted token
+    srv = DecodeServer(cfg, params, slots=1, prefill_buckets=(8,),
+                       eos_id=eos,
+                       draft_params=dparams, draft_cfg=dcfg, lookahead=4)
+    rid = srv.submit([1, 2, 3], max_new=8)
+    srv.run()
+    out = srv.result(rid)
+    want = ref[:ref.index(eos) + 1]
+    assert out == want, (out, want)
+
+
+def test_speculative_server_sampling_deterministic(setup, draft_setup):
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+
+    def run(seed):
+        srv = DecodeServer(cfg, params, slots=2, temperature=0.9,
+                           top_p=0.9, rng=jax.random.PRNGKey(seed),
+                           prefill_buckets=(8,),
+                           draft_params=dparams, draft_cfg=dcfg,
+                           lookahead=3)
+        rid = srv.submit([3, 1, 4], max_new=6)
+        srv.run()
+        return srv.result(rid)
+
+    assert run(0) == run(0)
+    assert len(run(0)) == 6
+    runs = {tuple(run(s)) for s in range(4)}
+    assert len(runs) > 1  # seeds vary the sample
+
+
+def test_speculative_server_topk1_sampling_is_greedy(setup, draft_setup):
+    """top_k=1 collapses the truncated distribution to the argmax: the
+    SAMPLED speculative server must emit the greedy sequence exactly."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    srv = DecodeServer(cfg, params, slots=2, temperature=1.0, top_k=1,
+                       rng=jax.random.PRNGKey(11), prefill_buckets=(8,),
+                       draft_params=dparams, draft_cfg=dcfg, lookahead=3)
+    rid = srv.submit([6, 2, 8], max_new=7)
+    srv.run()
+    assert srv.result(rid) == _greedy_reference(cfg, params, [6, 2, 8], 7)
+
+
+def test_speculative_server_validation(setup, draft_setup):
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    with pytest.raises(ValueError, match="go together"):
+        DecodeServer(cfg, params, draft_params=dparams)
+    with pytest.raises(ValueError, match="lookahead"):
+        DecodeServer(cfg, params, prefill_buckets=(8,),
+                     draft_params=dparams, draft_cfg=dcfg, lookahead=7)
+    srv = DecodeServer(cfg, params, prefill_buckets=(8,),
+                       draft_params=dparams, draft_cfg=dcfg, lookahead=3)
+    with pytest.raises(ValueError, match="headroom"):
+        srv.submit([1] * 10, max_new=cfg.max_seq - 12)
+
+
 def test_sampling_mode_is_deterministic_per_seed(setup):
     cfg, params = setup
 

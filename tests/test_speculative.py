@@ -112,6 +112,71 @@ def test_sampled_deterministic_per_key_and_needs_rng(models):
         make_speculative_generate(target_cfg, draft_cfg, temperature=-1.0)
 
 
+@pytest.mark.parametrize("trunc", [{"top_k": 1}, {"top_p": 1e-6}])
+def test_truncation_to_argmax_reproduces_greedy(models, trunc):
+    """End-to-end exactness under truncation: top_k=1 (or a nucleus so
+    small only the argmax survives) collapses the truncated target
+    distribution to a point mass, so SAMPLED speculative decoding must
+    emit exactly the target's greedy sequence for every rng key."""
+    target_cfg, target, draft_cfg, draft = models
+    gen = make_speculative_generate(target_cfg, draft_cfg, k=3,
+                                    temperature=1.0, **trunc)
+    prompt = [3, 1, 4]
+    want = _target_greedy(target_cfg, target, prompt, 10)
+    for seed in (0, 1, 2):
+        got, _ = gen(target, draft, prompt, 10, jax.random.PRNGKey(seed))
+        assert got == want, (trunc, seed, got, want)
+
+
+def test_truncated_accept_resample_emits_truncated_target():
+    """The truncate-and-renormalize construction: with BOTH p and q
+    truncated (top_p=0.9 here) and renormalized — exactly what
+    make_speculative_generate feeds the acceptance rule — the first
+    emitted token of a round is distributed as the TRUNCATED target,
+    i.e. what make_generate's top-p sampling draws from."""
+    from kubegpu_tpu.workload.decode import truncated_probs
+    from kubegpu_tpu.workload.speculative import accept_resample
+
+    rng = np.random.default_rng(1)
+    V, k, N = 6, 3, 4000
+    zp = jnp.asarray(rng.normal(size=(k + 1, V)).astype(np.float32)) * 2
+    zq = jnp.asarray(rng.normal(size=(k, V)).astype(np.float32)) * 2
+    p_rows = truncated_probs(zp, 1.0, 0, 0.9)
+    q_rows = truncated_probs(zq, 1.0, 0, 0.9)
+    assert float(jnp.sum(p_rows[0] == 0)) > 0  # truncation really bit
+
+    accept = jax.jit(accept_resample)
+    counts = np.zeros(V)
+    for i in range(N):
+        key = jax.random.PRNGKey(i)
+        kd, ka = jax.random.split(key)
+        d0 = jax.random.categorical(
+            kd, jnp.log(jnp.maximum(q_rows, 1e-30)))
+        n_acc, extra = accept(p_rows, q_rows, d0, ka)
+        first = int(d0[0]) if int(n_acc) >= 1 else int(extra)
+        counts[first] += 1
+    emp = counts / N
+    want = np.asarray(p_rows[0])
+    np.testing.assert_allclose(emp, want, atol=0.033,
+                               err_msg=f"emp={emp} want={want}")
+    # nothing outside the truncated support was ever emitted
+    assert counts[np.asarray(p_rows[0]) == 0].sum() == 0
+
+
+def test_topk_topp_deterministic_and_validated(models):
+    target_cfg, target, draft_cfg, draft = models
+    gen = make_speculative_generate(target_cfg, draft_cfg, k=2,
+                                    temperature=0.8, top_p=0.9, top_k=8)
+    a = gen(target, draft, [5, 6], 8, jax.random.PRNGKey(3))[0]
+    b = gen(target, draft, [5, 6], 8, jax.random.PRNGKey(3))[0]
+    assert a == b and len(a) == 8
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        make_speculative_generate(target_cfg, draft_cfg, top_k=5)
+    with pytest.raises(ValueError, match="top_p"):
+        make_speculative_generate(target_cfg, draft_cfg, temperature=1.0,
+                                  top_p=0.0)
+
+
 def test_accept_resample_emits_target_distribution():
     """The theorem behind speculative sampling: whatever q proposes, the
     FIRST emitted token of a round is distributed exactly as p[0].
