@@ -56,6 +56,22 @@ HBM_GB = [("v6e", 30.0), ("v6 lite", 30.0), ("v5p", 93.0),
           ("v5 lite", 15.75), ("v5e", 15.75), ("v5", 93.0),
           ("v4", 30.0), ("v3", 30.0), ("v2", 15.0)]
 
+# Peak HBM bandwidth per chip (GB/s, public specs) — the denominator of
+# decode MBU (model-bandwidth-utilization): decode at small batch is
+# parameter-bandwidth-bound, so bytes-moved/step over this peak is the
+# roofline fraction the decode path achieves.
+HBM_GBPS = [("v6e", 1638.0), ("v6 lite", 1638.0), ("v5p", 2765.0),
+            ("v5 lite", 819.0), ("v5e", 819.0), ("v5", 2765.0),
+            ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0)]
+
+
+def hbm_bw_for(kind_str: str) -> float:
+    ks = (kind_str or "").lower()
+    for tag, bw in HBM_GBPS:
+        if tag in ks:
+            return bw
+    return 819.0  # conservative: smallest current part
+
 
 def peak_for(kind_str: str) -> float:
     ks = (kind_str or "").lower()
@@ -405,6 +421,15 @@ def config6_scale():
 _WORKLOAD_BENCH = r"""
 import json, math, os, time
 import jax, jax.numpy as jnp
+
+# honor an explicit platform choice even under a sitecustomize that pins
+# the axon TPU plugin (env alone is ignored there) — without this the
+# "cpu fallback" workload silently runs on the tunnel
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
 from kubegpu_tpu.workload.model import TransformerConfig
 from kubegpu_tpu.workload.train import init_sharded, make_train_step
 from kubegpu_tpu.workload.decode import make_generate
@@ -597,6 +622,148 @@ jax.device_get(out)  # host transfer = the sync barrier
 decode_s = (time.perf_counter() - t0) / decode_iters
 decode_tok_s = B * gen_len / decode_s
 
+# ---- serving stack at a FIXED decode sizing (VERDICT r4 #3) ----------
+# The headline decode number tracks whatever training sizing the ladder
+# picked (it moved 2151 -> 1867 tok/s purely because the ladder chose
+# d2304); the serving metrics below use a sizing chosen FOR decode that
+# never drifts with the ladder. The training state is freed first: the
+# serving model owns its own memory.
+params = opt_state = compiled = None
+import gc
+gc.collect()
+from kubegpu_tpu.workload.model import init_params
+from kubegpu_tpu.workload.serve import DecodeServer
+from kubegpu_tpu.workload.speculative import make_speculative_generate
+import numpy as _np
+
+if preset == "tpu":
+    DEC = dict(vocab=8192, d_model=2048, n_heads=16, n_layers=6,
+               d_ff=8192, max_seq=1024)
+    sv_max_new, sv_req, spec_new, spec_reps = 64, 8, 64, 2
+else:
+    DEC = dict(vocab=512, d_model=128, n_heads=4, n_layers=2,
+               d_ff=512, max_seq=256)
+    sv_max_new, sv_req, spec_new, spec_reps = 16, 6, 24, 1
+dec_cfg = TransformerConfig(**DEC)
+dec_params = init_params(jax.random.PRNGKey(7), dec_cfg)
+_prng = _np.random.default_rng(0)
+sv_prompts = [
+    _prng.integers(1, DEC["vocab"], int(n)).tolist()
+    for n in _np.linspace(16, DEC["max_seq"] // 2, sv_req)]
+
+def serve_run(srv):
+    # drive to drain, counting per-step active slots (utilization)
+    rids = [srv.submit(p, max_new=sv_max_new) for p in sv_prompts]
+    nsteps = act = 0
+    while srv.pending:
+        act += srv.step()
+        nsteps += 1
+    toks = sum(len(srv.result(r)) for r in rids)
+    return toks, act / max(1, nsteps * srv.slots)
+
+srv = DecodeServer(dec_cfg, dec_params, slots=4)
+serve_run(srv)  # compile pass (prefill buckets + decode step)
+t0 = time.perf_counter()
+sv_toks, sv_util = serve_run(srv)
+serve_s = time.perf_counter() - t0  # every step() host-transfers tokens
+serve_tok_s = sv_toks / serve_s
+
+# decode MBU: single-stream generate at the fixed sizing; bytes/step =
+# full f32 parameter read (decode casts per step) + the KV cache scan.
+dec_gen = jax.jit(make_generate(dec_cfg), static_argnums=(2,))
+mbu_B, mbu_prompt, mbu_new = 4, 128, 64
+pt = jnp.asarray(_prng.integers(1, DEC["vocab"], (mbu_B, mbu_prompt)),
+                 jnp.int32)
+o = dec_gen(dec_params, pt, mbu_new)
+jax.device_get(o)
+t0 = time.perf_counter()
+for _ in range(decode_iters):
+    o = dec_gen(dec_params, pt, mbu_new)
+jax.device_get(o)
+fixed_dec_s = (time.perf_counter() - t0) / decode_iters
+fixed_dec_tok_s = mbu_B * mbu_new / fixed_dec_s
+d_, L_, dff_, V_ = (DEC["d_model"], DEC["n_layers"], DEC["d_ff"],
+                    DEC["vocab"])
+n_params = 2 * V_ * d_ + L_ * (4 * d_ * d_ + 3 * d_ * dff_ + 2 * d_) + d_
+horizon = min(DEC["max_seq"], -(-(mbu_prompt + mbu_new) // 128) * 128)
+kv_bytes = (mbu_B * horizon * L_ * 2
+            * DEC["n_heads"] * (d_ // DEC["n_heads"]) * 2)
+# per-step HBM traffic: the weights are read in the COMPUTE dtype (bf16,
+# 2 B/param — XLA hoists the one-time f32->bf16 cast out of the decode
+# scan, so the f32 masters are NOT re-read per step) plus the full KV
+# cache scan. Counting 4 B/param here produced an impossible 159% MBU.
+step_bytes = 2 * n_params + kv_bytes
+per_tok_s = fixed_dec_s / mbu_new
+from bench import hbm_bw_for
+decode_mbu = (step_bytes / per_tok_s) / (hbm_bw_for(kind) * 1e9) \
+    if backend == "tpu" else None
+if decode_mbu is not None and decode_mbu >= 1.0:
+    # same stance as the MFU guard: >=100% of the bandwidth roofline is
+    # a broken traffic model or broken timing, never a result
+    raise RuntimeError(
+        f"unphysical decode MBU {decode_mbu:.2f} "
+        f"({step_bytes / per_tok_s / 1e9:.0f} GB/s vs "
+        f"{hbm_bw_for(kind):.0f} peak): traffic model or sync is broken")
+
+# speculative speedup at the same fixed sizing (VERDICT r4 #3). A
+# RANDOM draft accepts nothing (measured: 64 verifies for 64 tokens —
+# pure overhead), so the draft here is the TRUNCATED TARGET: the
+# target's embed + first 2 layers + final norm/unembed, with the
+# remaining layers' residual outputs scaled to ~0 in the target — a
+# distillation proxy with a REAL cost asymmetry (2 of 6 layers) and
+# realistic high acceptance, exercising exactly the machinery a trained
+# draft would.
+spec_L = 2
+draft_cfg_b = TransformerConfig(
+    vocab=V_, d_model=d_, n_heads=DEC["n_heads"], n_layers=spec_L,
+    d_ff=dff_, max_seq=DEC["max_seq"])
+spec_target = {
+    "embed": dec_params["embed"],
+    "final_norm": dec_params["final_norm"],
+    "unembed": dec_params["unembed"],
+    "layers": [dict(lyr) for lyr in dec_params["layers"]],
+}
+for lyr in spec_target["layers"][spec_L:]:
+    lyr["wo"] = lyr["wo"] * 1e-3
+    lyr["w_down"] = lyr["w_down"] * 1e-3
+draft_b = {
+    "embed": dec_params["embed"],
+    "final_norm": dec_params["final_norm"],
+    "unembed": dec_params["unembed"],
+    "layers": [dict(lyr) for lyr in dec_params["layers"][:spec_L]],
+}
+spec_gen = make_speculative_generate(dec_cfg, draft_cfg_b, k=4)
+spec_prompt = sv_prompts[0][:32]
+spec_gen(spec_target, draft_b, spec_prompt, spec_new)  # compile pass
+t0 = time.perf_counter()
+for _ in range(spec_reps):
+    _, spec_calls = spec_gen(spec_target, draft_b, spec_prompt, spec_new)
+spec_s = (time.perf_counter() - t0) / spec_reps
+pb = jnp.asarray([spec_prompt], jnp.int32)
+o = dec_gen(spec_target, pb, spec_new)
+jax.device_get(o)
+t0 = time.perf_counter()
+for _ in range(spec_reps):
+    o = dec_gen(spec_target, pb, spec_new)
+jax.device_get(o)
+plain_s = (time.perf_counter() - t0) / spec_reps
+speculative_speedup = plain_s / spec_s
+serve_out = {
+    "decode_sizing": DEC,
+    "serve_tokens_per_s": round(serve_tok_s, 1),
+    "serve_slot_utilization": round(sv_util, 3),
+    "decode_fixed_tokens_per_s": round(fixed_dec_tok_s, 1),
+    "speculative_speedup": round(speculative_speedup, 3),
+    "speculative_target_calls": int(spec_calls),
+    "speculative_ceiling_calls": spec_new,
+    "speculative_draft": "truncated-target (%d of %d layers; "
+                         "distillation proxy)" % (spec_L, L_),
+}
+if decode_mbu is not None:
+    serve_out["decode_mbu"] = round(decode_mbu, 4)
+dec_params = draft_b = srv = None
+gc.collect()
+
 # Flash-kernel proof on real hardware (VERDICT r2 weak #5 / next #3):
 # compile the Pallas kernel non-interpret, check numerics against the
 # fused XLA attention on device, and A/B the full train step flash-vs-
@@ -607,7 +774,9 @@ decode_tok_s = B * gen_len / decode_s
 # compile), so the A/B picks the first candidate whose BOTH impls pass
 # the memory gate and reports which sizing it compared.
 flash_ab = {}
-if backend == "tpu":
+if backend == "tpu" and preset == "tpu":
+    # preset=cpu on a tpu backend (manual runs / tunnel edge cases) has
+    # no CANDS ladder to A/B over
     import dataclasses
     from kubegpu_tpu.workload.kernels.flash import flash_attention
     from kubegpu_tpu.workload.model import _causal_attention
@@ -720,6 +889,7 @@ out = {"workload_backend": backend,
 if mfu is not None:
     out["mfu"] = round(mfu, 4)
     out["peak_tflops"] = peak
+out.update(serve_out)
 out.update(flash_ab)
 print(json.dumps(out))
 """
@@ -815,7 +985,7 @@ def _workload_fingerprint() -> str:
     h = hashlib.sha256(_WORKLOAD_BENCH.encode())
     # the device tables moved to module level but stay part of what the
     # workload measures — a table change must invalidate old captures
-    h.update(repr((PEAK_TFLOPS, HBM_GB)).encode())
+    h.update(repr((PEAK_TFLOPS, HBM_GB, HBM_GBPS)).encode())
     root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "kubegpu_tpu", "workload")
     for dirpath, _, files in sorted(os.walk(root)):

@@ -39,6 +39,10 @@ class InMemoryAPIServer:
         self._pdbs: dict = {}
         self._pvcs: dict = {}
         self._pvs: dict = {}
+        # selector owners for SelectorSpreadPriority
+        # (`selector_spreading.go`: services, RCs, RSs, StatefulSets)
+        self._owners: dict = {k: {} for k in
+                              ("service", "rc", "rs", "statefulset")}
         # insertion-ordered (kind, name, reason, message) -> event; the
         # key IS the dedup identity, so record_event is O(1) not a scan
         self._events: dict = {}
@@ -307,6 +311,68 @@ class InMemoryAPIServer:
             pdb = self._pdbs.pop(name, None)
             if pdb is not None:
                 self._notify("pdb", "deleted", pdb)
+
+    # ---- selector owners (Services / RCs / RSs / StatefulSets) -------------
+    # The reference's SelectorSpreadPriority spreads by the label
+    # selectors of the objects that OWN the pod (`selector_spreading.go`,
+    # getSelectors) — these four kinds are its listers.
+
+    def _create_owner(self, kind: str, obj: dict) -> dict:
+        with self._lock:
+            name = obj["metadata"]["name"]
+            store = self._owners[kind]
+            if name in store:
+                raise Conflict(f"{kind} {name} exists")
+            store[name] = copy.deepcopy(obj)
+            self._notify(kind, "added", store[name])
+            return copy.deepcopy(store[name])
+
+    def _list_owners(self, kind: str) -> list:
+        with self._lock:
+            return [copy.deepcopy(o)
+                    for _, o in sorted(self._owners[kind].items())]
+
+    def _delete_owner(self, kind: str, name: str) -> None:
+        with self._lock:
+            obj = self._owners[kind].pop(name, None)
+            if obj is not None:
+                self._notify(kind, "deleted", obj)
+
+    def create_service(self, svc: dict) -> dict:
+        return self._create_owner("service", svc)
+
+    def list_services(self) -> list:
+        return self._list_owners("service")
+
+    def delete_service(self, name: str) -> None:
+        self._delete_owner("service", name)
+
+    def create_rc(self, rc: dict) -> dict:
+        return self._create_owner("rc", rc)
+
+    def list_rcs(self) -> list:
+        return self._list_owners("rc")
+
+    def delete_rc(self, name: str) -> None:
+        self._delete_owner("rc", name)
+
+    def create_rs(self, rs: dict) -> dict:
+        return self._create_owner("rs", rs)
+
+    def list_rss(self) -> list:
+        return self._list_owners("rs")
+
+    def delete_rs(self, name: str) -> None:
+        self._delete_owner("rs", name)
+
+    def create_statefulset(self, ss: dict) -> dict:
+        return self._create_owner("statefulset", ss)
+
+    def list_statefulsets(self) -> list:
+        return self._list_owners("statefulset")
+
+    def delete_statefulset(self, name: str) -> None:
+        self._delete_owner("statefulset", name)
 
     # ---- events ------------------------------------------------------------
     # The reference records k8s Events on scheduling outcomes

@@ -208,6 +208,21 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
                 if method == "DELETE" and len(parts) == 2:
                     api.delete_pdb(parts[1])
                     return self._send(200)
+            for kind, create, list_, delete in (
+                    ("services", api.create_service, api.list_services,
+                     api.delete_service),
+                    ("rcs", api.create_rc, api.list_rcs, api.delete_rc),
+                    ("rss", api.create_rs, api.list_rss, api.delete_rs),
+                    ("statefulsets", api.create_statefulset,
+                     api.list_statefulsets, api.delete_statefulset)):
+                if parts and parts[0] == kind:
+                    if method == "GET" and len(parts) == 1:
+                        return self._send(200, {"items": list_()})
+                    if method == "POST" and len(parts) == 1:
+                        return self._send(201, create(self._body()))
+                    if method == "DELETE" and len(parts) == 2:
+                        delete(parts[1])
+                        return self._send(200)
             if parts == ["events"]:
                 if method == "GET":
                     return self._send(200, {"items": api.list_events(
@@ -320,6 +335,44 @@ class HTTPAPIClient:
 
     def delete_pdb(self, name):
         return self._req("DELETE", f"/pdbs/{name}")
+
+    # -- selector owners (SelectorSpreadPriority listers) --------------------
+
+    def create_service(self, svc):
+        return self._req("POST", "/services", svc)
+
+    def list_services(self):
+        return self._req("GET", "/services")["items"]
+
+    def delete_service(self, name):
+        return self._req("DELETE", f"/services/{name}")
+
+    def create_rc(self, rc):
+        return self._req("POST", "/rcs", rc)
+
+    def list_rcs(self):
+        return self._req("GET", "/rcs")["items"]
+
+    def delete_rc(self, name):
+        return self._req("DELETE", f"/rcs/{name}")
+
+    def create_rs(self, rs):
+        return self._req("POST", "/rss", rs)
+
+    def list_rss(self):
+        return self._req("GET", "/rss")["items"]
+
+    def delete_rs(self, name):
+        return self._req("DELETE", f"/rss/{name}")
+
+    def create_statefulset(self, ss):
+        return self._req("POST", "/statefulsets", ss)
+
+    def list_statefulsets(self):
+        return self._req("GET", "/statefulsets")["items"]
+
+    def delete_statefulset(self, name):
+        return self._req("DELETE", f"/statefulsets/{name}")
 
     # -- persistent volumes / claims ----------------------------------------
 

@@ -261,6 +261,33 @@ class KubeAPIClient:
     # scheduler's volume binder consumes exactly this surface
     # (`volumebinder/volume_binder.go:1-74`).
 
+    # -- selector owners (SelectorSpreadPriority listers) --------------------
+    # The four owner kinds `selector_spreading.go`'s getSelectors lists.
+    # List-only: this scheduler never creates them on a real cluster.
+
+    def list_services(self) -> list:
+        return self._req(
+            "GET", f"/api/v1/namespaces/{self.namespace}/services"
+        ).get("items") or []
+
+    def list_rcs(self) -> list:
+        return self._req(
+            "GET",
+            f"/api/v1/namespaces/{self.namespace}/replicationcontrollers"
+        ).get("items") or []
+
+    def list_rss(self) -> list:
+        return self._req(
+            "GET",
+            f"/apis/apps/v1/namespaces/{self.namespace}/replicasets"
+        ).get("items") or []
+
+    def list_statefulsets(self) -> list:
+        return self._req(
+            "GET",
+            f"/apis/apps/v1/namespaces/{self.namespace}/statefulsets"
+        ).get("items") or []
+
     def _pvc_path(self, name: str = "") -> str:
         base = f"/api/v1/namespaces/{self.namespace}/persistentvolumeclaims"
         return base + (f"/{urllib.parse.quote(name)}" if name else "")

@@ -71,6 +71,7 @@ class GenericScheduler:
         # the node's full allocatable+used state.
         self._device_verdicts: dict = {}
         self._device_lock = threading.Lock()
+        self._owner_cache = None  # (expires, owner listings | None)
         # Set by Scheduler; None = no volume surface (predicate no-ops).
         self.volume_binder = None
         # Nominated preemptors: pod name -> (node, expiry, pod snapshot).
@@ -431,7 +432,8 @@ class GenericScheduler:
         if meta is self._AUTO_META:
             meta = self._interpod_meta(kube_pod)
         ctx = factory.PriorityContext(
-            meta, self.algorithm.hard_pod_affinity_weight)
+            meta, self.algorithm.hard_pod_affinity_weight,
+            owner_selectors=self._owner_selectors(kube_pod))
         combined = {name: feasible[name] * priorities.MAX_PRIORITY
                     * self.algorithm.device_weight for name in facts}
         for _name, weight, batch in self.algorithm.priorities:
@@ -473,6 +475,52 @@ class GenericScheduler:
         metrics.ALGORITHM_LATENCY.observe((time.perf_counter() - t0) * 1e6)
         trace.log_if_long()
         return host
+
+    OWNER_LIST_TTL_S = 2.0
+
+    def _owner_listings(self):
+        """The four owner lists, TTL-cached: prioritizing a burst of N
+        pods must not cost 4N list round-trips on a networked transport.
+        A transient lister failure keeps serving the stale listing (and
+        logs) instead of silently flipping to label-fallback scoring."""
+        now = time.monotonic()
+        cached = self._owner_cache
+        if cached is not None and cached[0] > now:
+            return cached[1]
+        api = getattr(self, "api", None)
+        list_services = getattr(api, "list_services", None)
+        if list_services is None:
+            listings = None  # transport exposes no owner listers
+        else:
+            try:
+                listings = (list_services(),
+                            getattr(api, "list_rcs", list)(),
+                            getattr(api, "list_rss", list)(),
+                            getattr(api, "list_statefulsets", list)())
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "owner listers failed; keeping previous listing",
+                    exc_info=True)
+                listings = cached[1] if cached is not None else None
+        self._owner_cache = (now + self.OWNER_LIST_TTL_S, listings)
+        return listings
+
+    def _owner_selectors(self, kube_pod: dict):
+        """Selectors of the Services/RCs/RSs/StatefulSets selecting this
+        pod, for SelectorSpreadPriority — or None when the API transport
+        exposes no owner listers (standalone engines fall back to
+        label-based spreading). Skipped entirely when the configured
+        algorithm does not score spreading."""
+        if not any(name == "SelectorSpreadPriority"
+                   for name, _, _ in self.algorithm.priorities):
+            return None
+        listings = self._owner_listings()
+        if listings is None:
+            return None
+        services, rcs, rss, statefulsets = listings
+        return priorities.owner_selectors_for_pod(
+            kube_pod, services=services, rcs=rcs, rss=rss,
+            statefulsets=statefulsets)
 
     def allocate_devices(self, kube_pod: dict, node_name: str) -> dict:
         """Re-run the device scheduler with allocation on, then serialize

@@ -37,12 +37,17 @@ class PredicateContext:
 
 
 class PriorityContext:
-    __slots__ = ("meta", "hard_pod_affinity_weight")
+    __slots__ = ("meta", "hard_pod_affinity_weight", "owner_selectors")
 
     def __init__(self, meta=None,
-                 hard_pod_affinity_weight=interpod.DEFAULT_HARD_POD_AFFINITY_WEIGHT):
+                 hard_pod_affinity_weight=interpod.DEFAULT_HARD_POD_AFFINITY_WEIGHT,
+                 owner_selectors=None):
         self.meta = meta
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        # selectors of the Services/RCs/RSs/StatefulSets that select the
+        # pod being scheduled (`selector_spreading.go` getSelectors);
+        # None = the transport exposes no owner listers (label fallback)
+        self.owner_selectors = owner_selectors
 
 
 class AlgorithmConfig:
@@ -275,10 +280,25 @@ def _pr_node_label(args):
 
 def _pr_spreading(args):
     def batch(kube_pod, pod_requests, facts, ctx):
-        max_same = max((priorities._count_same_labeled(kube_pod, f)
-                        for f in facts.values()), default=0)
-        return {name: priorities.selector_spreading(kube_pod, f, max_same)
-                for name, f in facts.items()}
+        sels = getattr(ctx, "owner_selectors", None)
+        if sels is None:
+            # standalone engine without Service/RC listers: spread by
+            # the pod's own identifying labels (documented fallback)
+            max_same = max((priorities._count_same_labeled(kube_pod, f)
+                            for f in facts.values()), default=0)
+            return {name: priorities.selector_spreading(kube_pod, f,
+                                                        max_same)
+                    for name, f in facts.items()}
+        if not sels:
+            # no owning object selects this pod: the reference scores
+            # every node 0 (`selector_spreading.go` map phase) — a
+            # uniform non-contribution
+            return {name: 0.0 for name in facts}
+        counts = {name: priorities.count_matching_selectors(f, sels)
+                  for name, f in facts.items()}
+        mx = max(counts.values(), default=0)
+        return {name: priorities.spread_score(counts[name], mx)
+                for name in facts}
     return batch
 
 

@@ -119,6 +119,87 @@ def selector_spreading(kube_pod: dict, facts: NodeFacts,
     return (1.0 - same / max_same) * MAX_PRIORITY
 
 
+def label_selector_matches(sel: dict, labels: dict) -> bool:
+    """Full LabelSelector semantics: ``matchLabels`` (AND of equalities)
+    plus ``matchExpressions`` (In / NotIn / Exists / DoesNotExist, with
+    upstream's absent-key behavior: NotIn and DoesNotExist match when
+    the key is absent). Unknown operators fail closed."""
+    for k, v in (sel.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in sel.get("matchExpressions") or []:
+        key = expr.get("key")
+        op = expr.get("operator")
+        vals = expr.get("values") or []
+        if op == "In":
+            if labels.get(key) not in vals:
+                return False
+        elif op == "NotIn":
+            if key in labels and labels[key] in vals:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            return False
+    return True
+
+
+def count_matching_selectors(facts: NodeFacts, selectors: list) -> int:
+    """Pods on the node matched by ANY of the owning objects' selectors
+    (`selector_spreading.go` CalculateSpreadPriorityMap: a pod counts
+    once even when several selectors match it)."""
+    n = 0
+    for other in facts.pod_labels.values():
+        if any(label_selector_matches(sel, other) for sel in selectors):
+            n += 1
+    return n
+
+
+def spread_score(count: int, max_count: int) -> float:
+    """The reference's reduce formula
+    (`selector_spreading.go` CalculateSpreadPriorityReduce):
+    ``MaxPriority * (max - count) / max``; all nodes score MaxPriority
+    when no node has a matching pod. Zone weighting is not modeled —
+    the fake-cluster nodes carry no zone labels."""
+    if max_count <= 0:
+        return MAX_PRIORITY
+    return MAX_PRIORITY * (max_count - count) / max_count
+
+
+def owner_selectors_for_pod(kube_pod: dict, services=(), rcs=(), rss=(),
+                            statefulsets=()) -> list:
+    """Selectors of the owning objects that SELECT this pod
+    (`selector_spreading.go` getSelectors): a Service/RC contributes its
+    ``spec.selector`` label map, an RS/StatefulSet its full
+    ``spec.selector`` LabelSelector (matchLabels AND matchExpressions),
+    each only when non-empty and matching the pod's labels. Returned
+    selectors are normalized to LabelSelector shape."""
+    labels = (kube_pod.get("metadata") or {}).get("labels") or {}
+    out = []
+    for objs, nested in ((services, False), (rcs, False), (rss, True),
+                         (statefulsets, True)):
+        for obj in objs:
+            raw = (obj.get("spec") or {}).get("selector") or {}
+            if not isinstance(raw, dict):
+                continue
+            if nested:
+                sel = {"matchLabels": dict(raw.get("matchLabels") or {}),
+                       "matchExpressions":
+                           list(raw.get("matchExpressions") or [])}
+            else:
+                sel = {"matchLabels": dict(raw),
+                       "matchExpressions": []}
+            if not (sel["matchLabels"] or sel["matchExpressions"]):
+                continue  # empty selector owns nothing (upstream)
+            if label_selector_matches(sel, labels):
+                out.append(sel)
+    return out
+
+
 def _count_same_labeled(kube_pod: dict, facts: NodeFacts) -> int:
     labels = (kube_pod.get("metadata") or {}).get("labels") or {}
     ident = {k: v for k, v in labels.items() if k != "name"}

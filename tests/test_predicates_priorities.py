@@ -227,6 +227,127 @@ def test_selector_spreading():
         priorities.selector_spreading(pod, crowded, max_same)
 
 
+LAB1 = {"foo": "bar", "baz": "blah"}
+LAB2 = {"bar": "foo", "baz": "blah"}
+
+
+def _spread(pod_labels, node_pods, services=(), rcs=(), rss=(), sss=()):
+    """Run SelectorSpreadPriority the way the scheduler does: owner
+    selectors resolved for the pod, reference map+reduce over two nodes.
+    ``node_pods`` = {node: [labels, ...]}. Vectors ported from the
+    reference's `selector_spreading_test.go` (namespace-free rows)."""
+    from kubegpu_tpu.scheduler import factory
+
+    pod = {"metadata": {"name": "p", "labels": dict(pod_labels)},
+           "spec": {}}
+    facts = {}
+    for node, podlist in node_pods.items():
+        facts[node] = priorities.NodeFacts(
+            {"metadata": {"name": node}}, {}, {},
+            {f"{node}-{i}": dict(lab) for i, lab in enumerate(podlist)})
+    ctx = factory.PriorityContext(
+        owner_selectors=priorities.owner_selectors_for_pod(
+            pod, services=services, rcs=rcs, rss=rss,
+            statefulsets=sss))
+    return factory._pr_spreading(None)(pod, {}, facts, ctx)
+
+
+def svc(selector):
+    return {"metadata": {"name": "s"}, "spec": {"selector": selector}}
+
+
+def test_selector_spread_upstream_vectors():
+    """Conformance vectors from `selector_spreading_test.go:70-180`
+    (expected scores on upstream's 0-10 scale)."""
+    # "nothing scheduled" / "no services": no owner -> uniform zero map
+    assert _spread({}, {"m1": [], "m2": []}) == {"m1": 0.0, "m2": 0.0}
+    assert _spread(LAB1, {"m1": [LAB2], "m2": []}) == \
+        {"m1": 0.0, "m2": 0.0}
+    # "different services": owning selector matches nothing on nodes
+    assert _spread(LAB1, {"m1": [LAB2], "m2": []},
+                   services=[svc({"key": "value"})]) == \
+        {"m1": 0.0, "m2": 0.0}
+    # "two pods, one service pod"
+    assert _spread(LAB1, {"m1": [LAB2], "m2": [LAB1]},
+                   services=[svc(LAB1)]) == {"m1": 10.0, "m2": 0.0}
+    # "three pods, two service pods on different machines"
+    assert _spread(LAB1, {"m1": [LAB2, LAB1], "m2": [LAB1]},
+                   services=[svc(LAB1)]) == {"m1": 0.0, "m2": 0.0}
+    # "four pods, three service pods"
+    assert _spread(LAB1, {"m1": [LAB2, LAB1], "m2": [LAB1, LAB1]},
+                   services=[svc(LAB1)]) == {"m1": 5.0, "m2": 0.0}
+    # "service with partial pod label matches"
+    assert _spread(LAB1, {"m1": [LAB2, LAB1], "m2": [LAB1]},
+                   services=[svc({"baz": "blah"})]) == \
+        {"m1": 0.0, "m2": 5.0}
+    # "... with service and replication controller": the RC selector
+    # narrows to labels1 but the service's wider selector still spreads
+    # over both label sets
+    assert _spread(LAB1, {"m1": [LAB2, LAB1], "m2": [LAB1]},
+                   services=[svc({"baz": "blah"})],
+                   rcs=[{"metadata": {"name": "rc"},
+                         "spec": {"selector": {"foo": "bar"}}}]) == \
+        {"m1": 0.0, "m2": 5.0}
+    # "... with service and replica set" (matchLabels nesting)
+    assert _spread(LAB1, {"m1": [LAB2, LAB1], "m2": [LAB1]},
+                   services=[svc({"baz": "blah"})],
+                   rss=[{"metadata": {"name": "rs"},
+                         "spec": {"selector":
+                                  {"matchLabels": {"foo": "bar"}}}}]) == \
+        {"m1": 0.0, "m2": 5.0}
+
+
+def test_selector_spread_match_expressions():
+    """Full LabelSelector semantics: an RS whose matchExpressions
+    exclude the pod does NOT own it, and an expressions-only selector
+    both owns and counts correctly."""
+    # NotIn excludes the pod (foo=bar is in the excluded set): not owner
+    rs_excl = {"metadata": {"name": "rs"},
+               "spec": {"selector": {
+                   "matchLabels": {"baz": "blah"},
+                   "matchExpressions": [{"key": "foo", "operator": "NotIn",
+                                         "values": ["bar"]}]}}}
+    assert _spread(LAB1, {"m1": [LAB1], "m2": []}, rss=[rs_excl]) == \
+        {"m1": 0.0, "m2": 0.0}
+    # expressions-only selector: In matches the pod AND counts only the
+    # node pods it selects (LAB2 has no foo key -> not counted by In)
+    rs_in = {"metadata": {"name": "rs"},
+             "spec": {"selector": {
+                 "matchExpressions": [{"key": "foo", "operator": "In",
+                                       "values": ["bar"]}]}}}
+    assert _spread(LAB1, {"m1": [LAB1, LAB2], "m2": [LAB2]},
+                   rss=[rs_in]) == {"m1": 0.0, "m2": 10.0}
+    # operator semantics
+    assert priorities.label_selector_matches(
+        {"matchExpressions": [{"key": "x", "operator": "DoesNotExist"}]},
+        {"y": "1"})
+    assert not priorities.label_selector_matches(
+        {"matchExpressions": [{"key": "x", "operator": "Exists"}]}, {})
+    assert priorities.label_selector_matches(
+        {"matchExpressions": [{"key": "x", "operator": "NotIn",
+                               "values": ["a"]}]}, {})  # absent key
+    assert not priorities.label_selector_matches(
+        {"matchExpressions": [{"key": "x", "operator": "Bogus"}]},
+        {"x": "a"})  # unknown operator fails closed
+
+
+def test_selector_spread_through_scheduler():
+    """End-to-end: pods selected by a Service spread across hosts
+    instead of packing onto one."""
+    from tests.test_e2e import make_cluster, tpu_pod
+
+    api, hosts, sched = make_cluster(n_hosts=2)
+    api.create_service(svc({"app": "web"}))
+    for i in range(2):
+        pod = tpu_pod(f"web-{i}", 1)
+        pod["metadata"]["labels"] = {"app": "web"}
+        api.create_pod(pod)
+        sched.run_until_idle()
+    placed = {api.get_pod(f"web-{i}")["spec"]["nodeName"]
+              for i in range(2)}
+    assert placed == {"host0", "host1"}  # spread, not packed
+
+
 def test_preferred_node_affinity_weights():
     pod = {"metadata": {"name": "p"}, "spec": {"affinity": {"nodeAffinity": {
         "preferredDuringSchedulingIgnoredDuringExecution": [
@@ -356,10 +477,15 @@ def test_scheduler_respects_host_ports():
     assert len(hosts) == 2  # port conflict forces different hosts
 
 
-def test_scheduler_spreads_same_labeled_pods():
+def test_scheduler_spreads_service_pods():
+    """SelectorSpreadPriority spreads pods SELECTED BY A SERVICE
+    (`selector_spreading.go`); same-labeled pods without an owning
+    object are NOT spread (upstream scores every node 0 then)."""
     api = InMemoryAPIServer()
     api.create_node(flat_tpu_node("host0", chips=8, cpu="64"))
     api.create_node(flat_tpu_node("host1", chips=8, cpu="64"))
+    api.create_service({"metadata": {"name": "web"},
+                        "spec": {"selector": {"app": "web"}}})
     sched = make_scheduler(api)
     for i in range(4):
         pod = tpu_pod(f"web-{i}", 1)
@@ -368,6 +494,23 @@ def test_scheduler_spreads_same_labeled_pods():
     sched.run_until_idle()
     hosts = [api.get_pod(f"web-{i}")["spec"]["nodeName"] for i in range(4)]
     assert sorted(hosts.count(h) for h in set(hosts)) == [2, 2]
+
+
+def test_label_spread_fallback_without_owner_listers():
+    """A transport with no Service lister keeps the standalone label
+    heuristic: ctx.owner_selectors None routes to the fallback."""
+    from kubegpu_tpu.scheduler import factory
+
+    pod = {"metadata": {"name": "p", "labels": {"app": "w"}}, "spec": {}}
+    facts = {
+        "a": priorities.NodeFacts({"metadata": {"name": "a"}}, {}, {},
+                                  {"x": {"app": "w"}}),
+        "b": priorities.NodeFacts({"metadata": {"name": "b"}}, {}, {},
+                                  {}),
+    }
+    ctx = factory.PriorityContext(owner_selectors=None)
+    scores = factory._pr_spreading(None)(pod, {}, facts, ctx)
+    assert scores["b"] > scores["a"]
 
 
 # ---- extender ---------------------------------------------------------------
