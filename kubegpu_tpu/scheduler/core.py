@@ -511,7 +511,7 @@ class GenericScheduler:
         exposes no owner listers (standalone engines fall back to
         label-based spreading). Skipped entirely when the configured
         algorithm does not score spreading."""
-        if not any(name == "SelectorSpreadPriority"
+        if not any(name in factory.SPREADING_PRIORITY_NAMES
                    for name, _, _ in self.algorithm.priorities):
             return None
         listings = self._owner_listings()

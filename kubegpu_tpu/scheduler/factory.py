@@ -278,6 +278,13 @@ def _pr_node_label(args):
         lambda pod, req, f: priorities.node_label(f, label, presence))
 
 
+# Registry names that resolve to the spreading batch below — the
+# scheduler consults this to decide whether owner listers are needed at
+# all (both names must behave identically).
+SPREADING_PRIORITY_NAMES = frozenset(
+    {"SelectorSpreadPriority", "ServiceSpreadingPriority"})
+
+
 def _pr_spreading(args):
     def batch(kube_pod, pod_requests, facts, ctx):
         sels = getattr(ctx, "owner_selectors", None)
@@ -290,10 +297,12 @@ def _pr_spreading(args):
                                                         max_same)
                     for name, f in facts.items()}
         if not sels:
-            # no owning object selects this pod: the reference scores
-            # every node 0 (`selector_spreading.go` map phase) — a
-            # uniform non-contribution
-            return {name: 0.0 for name in facts}
+            # no owning object selects this pod: upstream's map phase
+            # scores 0 and its reduce turns the all-zero column into
+            # MaxPriority everywhere (`selector_spreading.go`) — emit
+            # the post-reduce value, consistent with the
+            # owner-matches-no-pods branch below
+            return {name: priorities.MAX_PRIORITY for name in facts}
         counts = {name: priorities.count_matching_selectors(f, sels)
                   for name, f in facts.items()}
         mx = max(counts.values(), default=0)

@@ -120,32 +120,14 @@ def selector_spreading(kube_pod: dict, facts: NodeFacts,
 
 
 def label_selector_matches(sel: dict, labels: dict) -> bool:
-    """Full LabelSelector semantics: ``matchLabels`` (AND of equalities)
-    plus ``matchExpressions`` (In / NotIn / Exists / DoesNotExist, with
-    upstream's absent-key behavior: NotIn and DoesNotExist match when
-    the key is absent). Unknown operators fail closed."""
-    for k, v in (sel.get("matchLabels") or {}).items():
-        if labels.get(k) != v:
-            return False
-    for expr in sel.get("matchExpressions") or []:
-        key = expr.get("key")
-        op = expr.get("operator")
-        vals = expr.get("values") or []
-        if op == "In":
-            if labels.get(key) not in vals:
-                return False
-        elif op == "NotIn":
-            if key in labels and labels[key] in vals:
-                return False
-        elif op == "Exists":
-            if key not in labels:
-                return False
-        elif op == "DoesNotExist":
-            if key in labels:
-                return False
-        else:
-            return False
-    return True
+    """Full LabelSelector semantics — one matcher for the whole
+    scheduler: delegates to `interpod.label_selector_matches` (built on
+    `predicates._match_expression`, incl. Gt/Lt and upstream's
+    absent-key behavior for NotIn/DoesNotExist) so spread scoring can
+    never diverge from affinity matching for the same selector."""
+    from kubegpu_tpu.scheduler import interpod
+
+    return interpod.label_selector_matches(sel, labels)
 
 
 def count_matching_selectors(facts: NodeFacts, selectors: list) -> int:

@@ -333,9 +333,8 @@ class DecodeServer:
         self.cache, n_acc, extra = self._spec_verify(
             self.params, self.cache, chunk, jnp.asarray(self.pos), kv,
             q_rows)
-        n_acc = np.asarray(n_acc)
-        extra = np.asarray(extra)
-        chunk_np = np.asarray(chunk)
+        # one host transfer per round (remote rigs pay RTT per fetch)
+        n_acc, extra, chunk_np = jax.device_get((n_acc, extra, chunk))
         for s in active:
             req = self.slot_req[s]
             n = int(n_acc[s])

@@ -245,16 +245,22 @@ def make_speculative_generate(target_cfg: TransformerConfig,
             t_cache, tout, n_acc = verify(target_params, t_cache, chunk,
                                           jnp.int32(pos))
             target_calls += 1
+            # ONE host transfer per round: on a remote-TPU rig every
+            # device_get pays the tunnel RTT, and three sequential
+            # fetches per round tripled the loop's latency floor
             if sampling:
                 n_acc, extra = accept_jit(
                     tout, q_rows, drafts,
                     jax.random.fold_in(rkey, 10_000))
+                n_acc, extra_tok, drafts_np = jax.device_get(
+                    (n_acc, extra, drafts))
                 n_acc = int(n_acc)
-                extra_tok = int(np.asarray(extra))
+                extra_tok = int(extra_tok)
             else:
+                n_acc, tout_np, drafts_np = jax.device_get(
+                    (n_acc, tout, drafts))
                 n_acc = int(n_acc)
-                extra_tok = int(np.asarray(tout)[n_acc])
-            drafts_np = np.asarray(drafts)
+                extra_tok = int(tout_np[n_acc])
             # accepted draft tokens, then the correction-or-bonus token
             new = [int(x) for x in drafts_np[:n_acc]] + [extra_tok]
             out.extend(new)

@@ -259,14 +259,15 @@ def svc(selector):
 def test_selector_spread_upstream_vectors():
     """Conformance vectors from `selector_spreading_test.go:70-180`
     (expected scores on upstream's 0-10 scale)."""
-    # "nothing scheduled" / "no services": no owner -> uniform zero map
-    assert _spread({}, {"m1": [], "m2": []}) == {"m1": 0.0, "m2": 0.0}
+    # "nothing scheduled" / "no services": post-reduce, upstream scores
+    # every node MaxPriority (10) when no owner selects the pod
+    assert _spread({}, {"m1": [], "m2": []}) == {"m1": 10.0, "m2": 10.0}
     assert _spread(LAB1, {"m1": [LAB2], "m2": []}) == \
-        {"m1": 0.0, "m2": 0.0}
+        {"m1": 10.0, "m2": 10.0}
     # "different services": owning selector matches nothing on nodes
     assert _spread(LAB1, {"m1": [LAB2], "m2": []},
                    services=[svc({"key": "value"})]) == \
-        {"m1": 0.0, "m2": 0.0}
+        {"m1": 10.0, "m2": 10.0}
     # "two pods, one service pod"
     assert _spread(LAB1, {"m1": [LAB2], "m2": [LAB1]},
                    services=[svc(LAB1)]) == {"m1": 10.0, "m2": 0.0}
@@ -308,7 +309,7 @@ def test_selector_spread_match_expressions():
                    "matchExpressions": [{"key": "foo", "operator": "NotIn",
                                          "values": ["bar"]}]}}}
     assert _spread(LAB1, {"m1": [LAB1], "m2": []}, rss=[rs_excl]) == \
-        {"m1": 0.0, "m2": 0.0}
+        {"m1": 10.0, "m2": 10.0}  # not an owner -> uniform MaxPriority
     # expressions-only selector: In matches the pod AND counts only the
     # node pods it selects (LAB2 has no foo key -> not counted by In)
     rs_in = {"metadata": {"name": "rs"},
