@@ -50,9 +50,19 @@ def main(argv=None) -> int:
     ap.add_argument("--draft-layers", type=int, default=1)
     ap.add_argument("--lookahead", type=int, default=4,
                     help="draft tokens per speculative round (k)")
+    ap.add_argument("--prefix-cache", type=int, default=0, metavar="N",
+                    help="retain K/V of the last N served prompts; "
+                         "requests extending one prefill only the "
+                         "remainder")
     args = ap.parse_args(argv)
     if args.requests < 1:
         ap.error("--requests must be >= 1")
+    if args.prefix_cache < 0:
+        ap.error("--prefix-cache must be >= 0")
+    if args.prefix_cache and args.speculative:
+        ap.error("--prefix-cache applies to the server modes only "
+                 "(plain or --spec-server); --speculative is the "
+                 "single-stream path with no admission cache")
 
     import jax
 
@@ -127,13 +137,17 @@ def main(argv=None) -> int:
                            top_p=args.top_p,
                            rng=jax.random.PRNGKey(args.seed),
                            draft_params=draft, draft_cfg=draft_cfg,
-                           lookahead=args.lookahead)
+                           lookahead=args.lookahead,
+                           prefix_cache_size=args.prefix_cache)
         rids = [srv.submit(p, max_new=args.max_new) for p in prompts]
         srv.run()
         outs = [srv.result(r) for r in rids]
         stats = {"mode": "spec-serve" if args.spec_server else "serve",
                  "slots": args.slots,
                  "tokens": sum(len(o) for o in outs)}
+        if args.prefix_cache:
+            stats["prefix_hits"] = srv.prefix_hits
+            stats["prefix_misses"] = srv.prefix_misses
     wall = time.perf_counter() - t0
 
     if restored_step is not None:

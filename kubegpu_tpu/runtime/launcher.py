@@ -183,6 +183,33 @@ class WorkloadSupervisor:
                     f"container {cid} is running; stop it first")
             self._containers.pop(cid, None)
 
+    def logs(self, cid: str, tail_lines: int = 0) -> str:
+        """The container's captured stdout/stderr (last ``tail_lines``
+        when > 0) — the read side of the reference's streaming server
+        (`docker_container.go:179-190`), file-backed instead of
+        attach-multiplexed."""
+        with self._lock:
+            cont = self._containers.get(cid)
+        if cont is None:
+            raise KeyError(f"unknown container {cid}")
+        if cont.log_path == os.devnull:
+            return ""
+        # bounded read: a workload can write gigabytes; serving a tail
+        # query must not load the whole file into the agent. Reads the
+        # last 1 MiB (lines longer than that are truncated at the front).
+        max_bytes = 1 << 20
+        try:
+            with open(cont.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                data = f.read().decode(errors="replace")
+        except OSError:
+            return ""
+        if tail_lines > 0:
+            data = "\n".join(data.splitlines()[-tail_lines:])
+        return data
+
     def wait(self, cid: str, timeout: float | None = None) -> dict:
         with self._lock:
             cont = self._containers.get(cid)

@@ -29,6 +29,9 @@ rewrite, then `DockerService.CreateContainer` actually runs it):
     GET  /v1/containers                     -> all records
     POST /v1/stop-container     {"id": ...} -> SIGTERM/SIGKILL, record
     POST /v1/remove-container   {"id": ...} -> evict an exited record
+    GET  /v1/container-logs?id=...[&tail=N] -> {"logs": "..."} (the
+         read side of the reference's streaming server,
+         `docker_container.go:179-190`, HTTP-shaped)
 
 The server shares the node agent's DevicesManager, so discovery happens
 once per process, not once per container create (the CLI's old behavior).
@@ -128,6 +131,22 @@ class CRIHookServer:
                         self._reply(200, sup.status(cid))
                     except KeyError as e:
                         self._reply(404, {"error": str(e)})
+                elif self.path.startswith("/v1/container-logs"):
+                    sup = self._supervisor()
+                    if sup is None:
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    cid = (q.get("id") or [""])[0]
+                    try:
+                        tail = int((q.get("tail") or ["0"])[0])
+                        self._reply(200, {"id": cid,
+                                          "logs": sup.logs(cid, tail)})
+                    except KeyError as e:
+                        self._reply(404, {"error": str(e)})
+                    except ValueError as e:
+                        self._reply(400, {"error": str(e)})
                 else:
                     self._reply(404, {"error": "not found"})
 

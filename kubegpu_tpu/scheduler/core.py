@@ -71,6 +71,7 @@ class GenericScheduler:
         # the node's full allocatable+used state.
         self._device_verdicts: dict = {}
         self._device_lock = threading.Lock()
+        self._device_inflight: dict = {}  # dev_key -> threading.Event
         self._owner_cache = None  # (expires, owner listings | None)
         # Set by Scheduler; None = no volume surface (predicate no-ops).
         self.volume_binder = None
@@ -343,23 +344,56 @@ class GenericScheduler:
             # pod would poison shape-equal nodes with the wrong verdict.
             pinned_here = pod_info_get.pinned_node == snap.name
             dev_key = (snap.node_ex.shape_key(), device_class, pinned_here)
+            # compute-once discipline: on a uniform fleet every fit
+            # worker shares one dev_key, and the search is CPU-bound
+            # pure Python — 16 workers racing the same cold class
+            # serialize on the GIL into ~16x the single search time
+            # (the measured 256-node cold-pass tail). The first worker
+            # computes; the rest wait for its verdict.
+            wait_for = None
+            registered = False
             with self._device_lock:
                 hit = self._device_verdicts.get(dev_key)
+                if hit is None:
+                    wait_for = self._device_inflight.get(dev_key)
+                    if wait_for is None:
+                        self._device_inflight[dev_key] = threading.Event()
+                        registered = True
             if hit is not None:
                 return hit
-        if pod_info_get is not None:
-            pod_info = pod_info_get(snap.name)
-        else:
-            pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
-        fits, reasons, score = self.device_scheduler.pod_fits_resources(
-            pod_info, snap.node_ex, False)
-        result = (fits, [str(r) for r in reasons], score)
-        if dev_key is not None:
-            with self._device_lock:
-                if len(self._device_verdicts) >= self.MAX_DEVICE_VERDICTS:
-                    self._device_verdicts.clear()
-                self._device_verdicts[dev_key] = result
-        return result
+            if wait_for is not None:
+                wait_for.wait(timeout=5.0)
+                with self._device_lock:
+                    hit = self._device_verdicts.get(dev_key)
+                if hit is not None:
+                    return hit
+                # owner failed or timed out: compute it ourselves
+        try:
+            if pod_info_get is not None:
+                pod_info = pod_info_get(snap.name)
+            else:
+                pod_info = self.cache.pod_info_for_node(kube_pod, snap.name)
+            fits, reasons, score = self.device_scheduler.pod_fits_resources(
+                pod_info, snap.node_ex, False)
+            result = (fits, [str(r) for r in reasons], score)
+            if dev_key is not None:
+                with self._device_lock:
+                    if len(self._device_verdicts) >= self.MAX_DEVICE_VERDICTS:
+                        self._device_verdicts.clear()
+                    self._device_verdicts[dev_key] = result
+            return result
+        finally:
+            if dev_key is not None and registered:
+                # wake waiters whether we stored or raised — a crashed
+                # owner must not strand the class's other workers. Only
+                # the thread that REGISTERED the event tears it down: a
+                # timed-out waiter computing for itself must not pop an
+                # event a still-computing owner (or a newer wave's
+                # owner) is responsible for.
+                with self._device_lock:
+                    ev = self._device_inflight.pop(dev_key, None)
+                if ev is not None:
+                    ev.set()
 
     def find_nodes_that_fit(self, kube_pod: dict):
         """Parallel filter over all nodes (`generic_scheduler.go:310-383`),

@@ -72,6 +72,13 @@ class DecodeServer:
     via ``temperature``/``top_k``/``top_p`` + ``rng`` like
     `make_generate`.
 
+    ``prefix_cache_size > 0`` enables PREFIX REUSE: the K/V of served
+    prompts is retained (LRU, that many entries) and a request whose
+    prompt extends a stored one splices the cached rows in and prefills
+    only the remainder — the static-shape answer to paged serving's
+    prefix cache, exact because the shared prefix's K/V is
+    position-identical. ``prefix_hits``/``prefix_misses`` count reuse.
+
     With ``draft_params``/``draft_cfg`` the server decodes
     SPECULATIVELY per slot: each step proposes ``lookahead`` draft
     tokens for every slot, verifies all slots in one batched target
@@ -87,7 +94,7 @@ class DecodeServer:
                  top_p: float = 1.0, eos_id: int | None = None,
                  prefill_buckets: tuple = (32, 128, 512), rng=None,
                  draft_params=None, draft_cfg: TransformerConfig | None = None,
-                 lookahead: int = 4):
+                 lookahead: int = 4, prefix_cache_size: int = 0):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if (draft_params is None) != (draft_cfg is None):
@@ -136,6 +143,61 @@ class DecodeServer:
         # reference is dropped on reassignment, so XLA updates it in
         # place instead of copying the whole multi-slot cache per token
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        # -- prefix reuse: stored K/V of previously-served prompts lets a
+        # request sharing a prefix skip recomputing it (the static-shape
+        # answer to paged serving's prefix cache). Entries are keyed by
+        # the EXACT token prefix; a hit splices the stored rows into the
+        # slot and prefills only the remainder. Bucket-padding garbage in
+        # stored entries is safe by the same overwrite-before-attend
+        # argument as the admit prefill.
+        from collections import OrderedDict
+
+        if prefix_cache_size < 0:
+            raise ValueError(
+                f"prefix_cache_size must be >= 0, got {prefix_cache_size}")
+        self.prefix_cache_size = int(prefix_cache_size)
+        self._prefix_cache: OrderedDict = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
+        def rem_prefill(params, cache, stored, rem_tokens, slot, plen,
+                        rem_true):
+            """Splice a stored prefix (``[1, b, ...]`` per layer) into a
+            fresh row, run the remainder chunk at position ``plen``, and
+            write the row back into the big cache at ``slot``."""
+            s_max = cache[0]["k"].shape[1]
+            row = []
+            for big, st in zip(cache, stored):
+                row.append({
+                    k: jax.lax.dynamic_update_slice(
+                        jnp.zeros((1, s_max) + big[k].shape[2:],
+                                  big[k].dtype), st[k], (0, 0, 0, 0))
+                    for k in ("k", "v")})
+            logits, row = self._fstep(params, row, rem_tokens, plen)
+            new_cache = []
+            for big, rw in zip(cache, row):
+                new_cache.append({
+                    k: jax.lax.dynamic_update_slice(
+                        big[k], rw[k], (slot, 0, 0, 0)) for k in ("k", "v")})
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], rem_true - 1, axis=0, keepdims=False)
+            return new_cache, last
+
+        self._rem_prefill = jax.jit(rem_prefill, donate_argnums=(1,))
+
+        def snapshot_prefix(cache, slot, b: int):
+            """Copy one slot's first ``b`` cache positions out for the
+            prefix store. Runs eagerly: admission is host-paced anyway,
+            and eager keeps ``b`` free to vary per bucket without a
+            stale-trace hazard."""
+            return [
+                {k: jax.lax.dynamic_slice(
+                    big[k], (slot, 0, 0, 0),
+                    (1, b) + big[k].shape[2:]) for k in ("k", "v")}
+                for big in cache]
+
+        self._snapshot_prefix = snapshot_prefix
 
         def decode(params, cache, tok, pos, key):
             logits, cache = self._fstep(params, cache, tok[:, None], pos)
@@ -367,14 +429,72 @@ class DecodeServer:
 
     # -- internals -----------------------------------------------------------
 
+    def _prefix_lookup(self, prompt: list):
+        """Longest stored entry that is a PROPER prefix of ``prompt``
+        (LRU-refreshed), or None."""
+        best = None
+        for key in self._prefix_cache:
+            if len(key) < len(prompt) and \
+                    (best is None or len(key) > len(best)) and \
+                    tuple(prompt[:len(key)]) == key:
+                best = key
+        if best is None:
+            return None
+        self._prefix_cache.move_to_end(best)
+        return best, self._prefix_cache[best]
+
+    def _prefix_store(self, prompt: list, slot: int) -> None:
+        """Store the full prompt's K/V AND its bucket-aligned prefixes:
+        the dominant serving pattern is a shared system prompt with
+        different user suffixes, and those only ever match an
+        INTERMEDIATE prefix — a cache holding only full prompts would
+        never hit it."""
+        keys = [(tuple(prompt[:b]), b)
+                for b in self.buckets if b < len(prompt)]
+        keys.append((tuple(prompt), _bucket_for(len(prompt), self.buckets)))
+        for key, b in keys:
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                continue
+            self._prefix_cache[key] = self._snapshot_prefix(
+                self.cache, jnp.int32(slot), b)
+        while len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.popitem(last=False)
+
     def _admit(self, slot: int, req: _Request) -> None:
         n = len(req.prompt)
         bucket = _bucket_for(n, self.buckets)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req.prompt
-        self.cache, last = self._prefill(
-            self.params, self.cache, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(n))
+        hit = self._prefix_lookup(req.prompt) if self.prefix_cache_size \
+            else None
+        if hit is not None:
+            pkey, stored = hit
+            plen = len(pkey)
+            rem = req.prompt[plen:]
+            rb = _bucket_for(len(rem), self.buckets)
+            if plen + rb > self.max_seq:
+                # the padded remainder would write past the cache end,
+                # where dynamic_update_slice CLAMPS the start and
+                # silently corrupts the prefix K/V (the hazard
+                # decode.make_generate refuses up front) — full prefill
+                # instead of a corrupting shortcut
+                hit = None
+        if hit is not None:
+            rem_padded = np.zeros((1, rb), np.int32)
+            rem_padded[0, :len(rem)] = rem
+            self.cache, last = self._rem_prefill(
+                self.params, self.cache, stored, jnp.asarray(rem_padded),
+                jnp.int32(slot), jnp.int32(plen), jnp.int32(len(rem)))
+            self.prefix_hits += 1
+        else:
+            self.cache, last = self._prefill(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.int32(slot), jnp.int32(n))
+            if self.prefix_cache_size:
+                self.prefix_misses += 1
+        if self.prefix_cache_size:
+            self._prefix_store(req.prompt, slot)
         key = jax.random.fold_in(self.rng, self._tick)
         self._tick += 1
         first = int(np.asarray(_select_token(

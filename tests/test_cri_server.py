@@ -198,6 +198,32 @@ def test_stop_container_terminates(launch_served):
     assert listing["containers"] == []
 
 
+def test_container_logs_endpoint(launch_served):
+    """The streaming-server analogue: captured stdout is readable over
+    the endpoint, with tail support."""
+    import sys
+    import time
+
+    api, url, _ = launch_served
+    allocated_pod(api, "jl")
+    _, body = _launch(url, {
+        "pod": "jl", "container": "main", "config": {},
+        "command": [sys.executable, "-c",
+                    "print('line1'); print('line2'); print('line3')"]})
+    cid = body["id"]
+    for _ in range(100):
+        _, st = _get(url, f"/v1/container-status?id={cid}")
+        if st["state"] == "exited":
+            break
+        time.sleep(0.05)
+    code, out = _get(url, f"/v1/container-logs?id={cid}")
+    assert code == 200 and "line1" in out["logs"] and "line3" in out["logs"]
+    code, out = _get(url, f"/v1/container-logs?id={cid}&tail=1")
+    assert code == 200 and out["logs"].strip() == "line3"
+    code, _ = _get(url, "/v1/container-logs?id=nope")
+    assert code == 404
+
+
 def test_remove_running_container_refused(launch_served):
     import sys
 

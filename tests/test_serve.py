@@ -194,6 +194,88 @@ def test_speculative_server_validation(setup, draft_setup):
         srv.submit([1] * 10, max_new=cfg.max_seq - 12)
 
 
+def test_prefix_cache_exact_and_hits(setup):
+    """Prefix reuse: a request extending a served prompt splices cached
+    K/V and prefills only the remainder — tokens stay EXACTLY
+    make_generate's, and the hit counters prove the reuse happened."""
+    cfg, params = setup
+    srv = DecodeServer(cfg, params, slots=2, prefill_buckets=(8, 16),
+                       prefix_cache_size=4)
+    base = [1, 2, 3, 4, 5]
+    r1 = srv.submit(base + [6], max_new=5)
+    srv.run()
+    assert srv.result(r1) == _greedy_reference(cfg, params, base + [6], 5)
+    assert srv.prefix_hits == 0 and srv.prefix_misses == 1
+    # same full prompt stored -> longest stored proper prefix is base+[6]
+    ext = base + [6, 7, 8]
+    r2 = srv.submit(ext, max_new=5)
+    srv.run()
+    assert srv.result(r2) == _greedy_reference(cfg, params, ext, 5)
+    assert srv.prefix_hits == 1
+    # an unrelated prompt misses
+    r3 = srv.submit([9, 9, 9], max_new=3)
+    srv.run()
+    assert srv.result(r3) == _greedy_reference(cfg, params, [9, 9, 9], 3)
+    assert srv.prefix_misses == 2
+
+
+def test_prefix_hit_near_cache_end_falls_back(setup):
+    """When the padded remainder would write past max_seq (where the
+    cache write CLAMPS and would corrupt the prefix K/V), the hit path
+    must fall back to a full prefill — tokens stay exact."""
+    cfg, params = setup  # max_seq = 64
+    srv = DecodeServer(cfg, params, slots=1, prefill_buckets=(8,),
+                       prefix_cache_size=2)
+    base = list(range(1, 60))              # 59 tokens, stored
+    r1 = srv.submit(base, max_new=1)
+    srv.run()
+    assert srv.result(r1) == _greedy_reference(cfg, params, base, 1)
+    ext = base + [7, 8, 9]                 # 62 tokens; rem bucket 8
+    r2 = srv.submit(ext, max_new=2)        # 59 + 8 > 64: must NOT splice
+    srv.run()
+    assert srv.prefix_hits == 0            # fell back, no corrupting hit
+    assert srv.result(r2) == _greedy_reference(cfg, params, ext, 2)
+
+
+def test_prefix_cache_lru_eviction(setup):
+    cfg, params = setup
+    srv = DecodeServer(cfg, params, slots=1, prefill_buckets=(8,),
+                       prefix_cache_size=2)
+    for p in ([1, 1], [2, 2], [3, 3]):  # third insert evicts [1, 1]
+        rid = srv.submit(p, max_new=2)
+        srv.run()
+        srv.result(rid)
+    assert len(srv._prefix_cache) == 2
+    assert (1, 1) not in srv._prefix_cache
+    # extending the evicted prompt misses; extending a live one hits
+    rid = srv.submit([1, 1, 5], max_new=2)
+    srv.run()
+    assert srv.prefix_hits == 0
+    assert srv.result(rid) == _greedy_reference(cfg, params, [1, 1, 5], 2)
+    rid = srv.submit([3, 3, 5], max_new=2)
+    srv.run()
+    assert srv.prefix_hits == 1
+    assert srv.result(rid) == _greedy_reference(cfg, params, [3, 3, 5], 2)
+
+
+def test_prefix_cache_with_speculative_server(setup, draft_setup):
+    """Prefix reuse composes with the per-slot speculative mode (the
+    draft still full-prefills; only the target reuses)."""
+    cfg, params = setup
+    dcfg, dparams = draft_setup
+    srv = DecodeServer(cfg, params, slots=2, prefill_buckets=(8,),
+                       prefix_cache_size=2,
+                       draft_params=dparams, draft_cfg=dcfg, lookahead=3)
+    r1 = srv.submit([1, 2, 3], max_new=5)
+    srv.run()
+    want1 = _greedy_reference(cfg, params, [1, 2, 3], 5)
+    assert srv.result(r1) == want1
+    r2 = srv.submit([1, 2, 3, 7], max_new=5)
+    srv.run()
+    assert srv.prefix_hits == 1
+    assert srv.result(r2) == _greedy_reference(cfg, params, [1, 2, 3, 7], 5)
+
+
 def test_sampling_mode_is_deterministic_per_seed(setup):
     cfg, params = setup
 
