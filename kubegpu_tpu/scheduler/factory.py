@@ -306,8 +306,26 @@ def _pr_spreading(args):
         counts = {name: priorities.count_matching_selectors(f, sels)
                   for name, f in facts.items()}
         mx = max(counts.values(), default=0)
-        return {name: priorities.spread_score(counts[name], mx)
-                for name in facts}
+        # zone weighting (`selector_spreading.go` reduce): when any node
+        # carries zone labels, a zoned node's score blends 1/3 node
+        # spread with 2/3 zone spread (zone counts = sum of its nodes')
+        zones = {name: priorities.zone_key(f.labels)
+                 for name, f in facts.items()}
+        by_zone: dict = {}
+        for name, z in zones.items():
+            if z:
+                by_zone[z] = by_zone.get(z, 0) + counts[name]
+        zmax = max(by_zone.values(), default=0)
+        out = {}
+        for name in facts:
+            score = priorities.spread_score(counts[name], mx)
+            z = zones[name]
+            if by_zone and z:
+                zscore = priorities.spread_score(by_zone[z], zmax)
+                score = (score * (1.0 - priorities.ZONE_WEIGHTING)
+                         + priorities.ZONE_WEIGHTING * zscore)
+            out[name] = score
+        return out
     return batch
 
 

@@ -141,12 +141,29 @@ def count_matching_selectors(facts: NodeFacts, selectors: list) -> int:
     return n
 
 
+ZONE_REGION_LABEL = "failure-domain.beta.kubernetes.io/region"
+ZONE_FAILURE_DOMAIN_LABEL = "failure-domain.beta.kubernetes.io/zone"
+# zone spreading outweighs node spreading 2:1 (`selector_spreading.go:34`)
+ZONE_WEIGHTING = 2.0 / 3.0
+
+
+def zone_key(node_labels: dict) -> str:
+    """Unique per-failure-zone identifier from the node's region+zone
+    labels (upstream `GetZoneKey`); empty when the node is unzoned."""
+    region = node_labels.get(ZONE_REGION_LABEL, "")
+    zone = node_labels.get(ZONE_FAILURE_DOMAIN_LABEL, "")
+    if not region and not zone:
+        return ""
+    return f"{region}:\x00:{zone}"
+
+
 def spread_score(count: int, max_count: int) -> float:
     """The reference's reduce formula
     (`selector_spreading.go` CalculateSpreadPriorityReduce):
     ``MaxPriority * (max - count) / max``; all nodes score MaxPriority
-    when no node has a matching pod. Zone weighting is not modeled —
-    the fake-cluster nodes carry no zone labels."""
+    when no node has a matching pod. Zone weighting sits ABOVE this
+    (`factory._pr_spreading` blends node and zone spread_scores by
+    `ZONE_WEIGHTING` when nodes carry `zone_key` labels)."""
     if max_count <= 0:
         return MAX_PRIORITY
     return MAX_PRIORITY * (max_count - count) / max_count

@@ -231,9 +231,10 @@ LAB1 = {"foo": "bar", "baz": "blah"}
 LAB2 = {"bar": "foo", "baz": "blah"}
 
 
-def _spread(pod_labels, node_pods, services=(), rcs=(), rss=(), sss=()):
+def _spread(pod_labels, node_pods, services=(), rcs=(), rss=(), sss=(),
+            node_labels=None):
     """Run SelectorSpreadPriority the way the scheduler does: owner
-    selectors resolved for the pod, reference map+reduce over two nodes.
+    selectors resolved for the pod, reference map+reduce over the nodes.
     ``node_pods`` = {node: [labels, ...]}. Vectors ported from the
     reference's `selector_spreading_test.go` (namespace-free rows)."""
     from kubegpu_tpu.scheduler import factory
@@ -242,8 +243,11 @@ def _spread(pod_labels, node_pods, services=(), rcs=(), rss=(), sss=()):
            "spec": {}}
     facts = {}
     for node, podlist in node_pods.items():
+        meta = {"name": node}
+        if node_labels and node in node_labels:
+            meta["labels"] = dict(node_labels[node])
         facts[node] = priorities.NodeFacts(
-            {"metadata": {"name": node}}, {}, {},
+            {"metadata": meta}, {}, {},
             {f"{node}-{i}": dict(lab) for i, lab in enumerate(podlist)})
     ctx = factory.PriorityContext(
         owner_selectors=priorities.owner_selectors_for_pod(
@@ -296,6 +300,43 @@ def test_selector_spread_upstream_vectors():
                          "spec": {"selector":
                                   {"matchLabels": {"foo": "bar"}}}}]) == \
         {"m1": 0.0, "m2": 5.0}
+
+
+def test_zone_selector_spread_upstream_vectors():
+    """Zone-weighted reduce vectors from the reference's
+    `TestZoneSelectorSpreadPriority` (`selector_spreading_test.go:366+`,
+    expected scores on upstream's int-truncated 0-10 scale): a zoned
+    node's score blends 1/3 node spread with 2/3 zone spread."""
+    ZL = priorities.ZONE_FAILURE_DOMAIN_LABEL
+    LA = {"label1": "l1", "baz": "blah"}
+    LB = {"label2": "l2", "baz": "blah"}
+    nodes = {"m1z1": {ZL: "zone1"}, "m1z2": {ZL: "zone2"},
+             "m2z2": {ZL: "zone2"}, "m1z3": {ZL: "zone3"},
+             "m2z3": {ZL: "zone3"}, "m3z3": {ZL: "zone3"}}
+
+    def run(node_pods):
+        scores = _spread(LA, node_pods, services=[svc(LA)],
+                         node_labels=nodes)
+        return {n: int(s) for n, s in scores.items()}
+
+    # "two pods, 1 matching (in z2)"
+    assert run({"m1z1": [LB], "m1z2": [LA], "m2z2": [], "m1z3": [],
+                "m2z3": [], "m3z3": []}) == \
+        {"m1z1": 10, "m1z2": 0, "m2z2": 3, "m1z3": 10, "m2z3": 10,
+         "m3z3": 10}
+    # "five pods, 3 matching (z2=2, z3=1)"
+    assert run({"m1z1": [LB], "m1z2": [LA], "m2z2": [LA], "m1z3": [LB],
+                "m2z3": [LA], "m3z3": []}) == \
+        {"m1z1": 10, "m1z2": 0, "m2z2": 0, "m1z3": 6, "m2z3": 3,
+         "m3z3": 6}
+    # "four pods, 3 matching (z1=1, z2=1, z3=1)"
+    assert run({"m1z1": [LA], "m1z2": [LA], "m2z2": [LB], "m1z3": [LA],
+                "m2z3": [], "m3z3": []}) == \
+        {"m1z1": 0, "m1z2": 0, "m2z2": 3, "m1z3": 0, "m2z3": 3,
+         "m3z3": 3}
+    # unzoned cluster is pure node spread (haveZones == false)
+    plain = _spread(LA, {"a": [LA], "b": []}, services=[svc(LA)])
+    assert plain == {"a": 0.0, "b": 10.0}
 
 
 def test_selector_spread_match_expressions():
