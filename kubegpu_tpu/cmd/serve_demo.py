@@ -73,12 +73,31 @@ def main(argv=None) -> int:
             pass
     import numpy as np
 
+    from kubegpu_tpu.workload import spmd
     from kubegpu_tpu.workload.model import TransformerConfig, init_params
+
+    # serving is a gang workload like training: a scheduled pod-set joins
+    # one jax.distributed group from the hook-injected contract (no-op
+    # single-process), then serves over a model-parallel mesh. The batch
+    # stays replicated (dp=1): every rank drives the same host loop and
+    # the decode outputs stay fully addressable on each process.
+    multiproc = spmd.distributed_init_from_env()
+    ndev = len(jax.devices())
+    mesh = spmd.make_mesh(ndev, dp=1, sp=1, tp=ndev) if ndev > 1 else None
 
     cfg = TransformerConfig(vocab=args.vocab, d_model=args.d_model,
                             n_heads=args.n_heads, n_layers=args.n_layers,
                             d_ff=4 * args.d_model, max_seq=args.seq)
-    params = init_params(jax.random.PRNGKey(args.seed), cfg)
+    if mesh is not None:
+        # initialize DIRECTLY sharded (train.py's init pattern): a model
+        # sized to need the mesh must never be materialized on one
+        # device first, and small runs skip a full-model reshuffle
+        from kubegpu_tpu.workload.train import init_sharded
+
+        params, _, _ = init_sharded(jax.random.PRNGKey(args.seed), cfg,
+                                    mesh, init_optimizer=False)
+    else:
+        params = init_params(jax.random.PRNGKey(args.seed), cfg)
     restored_step = None
     if args.checkpoint_dir:
         from kubegpu_tpu.workload.checkpoint import restore_checkpoint
@@ -92,13 +111,38 @@ def main(argv=None) -> int:
         state, at = restore_checkpoint(
             args.checkpoint_dir,
             {"params": params, "opt_state": opt_template})
-        if state is None:
+        ok = state is not None
+        if multiproc:
+            # EVERY rank must agree on restore success before any
+            # collective: one rank exiting at ap.error while its peers
+            # enter the first sharded op would hang the survivors until
+            # the heartbeat/supervisor timeout
+            from jax.experimental import multihost_utils
+
+            ok = bool(multihost_utils.process_allgather(
+                np.array([ok])).all())
+        if not ok:
             ap.error(f"no readable checkpoint in {args.checkpoint_dir} "
                      "(serve_demo restores full fine-tune checkpoints "
-                     "saved by train_demo)")
+                     "saved by train_demo; in a gang, every rank needs "
+                     "the checkpoint readable)")
         params = state["params"]
         restored_step = at
         del state  # drop the restored Adam moments before serving
+
+    def place(tree, tree_cfg):
+        """Lay weights out on the serving mesh (fresh OR restored params
+        land committed to one device otherwise, which conflicts with the
+        forward's sharding constraints)."""
+        if mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(tree, jax.tree.map(
+            lambda s: NamedSharding(mesh, s), spmd.param_pspecs(tree_cfg),
+            is_leaf=lambda x: isinstance(x, PartitionSpec)))
+
+    params = place(params, cfg)
 
     rng = np.random.default_rng(args.seed)
     prompts = [[int(t) for t in rng.integers(1, cfg.vocab,
@@ -111,7 +155,15 @@ def main(argv=None) -> int:
             vocab=args.vocab, d_model=max(32, args.d_model // 4),
             n_heads=args.n_heads, n_layers=args.draft_layers,
             d_ff=args.d_model, max_seq=args.seq)
-        draft = init_params(jax.random.PRNGKey(args.seed + 1), draft_cfg)
+        if mesh is not None:
+            from kubegpu_tpu.workload.train import init_sharded
+
+            draft, _, _ = init_sharded(jax.random.PRNGKey(args.seed + 1),
+                                       draft_cfg, mesh,
+                                       init_optimizer=False)
+        else:
+            draft = init_params(jax.random.PRNGKey(args.seed + 1),
+                                draft_cfg)
 
     t0 = time.perf_counter()
     if args.speculative:
@@ -119,6 +171,7 @@ def main(argv=None) -> int:
             make_speculative_generate)
 
         gen = make_speculative_generate(cfg, draft_cfg, k=args.lookahead,
+                                        mesh=mesh,
                                         temperature=args.temperature,
                                         top_k=args.top_k, top_p=args.top_p)
         outs, calls = [], 0
@@ -132,7 +185,7 @@ def main(argv=None) -> int:
     else:
         from kubegpu_tpu.workload.serve import DecodeServer
 
-        srv = DecodeServer(cfg, params, slots=args.slots,
+        srv = DecodeServer(cfg, params, slots=args.slots, mesh=mesh,
                            temperature=args.temperature, top_k=args.top_k,
                            top_p=args.top_p,
                            rng=jax.random.PRNGKey(args.seed),
@@ -152,13 +205,18 @@ def main(argv=None) -> int:
 
     if restored_step is not None:
         stats["restored_step"] = restored_step
+    if multiproc:
+        stats["processes"] = jax.process_count()
     stats.update({
         "requests": args.requests,
         "wall_s": round(wall, 2),
         "tokens_per_s": round(stats["tokens"] / wall, 1),
         "first_output": outs[0][:8],
     })
-    print(json.dumps(stats))
+    # one JSON line per JOB: in a gang every rank serves the identical
+    # replicated batch, so rank 0 speaks for the group
+    if jax.process_index() == 0 or not multiproc:
+        print(json.dumps(stats))
     return 0
 
 

@@ -38,6 +38,61 @@ TRAIN = [sys.executable, "-m", "kubegpu_tpu.cmd.train_demo",
          "--tp", "1", *SIZE]
 
 
+SERVE_SIZE = ["--seq", "64", "--vocab", "64", "--d-model", "32",
+              "--n-layers", "1", "--n-heads", "4"]
+
+
+def test_gang_serves_across_processes(tmp_path, monkeypatch):
+    """Serving is a gang workload too: two scheduled pods launch
+    serve_demo, join one jax.distributed group, serve over a tp=2 mesh
+    spanning processes, and rank 0's tokens equal the single-process
+    server's exactly."""
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.chdir(REPO)
+    gid = free_gang_id()
+    api, hosts, sched = slice_cluster([(0, 0, 0), (2, 0, 0)], (4, 2, 1))
+    api.create_pod(gang_pod("sv-0", 4, gang_id=gid, gang_size=2))
+    api.create_pod(gang_pod("sv-1", 4, gang_id=gid, gang_size=2))
+    sched.run_until_idle()
+    assert all(api.get_pod(n)["spec"].get("nodeName")
+               for n in ("sv-0", "sv-1")), "gang did not bind"
+
+    cmd = [sys.executable, "-m", "kubegpu_tpu.cmd.serve_demo",
+           "--requests", "2", "--max-new", "4", *SERVE_SIZE]
+    sup = WorkloadSupervisor(api=api, log_dir=str(tmp_path))
+    cids = {}
+    try:
+        for name in ("sv-0", "sv-1"):
+            node = api.get_pod(name)["spec"]["nodeName"]
+            cfg = hosts[node].hook.create_container(
+                name, "main", {"envs": platform_envs(1)})
+            cids[name] = sup.launch(name, "main", cfg, cmd).cid
+        statuses = {n: sup.wait(c, timeout=480) for n, c in cids.items()}
+    finally:
+        sup.shutdown()
+    for name, st in statuses.items():
+        log = open(st["log_path"]).read()
+        assert st["exit_code"] == 0, f"{name} failed:\n{log[-2000:]}"
+    outs = []
+    for st in statuses.values():
+        outs.extend(json.loads(ln) for ln in open(st["log_path"])
+                    if ln.startswith("{"))
+    assert len(outs) == 1, "exactly one rank speaks for the job"
+    out = outs[0]
+    assert out["processes"] == 2 and out["tokens"] == 8
+
+    # the distributed serve IS the single-process serve (f32 exact)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    ref = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=480, env=env, cwd=REPO)
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_out = json.loads(ref.stdout.strip().splitlines()[-1])
+    assert out["first_output"] == ref_out["first_output"]
+
+
 def test_coordinator_port_skips_in_use():
     """Congruent gang ids (or a busy port on the coordinator host) must
     not collide: the deterministic port linearly probes past used ones,
