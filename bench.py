@@ -1211,6 +1211,19 @@ def main():
     per_config["scale_256node_p95_ms"] = round(
         s256[int(0.95 * (len(s256) - 1))] * 1e3, 3)
     per_config["scale_256node_max_ms"] = round(s256[-1] * 1e3, 3)
+    # Robustness trajectory: kill one node agent of a 2-node gang under
+    # the seeded chaos transport; time from agent death to the gang fully
+    # rebound on surviving nodes (detection grace included) with zero
+    # leaked chips. See cmd/simulate.py --chaos. A scenario failure is a
+    # missing metric, never a lost bench run — every other number above
+    # is already in hand.
+    try:
+        from kubegpu_tpu.cmd.simulate import run_chaos_scenario
+
+        per_config["node_loss_recovery_ms"] = \
+            run_chaos_scenario(seed=0)["recovery_ms"]
+    except Exception as e:  # noqa: BLE001
+        per_config["node_loss_recovery_error"] = f"{type(e).__name__}: {e}"
     while _LIVE_CLUSTERS:
         _LIVE_CLUSTERS.pop().close()
     if not os.environ.get("KGTPU_BENCH_SKIP_WORKLOAD"):

@@ -1,0 +1,163 @@
+"""Chaos transport: a seeded, deterministic fault-injection proxy over the
+API-client surface.
+
+Every component in this framework (scheduler, node agent, lifecycle
+controller, runtime hook) talks to the control plane through one client
+surface — ``InMemoryAPIServer`` in-process or ``HTTPAPIClient`` over the
+wire (`cluster/httpapi.py` implements the identical methods). That makes
+the transport the single choke point where network failure can be
+injected for ALL of them: a ``ChaosProxy`` wraps any such client and,
+per call, may
+
+- **drop** the request (raise ``ConnectionError`` before it is sent —
+  the caller sees a transient transport failure, the server never does),
+- **delay** it (sleep before delivery),
+- **duplicate** it (deliver twice; the second delivery's outcome is
+  discarded — the at-least-once retry a real network can produce), or
+- **partition** the component (every call fails until ``heal``).
+
+Faults draw from one seeded RNG owned by the shared ``ChaosNetwork``, so
+a single-threaded driver replays the identical fault sequence for a
+given seed — the property the chaos tests assert three runs in a row.
+
+Verbs can be scoped (``verbs=`` / ``exempt=``) so a test can target the
+write path while leaving reads clean. ``add_watcher``/``close`` are
+always passed through un-faulted: watch registration is process wiring,
+not a request.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+# Verbs never faulted: local wiring, not requests on the wire.
+_PASSTHROUGH = {"add_watcher", "close"}
+
+
+class ChaosConfig:
+    """Per-component fault rates. ``drop``/``delay``/``duplicate`` are
+    probabilities per call; ``delay_s`` the injected latency."""
+
+    def __init__(self, drop: float = 0.0, delay: float = 0.0,
+                 delay_s: float = 0.002, duplicate: float = 0.0,
+                 verbs: set | frozenset | None = None,
+                 exempt: set | frozenset | None = None):
+        self.drop = drop
+        self.delay = delay
+        self.delay_s = delay_s
+        self.duplicate = duplicate
+        self.verbs = frozenset(verbs) if verbs is not None else None
+        self.exempt = frozenset(exempt or ())
+
+    def applies_to(self, verb: str) -> bool:
+        if verb in self.exempt:
+            return False
+        return self.verbs is None or verb in self.verbs
+
+
+class ChaosNetwork:
+    """Shared fault source for a set of proxied components: one seeded
+    RNG (deterministic replay), per-component configs, and the partition
+    set."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._partitioned: set = set()
+        self.faults: dict = {}  # (component, kind) -> count
+
+    def proxy(self, api, component: str,
+              config: ChaosConfig | None = None) -> "ChaosProxy":
+        return ChaosProxy(self, api, component, config or ChaosConfig())
+
+    # ---- partitions --------------------------------------------------------
+
+    def partition(self, *components: str) -> None:
+        """Cut the named components off from the API server entirely."""
+        with self._lock:
+            self._partitioned.update(components)
+
+    def heal(self, *components: str) -> None:
+        """Reconnect components (no args = heal everything)."""
+        with self._lock:
+            if components:
+                self._partitioned.difference_update(components)
+            else:
+                self._partitioned.clear()
+
+    def is_partitioned(self, component: str) -> bool:
+        with self._lock:
+            return component in self._partitioned
+
+    # ---- fault drawing -----------------------------------------------------
+
+    def _count(self, component: str, kind: str) -> None:
+        key = (component, kind)
+        self.faults[key] = self.faults.get(key, 0) + 1
+
+    def draw(self, component: str, verb: str, config: ChaosConfig):
+        """Decide this call's fate. Returns (delay_s, duplicate) or
+        raises ConnectionError for drops/partitions. One lock-guarded
+        RNG draw sequence per call keeps a given seed's fault schedule
+        reproducible under a single-threaded driver."""
+        with self._lock:
+            if component in self._partitioned:
+                self._count(component, "partition")
+                raise ConnectionError(
+                    f"chaos: {component} is partitioned from the API "
+                    f"server ({verb})")
+            if not config.applies_to(verb):
+                return 0.0, False
+            roll = self._rng.random()
+            delay_s = 0.0
+            duplicate = False
+            if roll < config.drop:
+                self._count(component, "drop")
+                raise ConnectionError(
+                    f"chaos: dropped {component}.{verb}")
+            roll = self._rng.random()
+            if roll < config.delay:
+                self._count(component, "delay")
+                delay_s = config.delay_s
+            roll = self._rng.random()
+            if roll < config.duplicate:
+                self._count(component, "duplicate")
+                duplicate = True
+            return delay_s, duplicate
+
+
+class ChaosProxy:
+    """Duck-typed stand-in for the API client it wraps: every callable
+    attribute goes through the chaos network first."""
+
+    def __init__(self, net: ChaosNetwork, api, component: str,
+                 config: ChaosConfig):
+        self._net = net
+        self._api = api
+        self._component = component
+        self._config = config
+
+    def __getattr__(self, name: str):
+        real = getattr(self._api, name)
+        if not callable(real) or name.startswith("_") \
+                or name in _PASSTHROUGH:
+            return real
+
+        def wrapper(*args, **kwargs):
+            delay_s, duplicate = self._net.draw(
+                self._component, name, self._config)
+            if delay_s > 0:
+                time.sleep(delay_s)
+            result = real(*args, **kwargs)
+            if duplicate:
+                # at-least-once delivery: the duplicate's outcome (often
+                # a Conflict on create, a no-op on idempotent verbs) is
+                # the network's problem, not the caller's
+                try:
+                    real(*args, **kwargs)
+                except Exception:
+                    pass
+            return result
+        return wrapper

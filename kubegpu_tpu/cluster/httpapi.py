@@ -28,8 +28,11 @@ Leases implement the scheduler's HA leader election (reference:
 from __future__ import annotations
 
 import json
+import logging
+import random
 import threading
 import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -263,29 +266,56 @@ class HTTPAPIClient:
     log, so informer-style consumers (the scheduler) work unchanged.
     """
 
+    # Verbs safe to resend when the transport (not the server) failed:
+    # the request either never arrived or its reply was lost, and
+    # re-applying it converges to the same state. POST stays single-shot
+    # — a blind resend of a bind/create could double-apply.
+    IDEMPOTENT_METHODS = frozenset({"GET", "PUT", "PATCH", "DELETE"})
+    RETRY_ATTEMPTS = 3
+    RETRY_BASE_S = 0.05
+    RETRY_CAP_S = 0.5
+
     def __init__(self, base_url: str, timeout: float = 30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self._watchers: list = []
         self._watch_thread = None
         self._stop = threading.Event()
+        self.retry_count = 0   # transport-level retries performed
+        self.watch_errors = 0  # failed watch polls survived
 
     def _req(self, method: str, path: str, body=None, timeout=None):
+        """One API round trip. Idempotent verbs retry transient transport
+        failures (connection reset, refused, timeout) with capped
+        exponential backoff + jitter; an HTTP *response* — any status —
+        is the server speaking and is never retried here."""
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
-                return json.loads(resp.read().decode() or "{}")
-        except urllib.error.HTTPError as e:
-            payload = e.read().decode()
-            if e.code == 404:
-                raise NotFound(payload)
-            if e.code == 409:
-                raise Conflict(payload)
-            raise RuntimeError(f"HTTP {e.code}: {payload}")
+        attempts = self.RETRY_ATTEMPTS \
+            if method in self.IDEMPOTENT_METHODS else 1
+        for attempt in range(attempts):
+            req = urllib.request.Request(
+                self.base_url + path, data=data, method=method,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout) as resp:
+                    return json.loads(resp.read().decode() or "{}")
+            except urllib.error.HTTPError as e:
+                payload = e.read().decode()
+                if e.code == 404:
+                    raise NotFound(payload)
+                if e.code == 409:
+                    raise Conflict(payload)
+                raise RuntimeError(f"HTTP {e.code}: {payload}")
+            except (urllib.error.URLError, ConnectionError,
+                    TimeoutError, OSError):
+                if attempt + 1 >= attempts:
+                    raise
+                self.retry_count += 1
+                backoff = min(self.RETRY_CAP_S,
+                              self.RETRY_BASE_S * 2 ** attempt)
+                # jitter so a fleet of clients doesn't resend in lockstep
+                self._stop.wait(backoff * (0.5 + random.random() / 2.0))
 
     # -- node/pod surface ---------------------------------------------------
 
@@ -432,14 +462,32 @@ class HTTPAPIClient:
             self._watch_thread.start()
 
     def _watch_loop(self):
+        """Informer long-poll. MUST outlive transient transport errors:
+        the consumers behind it (scheduler cache, queue wake-ups) have no
+        other event source, so a watch thread dying silently strands the
+        whole control loop. Failed polls back off exponentially (capped),
+        are counted in ``watch_errors``, logged once per failure streak,
+        and every recovery resumes from the last seen sequence number —
+        no events skipped, none replayed."""
+        log = logging.getLogger(__name__)
         seq = 0
+        failures = 0
         while not self._stop.is_set():
             try:
                 out = self._req("GET", f"/watch?since={seq}&timeout=5",
                                 timeout=30.0)
             except Exception:
-                time.sleep(0.5)
+                self.watch_errors += 1
+                failures += 1
+                if failures == 1:
+                    log.warning("watch poll failed; retrying from seq %d",
+                                seq, exc_info=True)
+                self._stop.wait(min(5.0, 0.2 * 2 ** min(failures - 1, 5)))
                 continue
+            if failures:
+                log.info("watch recovered after %d failed polls; "
+                         "resuming from seq %d", failures, seq)
+                failures = 0
             for ev_seq, kind, event, obj in out.get("events", []):
                 seq = max(seq, ev_seq)
                 for fn in list(self._watchers):

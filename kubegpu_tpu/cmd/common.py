@@ -57,9 +57,13 @@ def prometheus_text() -> str:
         lines.append(f"{h.name}_sum {h.total:.0f}")
         lines.append(f"{h.name}_count {h.n}")
     for c in (metrics.SCHEDULE_ATTEMPTS, metrics.SCHEDULE_FAILURES,
-              metrics.PREEMPTION_VICTIMS):
+              metrics.PREEMPTION_VICTIMS, metrics.NODE_LOST,
+              metrics.EVICTIONS):
         lines.append(f"# TYPE {c.name} counter")
         lines.append(f"{c.name} {c.value}")
+    for g in (metrics.NODE_READY,):
+        lines.append(f"# TYPE {g.name} gauge")
+        lines.append(f"{g.name} {g.value}")
     return "\n".join(lines) + "\n"
 
 

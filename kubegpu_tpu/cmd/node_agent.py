@@ -95,8 +95,12 @@ def main(argv=None) -> int:
                         args.device_plugins_dir)
     adv = DeviceAdvertiser(client, mgr, node_name, address=address)
     adv.start(interval_s=args.advertise_interval, retry_s=args.retry_interval)
+    # /healthz goes unhealthy when advertising has been failing longer
+    # than the advertise interval — a dead/blocked advertise loop is a
+    # dead node as far as the scheduler's lifecycle controller is
+    # concerned, and the agent should say so before the scheduler does.
     common.serve_health(args.healthz_port,
-                        extra_status=lambda: adv.patch_count > 0)
+                        extra_status=adv.healthy)
 
     cri_server = None
     supervisor = None

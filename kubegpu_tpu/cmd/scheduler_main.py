@@ -70,6 +70,14 @@ def main(argv=None) -> int:
     parser.add_argument("--disable-preemption", action="store_true")
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--lease-ttl", type=float, default=15.0)
+    parser.add_argument("--node-grace-s", type=float, default=0.0,
+                        help="heartbeat grace period before a node is "
+                             "Lost and its pods (whole gangs) are "
+                             "evicted; 0 disables the node lifecycle "
+                             "controller")
+    parser.add_argument("--node-stale-s", type=float, default=0.0,
+                        help="heartbeat age marking a node Stale "
+                             "(default: node-grace-s / 3)")
     parser.add_argument("--healthz-port", type=int, default=0)
     parser.add_argument("--scheduler-plugins-dir", default=None,
                         help="load extra device-scheduler plugins (*.py "
@@ -78,7 +86,8 @@ def main(argv=None) -> int:
                         help="JSON/YAML file; explicit flags win")
     args = parser.parse_args(argv)
     config = common.load_config(args.config)
-    common.merge_flags(args, config, ["api", "parallelism", "lease_ttl"])
+    common.merge_flags(args, config, ["api", "parallelism", "lease_ttl",
+                                      "node_grace_s", "node_stale_s"])
 
     client = HTTPAPIClient(args.api)
     holder = f"{os.uname().nodename}-{os.getpid()}"
@@ -90,30 +99,73 @@ def main(argv=None) -> int:
     common.serve_health(args.healthz_port,
                         extra_status=lambda: True)
 
+    def start_lifecycle():
+        """Node liveness controller, gated on --node-grace-s. Runs only
+        while this replica schedules (the leader owns evictions — two
+        controllers double-evicting would race the requeues)."""
+        if not args.node_grace_s or args.node_grace_s <= 0:
+            return None
+        from kubegpu_tpu.scheduler.lifecycle import NodeLifecycle
+
+        stale = args.node_stale_s if args.node_stale_s > 0 \
+            else args.node_grace_s / 3.0
+        controller = NodeLifecycle(client, stale_after_s=stale,
+                                   lost_after_s=args.node_grace_s)
+        controller.start()
+        return controller
+
+    lifecycle = None
     if not args.leader_elect:
         sched = build_scheduler(client, args, config)
         sched.start()
+        lifecycle = start_lifecycle()
         print(f"scheduler running against {args.api}", flush=True)
         stop.wait()
+        if lifecycle is not None:
+            lifecycle.stop()
         sched.stop()
         return 0
 
     # Leader election: acquire -> run; renew at ttl/3; demote on loss.
     print(f"scheduler candidate {holder} (leader election on)", flush=True)
     leading = False
+    lease_valid_until = 0.0
     while not stop.is_set():
-        acquired = client.acquire_lease(LEASE_NAME, holder, args.lease_ttl)
+        # A transient transport error at renewal must neither crash the
+        # replica (the retry layer skips POSTs, and acquire_lease is one)
+        # nor demote a leader that still holds the lease: nobody else can
+        # acquire until the TTL truly lapses, so tearing down early just
+        # leaves the cluster leaderless. Keep leading while the last
+        # successful renewal is still within TTL; demote only on a real
+        # denial or once the lease could have expired.
+        try:
+            # stamp validity from BEFORE the round trip: the server's TTL
+            # starts when it grants, so counting from the reply would keep
+            # us leading ~one RTT past a lapse a standby can already take
+            asked_at = time.monotonic()
+            acquired = client.acquire_lease(LEASE_NAME, holder,
+                                            args.lease_ttl)
+            if acquired:
+                lease_valid_until = asked_at + args.lease_ttl
+        except Exception:
+            acquired = leading and time.monotonic() < lease_valid_until
         if acquired and not leading:
             sched = build_scheduler(client, args, config)
             sched.start()
+            lifecycle = start_lifecycle()
             leading = True
             print(f"{holder} became leader", flush=True)
         elif not acquired and leading:
+            if lifecycle is not None:
+                lifecycle.stop()
+                lifecycle = None
             sched.stop()
             sched = None
             leading = False
             print(f"{holder} lost the lease, standing by", flush=True)
         stop.wait(args.lease_ttl / 3.0)
+    if lifecycle is not None:
+        lifecycle.stop()
     if sched is not None:
         sched.stop()
     return 0

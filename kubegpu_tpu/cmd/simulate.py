@@ -4,12 +4,19 @@ Spins up the API server, N fake v5p hosts with advertisers, and the
 scheduler; submits a workload mix (plain, HBM-floored, contiguous, and a
 gang) and prints the placements plus what each container would receive
 from the runtime hook.
+
+``--chaos`` runs the node-loss recovery scenario instead: a 4-host
+cluster under a seeded chaos transport, a 2-node gang placed, one node
+agent killed mid-gang — measuring how long the NodeLifecycle controller
+takes to detect the loss, evict the gang, and rebind it entirely on
+surviving nodes with zero leaked chips.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import time
 
 from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
 from kubegpu_tpu.core import codec, grammar
@@ -37,11 +44,143 @@ def make_pod(name, numchips, pod_requests=None, hbm=0):
                                      "resources": {"requests": {"cpu": "1"}}}]}}
 
 
+def _gang_chips(api, name):
+    """Chip-id list a bound pod's allocation annotation pins."""
+    pi = codec.kube_pod_to_pod_info(api.get_pod(name),
+                                    invalidate_existing=False)
+    chips = []
+    for cont in pi.running_containers.values():
+        for path in cont.allocate_from.values():
+            cid = grammar.chip_id_from_path(path)
+            if cid:
+                chips.append(cid)
+    return chips
+
+
+def run_chaos_scenario(seed: int = 0, lost_after_s: float = 0.9,
+                       stale_after_s: float = 0.3,
+                       advertise_interval_s: float = 0.1,
+                       drop: float = 0.05):
+    """Kill one node agent of a 2-node gang under a seeded chaos
+    transport; measure detection + gang eviction + rebind time.
+
+    Returns a dict with ``recovery_ms``, the victim node, the chaos fault
+    counts, and the final placements — raises if the gang fails to place,
+    leaks chips, or lands back on the lost node.
+    """
+    from kubegpu_tpu.cluster.chaos import ChaosConfig, ChaosNetwork
+    from kubegpu_tpu.scheduler.lifecycle import NodeLifecycle
+
+    net = ChaosNetwork(seed=seed)
+    api = InMemoryAPIServer()
+    # 2x2 grid of 4-chip hosts: any surviving pair adjacent to each other
+    # can host the re-planned 8-chip gang block
+    origins = [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)]
+    advs = {}
+    for i, origin in enumerate(origins):
+        name = f"host{i}"
+        api.create_node({"metadata": {"name": name},
+                         "status": {"allocatable": {"cpu": "64",
+                                                    "pods": 100}}})
+        mgr = DevicesManager()
+        mgr.add_device(TPUDeviceManager(FakeTPUBackend(
+            v5p_host_inventory(host_origin=origin, mesh_dims=(4, 4, 1)))))
+        mgr.start()
+        adv = DeviceAdvertiser(
+            net.proxy(api, f"agent-{name}", ChaosConfig(drop=drop)),
+            mgr, name)
+        adv.start(interval_s=advertise_interval_s, retry_s=0.03)
+        advs[name] = adv
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    # chaos scoped to verbs every failure path requeues through cleanly
+    # (list_pods is excluded: the Scheduler constructor's cold-start sync
+    # reads it with no retry layer above the in-memory client)
+    sched_api = net.proxy(api, "scheduler", ChaosConfig(
+        drop=drop, delay=0.2, delay_s=0.002,
+        verbs={"bind_many", "bind_pod", "update_pod_annotations",
+               "record_event", "get_pod"}))
+    sched = Scheduler(sched_api, ds)
+    sched.start()
+    lifecycle = NodeLifecycle(
+        net.proxy(api, "lifecycle", ChaosConfig(drop=drop)),
+        stale_after_s=stale_after_s, lost_after_s=lost_after_s)
+    lifecycle.start(interval_s=0.05)
+    names = ["chaos-gang-0", "chaos-gang-1"]
+    try:
+        for name in names:
+            api.create_pod(make_pod(name, 4,
+                                    pod_requests={RESOURCE_GANG: 77,
+                                                  RESOURCE_GANG_SIZE: 2}))
+
+        def placements(deadline_s, forbidden=None):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                bound = {}
+                for name in names:
+                    try:
+                        node = api.get_pod(name)["spec"].get("nodeName")
+                    except KeyError:
+                        # mid-eviction: deleted, replacement not created
+                        # yet (the create may even have been chaos-dropped
+                        # and be parked for the next lifecycle tick)
+                        break
+                    if not node or (forbidden and node == forbidden):
+                        break
+                    bound[name] = node
+                else:
+                    return bound
+                time.sleep(0.02)
+            raise RuntimeError(
+                f"gang did not (re)bind in {deadline_s}s "
+                f"(forbidden={forbidden}, faults={net.faults})")
+
+        first = placements(20.0)
+        victim = first[names[0]]
+        advs[victim].stop()  # the node agent dies mid-gang
+        t0 = time.monotonic()
+        final = placements(30.0, forbidden=victim)
+        recovery_ms = (time.monotonic() - t0) * 1e3
+        chips = {name: _gang_chips(api, name) for name in names}
+        all_chips = [c for cs in chips.values() for c in cs]
+        if sorted(len(c) for c in chips.values()) != [4, 4] or \
+                len(set(all_chips)) != 8:
+            raise RuntimeError(f"chip leak/short allocation: {chips}")
+        return {"recovery_ms": round(recovery_ms, 1),
+                "victim": victim,
+                "first_placement": first,
+                "final_placement": final,
+                "evicted_pods": lifecycle.evicted_total,
+                "chaos_faults": {f"{c}:{k}": n for (c, k), n
+                                 in sorted(net.faults.items())}}
+    finally:
+        lifecycle.stop()
+        for adv in advs.values():
+            adv.stop()
+        sched.stop()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--hosts", type=int, default=4)
     parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run the node-loss recovery scenario under "
+                             "the seeded chaos transport")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="chaos transport seed")
     args = parser.parse_args(argv)
+
+    if args.chaos:
+        result = run_chaos_scenario(seed=args.seed)
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            print(f"node {result['victim']} killed mid-gang; recovered in "
+                  f"{result['recovery_ms']:.0f} ms "
+                  f"({result['first_placement']} -> "
+                  f"{result['final_placement']})")
+        return 0
 
     api = InMemoryAPIServer()
     hooks = {}

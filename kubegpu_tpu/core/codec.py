@@ -26,6 +26,15 @@ POD_ANNOTATION_KEY = "pod.alpha/DeviceInformation"
 # this when building TPU_COORDINATOR_ADDRESS (node NAMES are cluster
 # identifiers, not necessarily resolvable hostnames).
 NODE_ADDRESS_ANNOTATION = "node.alpha/Address"
+# Wall-clock timestamp (seconds) the advertiser stamps on every successful
+# pass — the liveness signal the scheduler-side NodeLifecycle controller
+# ages into Ready/Stale/Lost. Wall clock, not monotonic: the stamp crosses
+# process (and potentially host) boundaries.
+NODE_HEARTBEAT_ANNOTATION = "node.alpha/Heartbeat"
+# Per-chip health map {chip_id: "healthy" | "degraded" | ...} reported by
+# the device backend. A non-healthy chip is withheld from the advertised
+# allocatable inventory (the node shrinks, it does not vanish).
+NODE_CHIP_HEALTH_ANNOTATION = "node.alpha/ChipHealth"
 
 # Kubernetes quantity suffixes -> multiplier. Serialized pods carry requests
 # as quantity strings ("500m", "1Gi"); the reference reads them through
@@ -91,6 +100,43 @@ def annotation_to_node_info(meta: dict, existing: NodeInfo | None = None) -> Nod
         for key, val in existing.used.items():
             node_info.used[key] = val
     return node_info
+
+
+def heartbeat_to_annotation(meta: dict, timestamp: float) -> None:
+    """Stamp the advertiser's liveness heartbeat (wall-clock seconds)."""
+    _annotations(meta)[NODE_HEARTBEAT_ANNOTATION] = json.dumps(
+        round(float(timestamp), 3))
+
+
+def annotation_to_heartbeat(meta: dict) -> float | None:
+    """Decode the heartbeat timestamp; None = no (or unparseable)
+    heartbeat, meaning liveness is not tracked for this node (a node
+    registered out-of-band, or an older advertiser)."""
+    raw = (meta.get("annotations") or {}).get(NODE_HEARTBEAT_ANNOTATION)
+    if raw is None:
+        return None
+    try:
+        return float(json.loads(raw))
+    except (TypeError, ValueError):
+        return None
+
+
+def chip_health_to_annotation(meta: dict, health: dict) -> None:
+    """Serialize the backend's per-chip health map."""
+    _annotations(meta)[NODE_CHIP_HEALTH_ANNOTATION] = json.dumps(
+        dict(health), sort_keys=True)
+
+
+def annotation_to_chip_health(meta: dict) -> dict:
+    """Decode the per-chip health map; {} = everything healthy."""
+    raw = (meta.get("annotations") or {}).get(NODE_CHIP_HEALTH_ANNOTATION)
+    if not raw:
+        return {}
+    try:
+        decoded = json.loads(raw)
+    except (TypeError, ValueError):
+        return {}
+    return decoded if isinstance(decoded, dict) else {}
 
 
 def pod_info_to_annotation(meta: dict, pod_info: PodInfo) -> None:

@@ -67,6 +67,19 @@ class Counter:
             self.value += by
 
 
+class Gauge:
+    """A settable level (current node counts, queue depths)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def set(self, value) -> None:
+        with self._lock:
+            self.value = value
+
+
 # The reference's three scheduler histograms (`metrics.go:29-54`).
 E2E_SCHEDULING_LATENCY = Histogram("scheduler_e2e_scheduling_latency_microseconds")
 ALGORITHM_LATENCY = Histogram("scheduler_scheduling_algorithm_latency_microseconds")
@@ -83,6 +96,11 @@ INTERNAL_ERRORS = Counter("scheduler_internal_errors_total")
 # one-shot per process, so the counter is how a persistent native break
 # (a silent performance cliff) stays visible.
 NATIVE_FALLBACKS = Counter("allocator_native_fallbacks_total")
+# Node lifecycle (scheduler/lifecycle.py): current Ready node count, total
+# Ready->Lost transitions, and pods evicted off Lost nodes.
+NODE_READY = Gauge("scheduler_node_ready")
+NODE_LOST = Counter("scheduler_node_lost_total")
+EVICTIONS = Counter("scheduler_evictions_total")
 
 
 def reset_all() -> None:
@@ -90,8 +108,9 @@ def reset_all() -> None:
     for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY):
         h.__init__(h.name)
     for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS,
-              INTERNAL_ERRORS, NATIVE_FALLBACKS):
+              INTERNAL_ERRORS, NATIVE_FALLBACKS, NODE_LOST, EVICTIONS):
         c.__init__(c.name)
+    NODE_READY.__init__(NODE_READY.name)
 
 
 class Trace:

@@ -4,11 +4,17 @@ Reference: `crishim/pkg/kubeadvertise/advertise_device.go`. A periodic loop
 (default 20s) builds a fresh NodeInfo from the device manager, serializes
 it, and strategic-merge-patches the node object; on failure it retries on a
 tighter 5s loop until a patch lands (`advertise_device.go:63-95,130`).
+
+Every successful pass also stamps a wall-clock heartbeat and the backend's
+per-chip health map into the node annotations — the liveness/degradation
+signal the scheduler-side ``NodeLifecycle`` controller consumes.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 
 from kubegpu_tpu.core import codec
 from kubegpu_tpu.core.types import NodeInfo
@@ -16,18 +22,28 @@ from kubegpu_tpu.core.types import NodeInfo
 DEFAULT_INTERVAL_S = 20.0
 DEFAULT_RETRY_S = 5.0
 
+log = logging.getLogger(__name__)
+
 
 class DeviceAdvertiser:
     def __init__(self, client, dev_mgr, node_name: str,
-                 address: str | None = None):
+                 address: str | None = None, clock=None):
         self.client = client
         self.dev_mgr = dev_mgr
         self.node_name = node_name
         self.address = address
+        # wall clock for the cross-process heartbeat stamp; injectable so
+        # lifecycle tests can drive time deterministically
+        self.clock = clock if clock is not None else time.time
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.patch_count = 0
         self.error_count = 0
+        # healthz inputs: when did an advertise pass last land, and at
+        # what cadence should the next one have landed
+        self.last_success_monotonic: float | None = None
+        self._interval_s = DEFAULT_INTERVAL_S
+        self._retry_s = DEFAULT_RETRY_S
 
     def advertise_once(self) -> None:
         """One advertise pass (`advertise_device.go:39-61`)."""
@@ -36,16 +52,35 @@ class DeviceAdvertiser:
         self.dev_mgr.update_node_info(info)
         meta: dict = {}
         codec.node_info_to_annotation(meta, info)
+        codec.heartbeat_to_annotation(meta, self.clock())
+        health_probe = getattr(self.dev_mgr, "chip_health", None)
+        if health_probe is not None:
+            codec.chip_health_to_annotation(meta, health_probe())
         if self.address:
             meta.setdefault("annotations", {})[
                 codec.NODE_ADDRESS_ANNOTATION] = self.address
         self.client.patch_node_metadata(self.node_name, meta)
         self.patch_count += 1
+        self.last_success_monotonic = time.monotonic()
+
+    def healthy(self, now: float | None = None) -> bool:
+        """The node agent's /healthz signal: unhealthy until the first
+        advertise pass lands (startup readiness gate — an agent that has
+        never registered its inventory is not ready), and unhealthy again
+        once advertising has been failing longer than the advertise
+        interval (+ one retry period of slack)."""
+        if self.last_success_monotonic is None:
+            return False
+        now = time.monotonic() if now is None else now
+        return (now - self.last_success_monotonic) <= \
+            self._interval_s + self._retry_s
 
     def start(self, interval_s: float = DEFAULT_INTERVAL_S,
               retry_s: float = DEFAULT_RETRY_S) -> None:
         """Run the advertise loop in a daemon thread
         (`advertise_device.go:120-133`)."""
+        self._interval_s = interval_s
+        self._retry_s = retry_s
 
         def loop():
             while not self._stop.is_set():
@@ -53,7 +88,13 @@ class DeviceAdvertiser:
                     self.advertise_once()
                     wait = interval_s
                 except Exception:
+                    # the failure used to be swallowed silently; a
+                    # persistently-failing advertiser looked identical to
+                    # a healthy one from the logs
                     self.error_count += 1
+                    log.warning("advertise pass failed for node %s "
+                                "(error %d)", self.node_name,
+                                self.error_count, exc_info=True)
                     wait = retry_s
                 self._stop.wait(wait)
 

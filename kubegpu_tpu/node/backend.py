@@ -48,8 +48,23 @@ class TPUInventory:
         return None
 
 
+# Chip health states a backend may report. Anything other than HEALTHY
+# withholds the chip from the advertised allocatable inventory.
+CHIP_HEALTHY = "healthy"
+CHIP_DEGRADED = "degraded"
+CHIP_FAILED = "failed"
+
+
 class TPUBackend:
     """Abstract discovery backend (the fake seam)."""
 
     def enumerate(self) -> TPUInventory:
         raise NotImplementedError
+
+    def chip_health(self) -> dict:
+        """Per-chip health, ``{chip_id: state}``. Chips absent from the
+        map are healthy; a non-``healthy`` state shrinks the advertised
+        inventory (the node keeps serving its remaining chips instead of
+        vanishing wholesale). Backends without health telemetry inherit
+        this all-healthy default."""
+        return {}

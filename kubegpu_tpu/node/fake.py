@@ -54,15 +54,29 @@ def single_chip_inventory() -> TPUInventory:
 
 
 class FakeTPUBackend(TPUBackend):
-    """Backend returning a canned inventory; can simulate discovery failure."""
+    """Backend returning a canned inventory; can simulate discovery
+    failure and per-chip health degradation."""
 
     def __init__(self, inventory: TPUInventory | None = None, fail: bool = False):
         self.inventory = inventory if inventory is not None else v5p_host_inventory()
         self.fail = fail
         self.enumerate_calls = 0
+        self._health: dict = {}
 
     def enumerate(self) -> TPUInventory:
         self.enumerate_calls += 1
         if self.fail:
             raise RuntimeError("fake libtpu enumeration failure")
         return self.inventory
+
+    def set_chip_health(self, chip_id: str, state: str) -> None:
+        """Inject a health state for one chip (``healthy`` clears it)."""
+        from kubegpu_tpu.node.backend import CHIP_HEALTHY
+
+        if state == CHIP_HEALTHY:
+            self._health.pop(chip_id, None)
+        else:
+            self._health[chip_id] = state
+
+    def chip_health(self) -> dict:
+        return dict(self._health)

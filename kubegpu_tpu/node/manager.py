@@ -41,6 +41,7 @@ class TPUDeviceManager:
         self.name = name
         self.inventory: TPUInventory | None = None
         self.mesh: ICIMesh | None = None
+        self.health: dict = {}  # chip_id -> state (absent = healthy)
 
     def get_name(self) -> str:
         return self.name
@@ -61,6 +62,16 @@ class TPUDeviceManager:
         self.inventory = inv
         dims = inv.mesh_dims if all(inv.mesh_dims) else (1, 1, 1)
         self.mesh = ICIMesh(dims, inv.mesh_wrap)
+        try:
+            self.health = dict(self.backend.chip_health() or {})
+        except Exception:
+            # health telemetry is advisory: a broken probe must not take
+            # the whole inventory down with it
+            self.health = {}
+
+    def chip_health(self) -> dict:
+        """Last-known per-chip health, for the advertiser's annotation."""
+        return dict(self.health)
 
     def _tray_index(self, coords: tuple) -> int:
         """Linear index of the tray block containing ``coords``."""
@@ -82,7 +93,12 @@ class TPUDeviceManager:
     def update_node_info(self, node_info: NodeInfo) -> None:
         """Advertise chip inventory into a NodeInfo
         (`nvidia_gpu_manager.go:204-223`). Discovery failure advertises
-        zero chips rather than stale state."""
+        zero chips rather than stale state. A chip the backend reports
+        non-healthy stays in ``capacity`` (it physically exists) but is
+        withheld from ``allocatable`` — the node shrinks instead of
+        vanishing, and the scheduler simply stops placing onto that chip."""
+        from kubegpu_tpu.node.backend import CHIP_HEALTHY
+
         try:
             self._refresh()
         except Exception:
@@ -90,11 +106,16 @@ class TPUDeviceManager:
             node_info.allocatable[grammar.RESOURCE_NUM_CHIPS] = 0
             return
         inv = self.inventory
+        healthy = [c for c in inv.chips
+                   if self.health.get(c.chip_id, CHIP_HEALTHY) == CHIP_HEALTHY]
         node_info.capacity[grammar.RESOURCE_NUM_CHIPS] = len(inv.chips)
-        node_info.allocatable[grammar.RESOURCE_NUM_CHIPS] = len(inv.chips)
+        node_info.allocatable[grammar.RESOURCE_NUM_CHIPS] = len(healthy)
+        healthy_ids = {c.chip_id for c in healthy}
         for chip in inv.chips:
             base = self.chip_group_path(chip)
-            for res_list in (node_info.capacity, node_info.allocatable):
+            res_lists = (node_info.capacity, node_info.allocatable) \
+                if chip.chip_id in healthy_ids else (node_info.capacity,)
+            for res_list in res_lists:
                 add_group_resource(res_list, f"{base}/{grammar.CHIPS_SUFFIX}", 1)
                 add_group_resource(res_list, f"{base}/{grammar.HBM_SUFFIX}",
                                    chip.hbm_bytes)
@@ -191,6 +212,22 @@ class DevicesManager:
         for dev in self.devices:
             if self.operational.get(dev.get_name()):
                 dev.update_node_info(node_info)
+
+    def chip_health(self) -> dict:
+        """Merged per-chip health across operational devices (chip ids are
+        globally unique — they encode mesh coordinates)."""
+        out: dict = {}
+        for dev in self.devices:
+            if not self.operational.get(dev.get_name()):
+                continue
+            probe = getattr(dev, "chip_health", None)
+            if probe is None:
+                continue
+            try:
+                out.update(probe() or {})
+            except Exception:
+                continue
+        return out
 
     def allocate_devices(self, pod, container) -> tuple[list, list, dict]:
         """Aggregate allocations across plugins (`devicemanager.go:104-122`)."""

@@ -939,6 +939,12 @@ class Scheduler:
             current = self.api.get_pod(name)
         except KeyError:
             return True  # deleted while queued
+        except Exception:
+            # transient transport failure: the pod was already popped, so
+            # dropping it here would lose it forever — park it with
+            # backoff instead and let the next pass re-fetch
+            self.queue.add_unschedulable(kube_pod)
+            return True
         if (current.get("spec") or {}).get("nodeName"):
             return True  # already bound elsewhere
         kube_pod = current

@@ -1,0 +1,464 @@
+"""Node lifecycle: heartbeat liveness, Ready -> Stale -> Lost, and
+gang-aware eviction off Lost nodes.
+
+The paper's contract is that the scheduler owns placement; this module
+adds the failure half of that contract. The advertiser stamps a wall-clock
+heartbeat into the node annotations every pass (`node/advertiser.py`); the
+``NodeLifecycle`` controller ages those heartbeats:
+
+    Ready   age <  stale_after_s      normal
+    Stale   age >= stale_after_s      suspect; observational only
+    Lost    age >= lost_after_s       evict + (optionally) delete the node
+
+On Lost, every pod bound to the node is evicted. Eviction is **gang
+aware**: a running gang whose member sat on the lost node is stranded in
+its next collective, so the WHOLE gang — surviving members included — is
+failed and requeued as one unit. "Requeue" means delete-and-recreate with
+the binding, pinned allocation, process contract, and nomination stripped
+(but the gang membership kept), so the scheduler re-plans the pod-set on
+surviving nodes from intent, exactly like a fresh submission. The watch
+events from the deletions return every chip through the scheduler cache —
+zero leaked chips by construction.
+
+Nodes without a heartbeat annotation (registered out-of-band, or an older
+advertiser) are exempt: liveness is simply not tracked for them.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.core import codec
+
+log = logging.getLogger(__name__)
+
+READY = "ready"
+STALE = "stale"
+LOST = "lost"
+
+DEFAULT_STALE_AFTER_S = 40.0
+DEFAULT_LOST_AFTER_S = 120.0
+
+# API writes during eviction retry a few times in-line: the controller
+# runs exactly when the cluster is unhealthy, so a transient transport
+# error must not strand half an eviction. The pause between attempts is
+# what lets a multi-round-trip blip pass — immediate retries would all
+# land inside the same outage.
+_EVICT_ATTEMPTS = 3
+_EVICT_BACKOFF_S = 0.05
+
+
+def requeued_copy(kube_pod: dict) -> dict:
+    """A fresh pending copy of a bound pod: binding, status, pinned
+    allocation, gang process contract, and nominated-node reservation all
+    stripped; device intent (including gang membership) kept."""
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.gang import GANG_PROCESS_ANNOTATION
+
+    fresh = copy.deepcopy(kube_pod)
+    (fresh.setdefault("spec", {})).pop("nodeName", None)
+    fresh.pop("status", None)
+    meta = fresh.setdefault("metadata", {})
+    ann = dict(meta.get("annotations") or {})
+    ann.pop(GANG_PROCESS_ANNOTATION, None)
+    ann.pop(Scheduler.NOMINATED_NODE_ANNOTATION, None)
+    meta["annotations"] = ann
+    if codec.POD_ANNOTATION_KEY in ann:
+        # invalidate: allocate_from cleared, dev_requests reset to the
+        # annotation-specified requests, node pin dropped — the scheduler
+        # re-plans from intent (`codec.kube_pod_to_pod_info` semantics)
+        info = codec.kube_pod_to_pod_info(fresh, invalidate_existing=True)
+        codec.pod_info_to_annotation(meta, info)
+    return fresh
+
+
+class NodeLifecycle:
+    """Scheduler-side controller tracking node liveness from heartbeats.
+
+    Talks only to the API server (any client with the
+    ``InMemoryAPIServer`` surface — in-memory, HTTP, or chaos-proxied);
+    the scheduler observes the resulting node/pod events through its
+    ordinary informer and needs no direct coupling.
+    """
+
+    def __init__(self, api, stale_after_s: float = DEFAULT_STALE_AFTER_S,
+                 lost_after_s: float = DEFAULT_LOST_AFTER_S,
+                 delete_lost_nodes: bool = True, clock=None):
+        self.api = api
+        self.stale_after_s = stale_after_s
+        self.lost_after_s = max(lost_after_s, stale_after_s)
+        # Deleting the node object is what actually stops new placements
+        # onto it (the scheduler cache drops it on the watch event); a
+        # returning agent re-registers via --register-node. False keeps
+        # the node listed (and re-evicts anything that lands there).
+        self.delete_lost_nodes = delete_lost_nodes
+        self.clock = clock if clock is not None else time.time
+        self.states: dict = {}   # node name -> READY/STALE/LOST
+        # Heartbeat observations: node -> (last heartbeat VALUE, when
+        # this controller first saw that value, by its own clock). Aging
+        # the local observation instead of comparing wall clocks makes
+        # liveness immune to cross-host clock skew — a node whose clock
+        # runs minutes behind still changes its stamp every pass, and
+        # that change is what proves it alive. Corollary: a fresh
+        # controller must observe a heartbeat stand still for the full
+        # grace period before declaring the node Lost (no mass eviction
+        # on scheduler restart).
+        self._observed: dict = {}
+        # Lost nodes whose eviction has not finished draining. A deleted
+        # node disappears from list_nodes, so without this set a single
+        # failed pod-list during its one LOST tick would strand its pods
+        # bound to a nonexistent node forever.
+        self._draining: set = set()
+        # Evicted pods deleted from the API but whose replacement create
+        # failed: the fresh copy lives only here, so it is retried every
+        # tick until it lands (deleting it again can't bring it back).
+        self._pending_requeue: dict = {}
+        # Victims whose delete failed: pod name -> lost node. A gang
+        # member widened in from a SURVIVING node is invisible to both
+        # the per-lost-node drain listing and the orphan sweep (its node
+        # still exists), so failed evictions are retried by name here.
+        self._pending_evict: dict = {}
+        # Sweep gating: orphans can only appear around node loss, so the
+        # full-cluster sweep runs while loss activity is recent (plus a
+        # periodic backstop) instead of on every steady-state tick.
+        self._ticks = 0
+        self._sweep_hot = 1  # sweep on the first tick (fresh controller)
+        self.evicted_total = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- one pass ----------------------------------------------------------
+
+    def tick(self, now: float | None = None) -> dict:
+        """One liveness pass. Returns {"states": {node: state},
+        "evicted": [pod names]} for tests and the chaos scenario."""
+        now = self.clock() if now is None else now
+        try:
+            nodes = self.api.list_nodes()
+        except Exception:
+            log.warning("node lifecycle tick: node list failed",
+                        exc_info=True)
+            # the by-name flushes target pods directly and need no node
+            # listing — an already-deleted pod's replacement must not
+            # wait out extra ticks because an unrelated call dropped
+            evicted = self._flush_pending_evicts()
+            evicted.extend(self._flush_pending_requeues())
+            return {"states": dict(self.states), "evicted": evicted}
+        states: dict = {}
+        evicted: list = []
+        for node in nodes:
+            name = (node.get("metadata") or {}).get("name")
+            if not name:
+                continue
+            heartbeat = codec.annotation_to_heartbeat(
+                node.get("metadata") or {})
+            if heartbeat is None:
+                states[name] = READY  # liveness not tracked for this node
+                self._observed.pop(name, None)
+                continue
+            seen = self._observed.get(name)
+            if seen is None or seen[0] != heartbeat:
+                self._observed[name] = (heartbeat, now)
+                age = 0.0
+            else:
+                age = now - seen[1]
+            if age >= self.lost_after_s:
+                state = LOST
+            elif age >= self.stale_after_s:
+                state = STALE
+            else:
+                state = READY
+            states[name] = state
+            prev = self.states.get(name)
+            if state != prev:
+                log.info("node %s: %s -> %s (heartbeat age %.1fs)",
+                         name, prev or "new", state, age)
+            if state == LOST:
+                self._sweep_hot = 3  # binds race node deletion around loss
+                if prev != LOST:
+                    metrics.NODE_LOST.inc()
+                    self._event(name, "NodeLost",
+                                f"no heartbeat for {age:.1f}s "
+                                f"(grace {self.lost_after_s:.1f}s)")
+                # Delete the node BEFORE requeueing its pods: the watch
+                # event drops it from the scheduler cache, so a requeued
+                # gang can never be re-planned onto the dead node's chips
+                # in the window between eviction and deletion. Then evict
+                # on EVERY lost tick, not just the transition: with
+                # delete_lost_nodes=False a pod could still bind here
+                # between ticks (cheap once drained — the listing is empty).
+                if self.delete_lost_nodes:
+                    self._delete_node(name)
+                done, drained = self._evict_node(name)
+                evicted.extend(done)
+                if drained:
+                    self._draining.discard(name)
+                else:
+                    self._draining.add(name)
+            elif state == READY:
+                if prev in (STALE, LOST):
+                    self._event(name, "NodeReady",
+                                "heartbeat resumed; node is Ready again")
+                # a re-registered node owns its pods again; stop draining
+                self._draining.discard(name)
+        # Deleted Lost nodes no longer appear in list_nodes, so their
+        # eviction retries from here until the pod listing comes back
+        # clean — a transient failure during the LOST tick must not be
+        # the only chance those pods ever get.
+        for name in sorted(self._draining - set(states)):
+            done, drained = self._evict_node(name)
+            evicted.extend(done)
+            if drained:
+                self._draining.discard(name)
+        evicted.extend(self._flush_pending_evicts())
+        if (self._sweep_hot > 0 or self._draining or self._pending_evict
+                or self._pending_requeue or self._ticks % 10 == 0):
+            self._sweep_hot = max(0, self._sweep_hot - 1)
+            evicted.extend(self._sweep_orphans(set(states)))
+        evicted.extend(self._flush_pending_requeues())
+        self._ticks += 1
+        self._observed = {k: v for k, v in self._observed.items()
+                          if k in states}
+        self.states = states
+        metrics.NODE_READY.set(
+            sum(1 for s in states.values() if s == READY))
+        return {"states": states, "evicted": evicted}
+
+    # ---- eviction ----------------------------------------------------------
+
+    def _evict_node(self, node_name: str) -> tuple:
+        """Evict every pod bound to ``node_name``. Returns
+        ``(evicted pod names, drained)`` — drained=False means a listing
+        or eviction failed and the caller must retry next tick."""
+        try:
+            bound = self.api.list_pods(node_name=node_name)
+        except Exception:
+            log.warning("eviction: pod list for %s failed", node_name,
+                        exc_info=True)
+            return [], False
+        return self._evict_victims(
+            {p["metadata"]["name"]: p for p in bound}, node_name)
+
+    def _sweep_orphans(self, known_nodes: set) -> list:
+        """Evict pods bound to nodes that no longer exist: a bind racing
+        the node deletion can land AFTER the lost node drained (the bind
+        subresource does not re-check node existence, same as upstream),
+        and nothing else would ever reclaim such a pod."""
+        try:
+            pods = self.api.list_pods()
+            # Re-list nodes NOW: eviction retries above can burn hundreds
+            # of ms, and a node registered (plus a pod bound to it) since
+            # the tick's snapshot must not read as an orphan.
+            known_nodes = known_nodes | {
+                (n.get("metadata") or {}).get("name")
+                for n in self.api.list_nodes()}
+        except Exception:
+            return []
+        orphans: dict = {}
+        for pod in pods:
+            node = (pod.get("spec") or {}).get("nodeName")
+            if node and node not in known_nodes:
+                orphans.setdefault(node, {})[pod["metadata"]["name"]] = pod
+        evicted = []
+        for node in sorted(orphans):
+            log.warning("orphan sweep: %d pod(s) bound to nonexistent "
+                        "node %s", len(orphans[node]), node)
+            done, _ = self._evict_victims(orphans[node], node)
+            evicted.extend(done)
+        return evicted
+
+    def _evict_victims(self, victims: dict, lost_node: str) -> tuple:
+        """Evict + requeue a victim set, widened to whole gangs: a gang
+        with one member on a lost node is dead everywhere."""
+        from kubegpu_tpu.scheduler.gang import gang_key
+
+        gang_ids = set()
+        for pod in victims.values():
+            key = gang_key(pod)
+            if key is not None:
+                gang_ids.add(key[0])
+        if gang_ids:
+            try:
+                everything = self.api.list_pods()
+            except Exception:
+                log.warning("eviction: cluster pod list failed "
+                            "(gang widening for %s)", lost_node,
+                            exc_info=True)
+                return [], False
+            for pod in everything:
+                if not (pod.get("spec") or {}).get("nodeName"):
+                    continue  # pending members just stay queued
+                key = gang_key(pod)
+                if key is not None and key[0] in gang_ids:
+                    victims.setdefault(pod["metadata"]["name"], pod)
+        evicted = []
+        drained = True
+        for name in sorted(victims):
+            if self._evict_and_requeue(victims[name], lost_node):
+                evicted.append(name)
+                metrics.EVICTIONS.inc()
+                self.evicted_total += 1
+                self._pending_evict.pop(name, None)
+            else:
+                drained = False
+                if name not in self._pending_requeue:
+                    # delete failed, pod still bound: the drain listing
+                    # only re-covers the LOST node, so a widened gang
+                    # member on a surviving node must be retried by name
+                    self._pending_evict[name] = lost_node
+        return evicted, drained
+
+    def _evict_and_requeue(self, kube_pod: dict, lost_node: str) -> bool:
+        name = kube_pod["metadata"]["name"]
+        fresh = requeued_copy(kube_pod)
+        ambiguous = False  # a failed delete may still have landed
+        for attempt in range(_EVICT_ATTEMPTS):
+            try:
+                self.api.delete_pod(name)
+                break
+            except KeyError:
+                if not ambiguous:
+                    # gone before we ever touched it: deleted externally
+                    # (user tore the job down) — resurrecting it as a
+                    # pending copy is not this controller's call
+                    return True
+                break  # our own errored delete actually landed
+            except Exception:
+                ambiguous = True
+                # interruptible: stop() must not wait out a wide outage's
+                # worth of per-pod backoffs (unset event == plain sleep)
+                self._stop.wait(_EVICT_BACKOFF_S * (attempt + 1))
+        else:
+            log.warning("eviction: could not delete pod %s; retrying "
+                        "next tick", name)
+            return False
+        # only now is the pod actually off the API — an event stamped
+        # earlier (or re-stamped per retry tick) would report evictions
+        # that never happened
+        self._event(name, "Evicted",
+                    f"node {lost_node} lost; requeued for rescheduling",
+                    kind="Pod", event_type="Warning")
+        if self._create_requeued(name, fresh):
+            return True
+        # the pod is deleted and its replacement exists only in memory
+        # now: park it for per-tick retry rather than dropping it
+        self._pending_requeue[name] = fresh
+        log.warning("eviction: pod %s deleted but re-create failed; "
+                    "parked for retry", name)
+        return False
+
+    def _create_requeued(self, name: str, fresh: dict) -> bool:
+        from kubegpu_tpu.cluster.apiserver import Conflict
+
+        for attempt in range(_EVICT_ATTEMPTS):
+            try:
+                self.api.create_pod(fresh)
+                return True
+            except Conflict:
+                return True  # a duplicate/earlier create already landed
+            except Exception:
+                # interruptible: stop() must not wait out a wide outage's
+                # worth of per-pod backoffs (unset event == plain sleep)
+                self._stop.wait(_EVICT_BACKOFF_S * (attempt + 1))
+        return False
+
+    def _flush_pending_evicts(self) -> list:
+        """Retry victims whose delete failed. The per-node drain listing
+        only re-covers the LOST node, so a gang member widened in from a
+        surviving node (whose own node never drains) lands here."""
+        landed = []
+        for name in sorted(self._pending_evict):
+            lost_node = self._pending_evict[name]
+            try:
+                pod = self.api.get_pod(name)
+            except KeyError:
+                self._pending_evict.pop(name, None)  # already gone
+                continue
+            except Exception:
+                continue  # API unreachable; retry next tick
+            if not (pod.get("spec") or {}).get("nodeName"):
+                self._pending_evict.pop(name, None)  # already pending
+                continue
+            if self._evict_and_requeue(pod, lost_node):
+                landed.append(name)
+                metrics.EVICTIONS.inc()
+                self.evicted_total += 1
+                self._pending_evict.pop(name, None)
+            elif name in self._pending_requeue:
+                # the delete landed this time; the requeue path owns it now
+                self._pending_evict.pop(name, None)
+        return landed
+
+    def _flush_pending_requeues(self) -> list:
+        """Retry replacement creates whose pods are already deleted —
+        the one eviction state that cannot be recomputed from the API."""
+        landed = []
+        for name in sorted(self._pending_requeue):
+            if self._create_requeued(name, self._pending_requeue[name]):
+                landed.append(name)
+                metrics.EVICTIONS.inc()
+                self.evicted_total += 1
+        for name in landed:
+            self._pending_requeue.pop(name, None)
+        return landed
+
+    def _delete_node(self, name: str) -> None:
+        for attempt in range(_EVICT_ATTEMPTS):
+            try:
+                self.api.delete_node(name)
+                return
+            except KeyError:
+                return
+            except Exception:
+                # interruptible: stop() must not wait out a wide outage's
+                # worth of per-pod backoffs (unset event == plain sleep)
+                self._stop.wait(_EVICT_BACKOFF_S * (attempt + 1))
+        log.warning("could not delete lost node %s; will retry next tick",
+                    name)
+
+    def _event(self, name: str, reason: str, message: str,
+               kind: str = "Node", event_type: str = "Warning") -> None:
+        record = getattr(self.api, "record_event", None)
+        if record is None:
+            return
+        try:
+            record(kind, name, event_type, reason, message)
+        except Exception:
+            pass  # observability only
+
+    # ---- loop --------------------------------------------------------------
+
+    def start(self, interval_s: float | None = None) -> None:
+        interval = interval_s if interval_s is not None \
+            else max(0.05, self.stale_after_s / 2.0)
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.tick()
+                except Exception:
+                    log.exception("node lifecycle tick failed")
+                self._stop.wait(interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="node-lifecycle")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        # Last-chance drain: a pod in _pending_requeue is already deleted
+        # from the API and its replacement exists only in this process —
+        # the one eviction state that cannot be recomputed. Dropping it
+        # on demotion/shutdown would lose the workload silently.
+        if self._pending_requeue:
+            self._flush_pending_requeues()
+        for name in sorted(self._pending_requeue):
+            log.error("stopping with evicted pod %s not requeued — its "
+                      "replacement create kept failing; workload intent "
+                      "is lost with this process", name)

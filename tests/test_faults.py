@@ -4,7 +4,14 @@ exercised deliberately (the reference has no fault-injection framework,
 SURVEY.md §6; this suite is the TPU build's addition).
 """
 
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
 from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
 from kubegpu_tpu.core import codec, grammar
 from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
 
@@ -139,6 +146,129 @@ def test_backend_discovery_failure_zeroes_then_recovers():
     broken["yes"] = False
     adv.advertise_once()  # node event also wakes the unschedulable pod
     assert drive_until_bound(api, sched, "p1")
+
+
+def test_http_client_retries_idempotent_verbs_only(monkeypatch):
+    """Transient transport failures (resets, refused connections) retry
+    on idempotent verbs with backoff; POSTs stay single-shot so a
+    bind/create can never double-apply from a blind resend."""
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    try:
+        api.create_node({"metadata": {"name": "n1"}})
+        real = urllib.request.urlopen
+        calls = {"n": 0, "fail_next": 2}
+
+        def flaky(req, timeout=None):
+            calls["n"] += 1
+            if calls["fail_next"] > 0:
+                calls["fail_next"] -= 1
+                raise ConnectionResetError("injected reset")
+            return real(req, timeout=timeout)
+
+        monkeypatch.setattr(urllib.request, "urlopen", flaky)
+        # GET survives two resets without the caller seeing anything
+        assert client.get_node("n1")["metadata"]["name"] == "n1"
+        assert client.retry_count == 2
+        # POST: exactly one attempt, the failure surfaces
+        calls["n"], calls["fail_next"] = 0, 10**6
+        with pytest.raises(OSError):
+            client.create_pod({"metadata": {"name": "px"}})
+        assert calls["n"] == 1
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_watch_survives_transient_transport_failure(monkeypatch):
+    """A failing watch long-poll must not kill the informer thread: it
+    backs off, resumes from the last seen sequence number, and delivers
+    later events exactly once."""
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    events = []
+    try:
+        client.add_watcher(
+            lambda kind, event, obj: events.append(
+                (kind, event, obj["metadata"]["name"])))
+        api.create_node({"metadata": {"name": "n1"}})
+
+        def wait_for(item, deadline_s):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if item in events:
+                    return True
+                time.sleep(0.01)
+            return False
+
+        assert wait_for(("node", "added", "n1"), 5.0)
+        # break the transport: enough consecutive failures to exhaust
+        # _req's in-call retries AND fail whole polls (watch-loop layer)
+        real = urllib.request.urlopen
+        state = {"fail_next": 8}
+
+        def flaky(req, timeout=None):
+            if state["fail_next"] > 0:
+                state["fail_next"] -= 1
+                raise urllib.error.URLError("injected transport failure")
+            return real(req, timeout=timeout)
+
+        monkeypatch.setattr(urllib.request, "urlopen", flaky)
+        # flush the long-poll already in flight (it predates the fault
+        # window and would deliver the next event over the REAL socket)
+        api.create_node({"metadata": {"name": "flush"}})
+        assert wait_for(("node", "added", "flush"), 5.0)
+        deadline = time.monotonic() + 10.0
+        while client.watch_errors < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert client.watch_errors >= 1  # whole polls actually failed
+        api.create_node({"metadata": {"name": "n2"}})  # mid-outage event
+        assert wait_for(("node", "added", "n2"), 15.0)
+        assert events.count(("node", "added", "n2")) == 1  # no replay
+        assert events.count(("node", "added", "n1")) == 1
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_lease_failover_standby_resumes_backlog():
+    """Leader failover over the real lease route: the standby acquires
+    the lease once the dead holder's TTL lapses, builds its engine, and
+    drains the backlog that piled up meanwhile (the scheduler_main.py
+    promotion path)."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    server, url = serve_api(api)
+    holder_a = HTTPAPIClient(url)
+    standby = HTTPAPIClient(url)
+    sched = None
+    try:
+        assert holder_a.acquire_lease("kgtpu-scheduler", "holder-a", 0.2)
+        assert not standby.acquire_lease("kgtpu-scheduler", "holder-b", 0.2)
+        # holder-a dies (never renews); pods keep arriving
+        api.create_pod(tpu_pod("p1", 2))
+        api.create_pod(tpu_pod("p2", 2))
+        deadline = time.monotonic() + 5.0
+        promoted = False
+        while time.monotonic() < deadline:
+            if standby.acquire_lease("kgtpu-scheduler", "holder-b", 0.2):
+                promoted = True
+                break
+            time.sleep(0.05)
+        assert promoted  # TTL lapsed, the standby took the lease
+        sched = make_scheduler(standby)  # promotion builds the engine
+        assert drive_until_bound(api, sched, "p1")
+        assert drive_until_bound(api, sched, "p2")
+        assert len(set(allocated_chips(api, "p1") +
+                       allocated_chips(api, "p2"))) == 4
+    finally:
+        if sched is not None:
+            sched.stop()
+        holder_a.close()
+        standby.close()
+        server.shutdown()
 
 
 def test_node_vanishes_mid_pass():

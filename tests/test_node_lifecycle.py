@@ -1,0 +1,411 @@
+"""Node lifecycle: heartbeat liveness, chip-health degradation,
+Ready/Stale/Lost transitions, gang-aware eviction, and the seeded chaos
+scenario (ISSUE 1 acceptance: a killed node agent's 2-node gang rebinds
+entirely on surviving nodes with zero leaked chips, deterministically).
+"""
+
+import time
+
+import pytest
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.cluster.chaos import ChaosConfig, ChaosNetwork
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+from kubegpu_tpu.node.backend import CHIP_DEGRADED
+from kubegpu_tpu.node.fake import FakeTPUBackend, v5p_host_inventory
+from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+from kubegpu_tpu.scheduler.core import Scheduler
+from kubegpu_tpu.scheduler.gang import (GANG_PROCESS_ANNOTATION,
+                                        RESOURCE_GANG, RESOURCE_GANG_SIZE)
+from kubegpu_tpu.scheduler.lifecycle import (LOST, READY, STALE,
+                                             NodeLifecycle, requeued_copy)
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+from tests.test_faults import allocated_chips, drive_until_bound
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+
+
+def _mesh_host(api, name, origin, clock=None, mesh_dims=(4, 4, 1)):
+    """Create + advertise one fake v5p host; returns (advertiser, backend)."""
+    api.create_node({"metadata": {"name": name},
+                     "status": {"allocatable": {"cpu": "64", "pods": 100}}})
+    backend = FakeTPUBackend(
+        v5p_host_inventory(host_origin=origin, mesh_dims=mesh_dims))
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(backend))
+    mgr.start()
+    adv = DeviceAdvertiser(api, mgr, name, clock=clock)
+    adv.advertise_once()
+    return adv, backend
+
+
+def gang_pod(name, chips, gang, size):
+    return tpu_pod(name, chips,
+                   pod_requests={RESOURCE_GANG: gang,
+                                 RESOURCE_GANG_SIZE: size})
+
+
+# ---- codecs -----------------------------------------------------------------
+
+
+def test_heartbeat_and_chip_health_codec_roundtrip():
+    meta = {}
+    codec.heartbeat_to_annotation(meta, 1234.5678)
+    assert codec.annotation_to_heartbeat(meta) == pytest.approx(1234.568)
+    codec.chip_health_to_annotation(meta, {"0.0.0": "degraded"})
+    assert codec.annotation_to_chip_health(meta) == {"0.0.0": "degraded"}
+    # absent / garbage never raise
+    assert codec.annotation_to_heartbeat({}) is None
+    assert codec.annotation_to_chip_health({}) == {}
+    bad = {"annotations": {codec.NODE_HEARTBEAT_ANNOTATION: "nope",
+                           codec.NODE_CHIP_HEALTH_ANNOTATION: "[broken"}}
+    assert codec.annotation_to_heartbeat(bad) is None
+    assert codec.annotation_to_chip_health(bad) == {}
+
+
+def test_advertiser_stamps_heartbeat_and_health():
+    api = InMemoryAPIServer()
+    adv, backend = _mesh_host(api, "host0", (0, 0, 0),
+                              clock=lambda: 777.0)
+    meta = api.get_node("host0")["metadata"]
+    assert codec.annotation_to_heartbeat(meta) == 777.0
+    assert codec.annotation_to_chip_health(meta) == {}
+    backend.set_chip_health("1.0.0", CHIP_DEGRADED)
+    adv.advertise_once()
+    meta = api.get_node("host0")["metadata"]
+    assert codec.annotation_to_chip_health(meta) == {"1.0.0": "degraded"}
+
+
+# ---- chip-health degradation ------------------------------------------------
+
+
+def test_degraded_chip_shrinks_inventory_then_recovers():
+    """A degraded chip is withheld from allocatable (capacity keeps it):
+    the node shrinks instead of vanishing, and re-grows on recovery."""
+    api = InMemoryAPIServer()
+    adv, backend = _mesh_host(api, "host0", (0, 0, 0),
+                              mesh_dims=(2, 2, 1))
+    backend.set_chip_health("0.0.0", CHIP_DEGRADED)
+    adv.advertise_once()
+    node_ex = codec.annotation_to_node_info(api.get_node("host0")["metadata"])
+    assert node_ex.capacity[grammar.RESOURCE_NUM_CHIPS] == 4
+    assert node_ex.allocatable[grammar.RESOURCE_NUM_CHIPS] == 3
+    sched = make_scheduler(api)
+    try:
+        api.create_pod(tpu_pod("wants4", 4))
+        sched.run_until_idle()
+        assert not api.get_pod("wants4")["spec"].get("nodeName")
+        api.create_pod(tpu_pod("wants3", 3))
+        assert drive_until_bound(api, sched, "wants3")
+        # the degraded chip must not be among the allocated ones
+        assert "0.0.0" not in allocated_chips(api, "wants3")
+        # recovery: the chip heals, the node re-grows, wants4 still can't
+        # fit (wants3 holds 3 chips) but a fresh 1-chip pod can take the
+        # healed chip
+        backend.set_chip_health("0.0.0", "healthy")
+        adv.advertise_once()
+        node_ex = codec.annotation_to_node_info(
+            api.get_node("host0")["metadata"])
+        assert node_ex.allocatable[grammar.RESOURCE_NUM_CHIPS] == 4
+        api.create_pod(tpu_pod("wants1", 1))
+        assert drive_until_bound(api, sched, "wants1")
+        assert allocated_chips(api, "wants1") == ["0.0.0"]
+    finally:
+        sched.stop()
+
+
+# ---- Ready / Stale / Lost ---------------------------------------------------
+
+
+def test_lifecycle_transitions_and_no_heartbeat_exemption():
+    clock = {"now": 1000.0}
+    api = InMemoryAPIServer()
+    _mesh_host(api, "hb", (0, 0, 0), clock=lambda: clock["now"])
+    api.create_node(flat_tpu_node("legacy"))  # no heartbeat: exempt
+    metrics.reset_all()
+    lc = NodeLifecycle(api, stale_after_s=30.0, lost_after_s=90.0,
+                       clock=lambda: clock["now"])
+    assert lc.tick()["states"] == {"hb": READY, "legacy": READY}
+    assert metrics.NODE_READY.value == 2
+    clock["now"] = 1040.0
+    assert lc.tick()["states"] == {"hb": STALE, "legacy": READY}
+    assert metrics.NODE_LOST.value == 0
+    clock["now"] = 1095.0
+    out = lc.tick()
+    assert out["states"] == {"hb": LOST, "legacy": READY}
+    assert metrics.NODE_LOST.value == 1
+    # the lost node was deleted; the exempt node survives forever
+    assert [n["metadata"]["name"] for n in api.list_nodes()] == ["legacy"]
+    clock["now"] = 9999.0
+    assert lc.tick()["states"] == {"legacy": READY}
+
+
+def test_lost_node_evicts_solo_pod_and_it_rebinds_elsewhere():
+    clock = {"now": 1000.0}
+    api = InMemoryAPIServer()
+    advs = {}
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0)]):
+        advs[f"host{i}"], _ = _mesh_host(api, f"host{i}", origin,
+                                         clock=lambda: clock["now"])
+    sched = make_scheduler(api)
+    try:
+        api.create_pod(tpu_pod("p1", 2))
+        assert drive_until_bound(api, sched, "p1")
+        victim = api.get_pod("p1")["spec"]["nodeName"]
+        survivor = next(n for n in advs if n != victim)
+        lc = NodeLifecycle(api, stale_after_s=2.0, lost_after_s=5.0,
+                           clock=lambda: clock["now"])
+        lc.tick()  # liveness ages from OBSERVED heartbeat change
+        clock["now"] = 1010.0
+        advs[survivor].advertise_once()  # survivor stays fresh
+        out = lc.tick()
+        assert out["states"][victim] == LOST
+        assert out["evicted"] == ["p1"]
+        assert metrics.EVICTIONS.value >= 1
+        assert drive_until_bound(api, sched, "p1")
+        assert api.get_pod("p1")["spec"]["nodeName"] == survivor
+        assert len(allocated_chips(api, "p1")) == 2
+    finally:
+        sched.stop()
+
+
+def test_clock_skew_does_not_mark_live_node_lost():
+    """Liveness ages the controller's OBSERVATION of heartbeat change,
+    not the node's wall clock: a node whose clock runs minutes behind
+    still proves itself alive by changing its stamp every pass."""
+    sched_clock = {"now": 1000.0}
+    api = InMemoryAPIServer()
+    # the node's clock is 300s behind the scheduler's
+    adv, _ = _mesh_host(api, "slow-clock", (0, 0, 0),
+                        clock=lambda: sched_clock["now"] - 300.0)
+    lc = NodeLifecycle(api, stale_after_s=30.0, lost_after_s=90.0,
+                       clock=lambda: sched_clock["now"])
+    for _ in range(5):
+        assert lc.tick()["states"] == {"slow-clock": READY}
+        sched_clock["now"] += 20.0
+        adv.advertise_once()  # stamp changes each pass: alive
+    # once the stamps stop changing the node ages out normally
+    assert lc.tick()["states"] == {"slow-clock": READY}  # observe last stamp
+    sched_clock["now"] += 95.0
+    assert lc.tick()["states"] == {"slow-clock": LOST}
+
+
+def test_orphan_sweep_reclaims_pod_bound_to_missing_node():
+    """A bind that lands after its node was deleted (bind does not
+    re-check node existence) is caught by the per-tick orphan sweep."""
+    api = InMemoryAPIServer()
+    _mesh_host(api, "host0", (0, 0, 0), clock=lambda: 1000.0)
+    api.create_pod(tpu_pod("stray", 1))
+    api.bind_pod("stray", "ghost-node")  # no such node object
+    lc = NodeLifecycle(api, stale_after_s=2.0, lost_after_s=5.0,
+                       clock=lambda: 1000.0)
+    out = lc.tick()
+    assert out["evicted"] == ["stray"]
+    assert not api.get_pod("stray")["spec"].get("nodeName")  # pending again
+
+
+def test_advertiser_healthy_gates_on_first_success():
+    api = InMemoryAPIServer()
+    backend = FakeTPUBackend(v5p_host_inventory())
+    mgr = DevicesManager()
+    mgr.add_device(TPUDeviceManager(backend))
+    mgr.start()
+    adv = DeviceAdvertiser(api, mgr, "nowhere")  # node object absent
+    assert not adv.healthy()  # never succeeded: not ready
+    api.create_node({"metadata": {"name": "nowhere"},
+                     "status": {"allocatable": {"cpu": "8", "pods": 10}}})
+    adv.advertise_once()
+    assert adv.healthy()
+    # a long silence after the last success turns it unhealthy again
+    assert not adv.healthy(now=adv.last_success_monotonic + 10_000.0)
+
+
+def test_requeued_copy_strips_binding_and_keeps_gang_intent():
+    pod = gang_pod("g-0", 4, gang=9, size=2)
+    pod["spec"]["nodeName"] = "host0"
+    pod["status"] = {"phase": "Scheduled"}
+    pod["metadata"]["annotations"][GANG_PROCESS_ANNOTATION] = "{}"
+    pod["metadata"]["annotations"][
+        Scheduler.NOMINATED_NODE_ANNOTATION] = "host0"
+    fresh = requeued_copy(pod)
+    assert "nodeName" not in fresh["spec"]
+    assert "status" not in fresh
+    ann = fresh["metadata"]["annotations"]
+    assert GANG_PROCESS_ANNOTATION not in ann
+    assert Scheduler.NOMINATED_NODE_ANNOTATION not in ann
+    info = codec.kube_pod_to_pod_info(fresh, invalidate_existing=False)
+    assert int(info.requests[RESOURCE_GANG]) == 9
+    assert int(info.requests[RESOURCE_GANG_SIZE]) == 2
+    assert not info.node_name
+    for cont in info.running_containers.values():
+        assert not cont.allocate_from
+
+
+class _TargetedFlakyDelete:
+    """Delegate to a real API, failing the first ``fail_n`` delete_pod
+    calls for one specific pod name."""
+
+    def __init__(self, api, pod_name, fail_n=3):
+        self._api = api
+        self._pod = pod_name
+        self._left = fail_n
+
+    def __getattr__(self, name):
+        real = getattr(self._api, name)
+        if name != "delete_pod":
+            return real
+
+        def wrapper(pname, *a, **kw):
+            if pname == self._pod and self._left > 0:
+                self._left -= 1
+                raise ConnectionError("injected delete failure")
+            return real(pname, *a, **kw)
+        return wrapper
+
+
+def test_widened_gang_member_delete_failure_is_retried_by_name():
+    """A gang member on a SURVIVING node whose delete keeps failing
+    during the lost tick must be parked and retried by name: the
+    per-node drain only re-lists the lost node (already empty once the
+    lost-node member evicted), and the orphan sweep skips it because its
+    node still exists — without the by-name retry it would stay bound
+    forever, leaking its chips and deadlocking the requeued gang."""
+    clock = {"now": 1000.0}
+    api = InMemoryAPIServer()
+    advs = {}
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0)]):
+        # 4 chips per host: each 4-chip member needs a full host, so the
+        # gang is forced to spread across both
+        advs[f"host{i}"], _ = _mesh_host(api, f"host{i}", origin,
+                                         clock=lambda: clock["now"],
+                                         mesh_dims=(2, 2, 1))
+    sched = make_scheduler(api)
+    try:
+        for name in ("g-0", "g-1"):
+            api.create_pod(gang_pod(name, 4, gang=3, size=2))
+        assert drive_until_bound(api, sched, "g-0")
+        assert drive_until_bound(api, sched, "g-1")
+        victim = api.get_pod("g-0")["spec"]["nodeName"]
+        assert api.get_pod("g-1")["spec"]["nodeName"] != victim
+        # 6 = 3 in-tick eviction attempts + 3 same-tick flush retries:
+        # g-1 must stay stranded past the whole LOST tick
+        flaky = _TargetedFlakyDelete(api, "g-1", fail_n=6)
+        lc = NodeLifecycle(flaky, stale_after_s=2.0, lost_after_s=5.0,
+                           clock=lambda: clock["now"])
+        lc.tick()
+        clock["now"] = 1010.0
+        for node, adv in advs.items():
+            if node != victim:
+                adv.advertise_once()
+        out = lc.tick()  # g-0 evicts; g-1's delete exhausts its attempts
+        assert out["states"][victim] == LOST
+        assert out["evicted"] == ["g-0"]
+        assert api.get_pod("g-1")["spec"].get("nodeName")  # still stranded
+        out2 = lc.tick()  # retried by name, not via the (empty) drain
+        assert "g-1" in out2["evicted"]
+        assert not api.get_pod("g-1")["spec"].get("nodeName")
+        assert not api.get_pod("g-0")["spec"].get("nodeName")
+    finally:
+        sched.stop()
+
+
+# ---- the acceptance scenario: gang loss under chaos -------------------------
+
+
+def _run_gang_chaos_once(seed):
+    """One deterministic pass: place a 2-node gang on 4 hosts, kill the
+    agent of the node holding rank 0 (its heartbeat stops), tick the
+    lifecycle, and drive rescheduling under a seeded chaos transport.
+    Returns (first placement, final placement, recovery seconds)."""
+    clock = {"now": 1000.0}
+    api = InMemoryAPIServer()
+    net = ChaosNetwork(seed=seed)
+    advs = {}
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0),
+                                (0, 2, 0), (2, 2, 0)]):
+        advs[f"host{i}"], _ = _mesh_host(api, f"host{i}", origin,
+                                         clock=lambda: clock["now"])
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    # chaos on the scheduler's write path: every one of these verbs'
+    # failure modes requeues cleanly (tests/test_faults.py), so the drops
+    # exercise real retry machinery without losing pods
+    sched_api = net.proxy(api, "scheduler", ChaosConfig(
+        drop=0.2, verbs={"bind_many", "bind_pod",
+                         "update_pod_annotations", "record_event"}))
+    sched = Scheduler(sched_api, ds)
+    names = ["g-0", "g-1"]
+
+    def drive(forbidden=None, rounds=60):
+        for _ in range(rounds):
+            try:
+                sched.run_until_idle()
+            except ConnectionError:
+                pass  # a dropped call surfaced; state is consistent
+            bound = {}
+            for name in names:
+                node = api.get_pod(name)["spec"].get("nodeName")
+                if node and (forbidden is None or node != forbidden):
+                    bound[name] = node
+            if len(bound) == len(names):
+                return bound
+            sched.queue.move_all_to_active()  # skip backoff waits
+        raise AssertionError(
+            f"gang failed to (re)bind; faults={net.faults}")
+
+    try:
+        for i, name in enumerate(names):
+            api.create_pod(gang_pod(name, 4, gang=5, size=2))
+        first = drive()
+        victim = first["g-0"]
+        # the controller observes everyone's heartbeat, then the victim's
+        # agent dies: its heartbeat freezes at t=1000 while the survivors
+        # keep advertising
+        lc = NodeLifecycle(api, stale_after_s=2.0, lost_after_s=5.0,
+                           clock=lambda: clock["now"])
+        lc.tick()
+        clock["now"] = 1010.0
+        for node, adv in advs.items():
+            if node != victim:
+                adv.advertise_once()
+        t0 = time.perf_counter()
+        out = lc.tick()
+        assert out["states"][victim] == LOST
+        assert sorted(out["evicted"]) == names  # the WHOLE gang fails
+        final = drive(forbidden=victim)
+        recovery_s = time.perf_counter() - t0
+        # zero leaked chips, verified via the allocation annotations:
+        # 4 chips per member, 8 distinct chips total, none on the victim
+        chips = {n: allocated_chips(api, n) for n in names}
+        assert sorted(len(c) for c in chips.values()) == [4, 4], chips
+        union = set(chips["g-0"]) | set(chips["g-1"])
+        assert len(union) == 8, chips
+        assert victim not in final.values()
+        # cache accounting agrees: survivors carry exactly the 8 chips
+        used = 0
+        for node in advs:
+            if node == victim:
+                assert sched.cache.snapshot_node(node) is None
+                continue
+            snap = sched.cache.snapshot_node(node)
+            used += sum(1 for k, v in snap.node_ex.used.items()
+                        if k.endswith(f"/{grammar.CHIPS_SUFFIX}") and v > 0)
+        assert used == 8
+        return first, final, recovery_s
+    finally:
+        sched.stop()
+
+
+@pytest.mark.chaos
+def test_gang_rebinds_on_survivors_after_node_loss_under_chaos():
+    """ISSUE 1 acceptance: seeded + deterministic — three consecutive
+    runs with the same seed produce the same placements, and each run
+    recovers the full gang on surviving nodes with zero leaked chips."""
+    runs = [_run_gang_chaos_once(seed=1234) for _ in range(3)]
+    firsts = {tuple(sorted(r[0].items())) for r in runs}
+    finals = {tuple(sorted(r[1].items())) for r in runs}
+    assert len(firsts) == 1 and len(finals) == 1, (firsts, finals)
+    for _, _, recovery_s in runs:
+        assert recovery_s > 0.0  # a real, reported recovery time
