@@ -161,7 +161,8 @@ class Cluster:
 
     def pod_coords(self, name):
         pod = self.api.get_pod(name)
-        pi = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
+        # raw read-back of the persisted allocation (no spec merge needed)
+        pi = codec.annotation_to_pod_info(pod.get("metadata") or {})
         out = []
         for cont in pi.running_containers.values():
             for path in cont.allocate_from.values():

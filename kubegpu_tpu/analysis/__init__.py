@@ -1,0 +1,36 @@
+"""Project-native static analysis: repo-specific invariants as lint rules.
+
+The scheduler's correctness story is concurrency discipline: schedule-time
+device accounting stays consistent across the advertiser, the scheduler,
+and the CRI hook, each moving on its own thread or process. This package
+encodes the invariants that keep that true as named, suppressible rules
+(`engine.py` + `rules/`), plus a *dynamic* lock-order harness
+(`lockgraph.py`, wired into pytest via `pytest_plugin.py`) that fails the
+suite on lock-order inversions observed while the tests run.
+
+CLI::
+
+    python -m kubegpu_tpu.analysis [paths...] [--select rule,...] [--json]
+
+Suppression::
+
+    something_flagged()  # analysis: disable=<rule>  -- why it is fine
+
+A suppression comment on the offending line (or the line directly above
+it) silences that rule there; ``# analysis: disable-file=<rule>`` near the
+top of a file silences it for the whole file. Every suppression should
+carry a justification — they are reviewed like code.
+"""
+
+from __future__ import annotations
+
+from kubegpu_tpu.analysis.engine import (AnalysisError, Context, Finding,
+                                         SourceFile, run_analysis)
+
+__all__ = [
+    "AnalysisError",
+    "Context",
+    "Finding",
+    "SourceFile",
+    "run_analysis",
+]

@@ -1,0 +1,71 @@
+"""CLI: ``python -m kubegpu_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from kubegpu_tpu.analysis.engine import (AnalysisError, all_rules,
+                                         run_analysis)
+
+
+def main(argv: list | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubegpu_tpu.analysis",
+        description="Project-native static analysis for kubegpu-tpu.")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or package roots to analyze "
+                             "(default: the kubegpu_tpu package)")
+    parser.add_argument("--select", default=None, metavar="RULE[,RULE...]",
+                        help="run only these rules")
+    parser.add_argument("--tests-dir", default=None,
+                        help="tests directory for round-trip-test checks "
+                             "(default: ./tests when it exists)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable findings")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:26s} {rule.description}")
+        return 0
+
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+    tests_dir = args.tests_dir
+    if tests_dir is None and os.path.isdir("tests"):
+        tests_dir = "tests"
+    select = [r.strip() for r in args.select.split(",")] \
+        if args.select else None
+
+    try:
+        findings = run_analysis(paths, select=select, tests_dir=tests_dir)
+    except AnalysisError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for finding in findings:
+            print(finding.render())
+        if findings:
+            by_rule: dict = {}
+            for f in findings:
+                by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+            summary = ", ".join(f"{n} {r}" for r, n in sorted(by_rule.items()))
+            print(f"\n{len(findings)} finding(s): {summary}")
+        else:
+            print("clean: no findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

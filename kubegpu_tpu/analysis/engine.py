@@ -1,0 +1,188 @@
+"""Analysis engine: file walking, suppressions, and the rule registry.
+
+Rules are whole-project passes (some, like codec-pairing, are inherently
+cross-file), so the engine parses every ``.py`` under the requested roots
+once and hands the full list of :class:`SourceFile` objects to each rule.
+Findings land on a repo-relative ``path:line`` and are filtered against
+``# analysis: disable=...`` comments before they reach the caller.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Sequence
+
+# `# analysis: disable=rule-a,rule-b  -- free-text justification`
+SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*disable(?P<scope>-file)?="
+    r"(?P<rules>[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)")
+
+
+class AnalysisError(Exception):
+    """A file could not be analyzed (unreadable or unparseable)."""
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chains -> ``"a.b.c"``; None for anything else."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """A parsed module plus its suppression map.
+
+    ``path`` is the display path (relative to the invocation cwd when
+    possible); ``relparts`` are the path components *relative to the
+    scanned root*, which is what rules use for scoping decisions — a
+    fixture tree passed explicitly must not inherit the exemptions of
+    the directory it happens to live under.
+    """
+
+    def __init__(self, path: str, relparts: tuple, text: str) -> None:
+        self.path = path
+        self.relparts = relparts
+        self.text = text
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            raise AnalysisError(f"{path}: syntax error: {e}") from e
+        self.line_suppressions: dict = {}
+        self.file_suppressions: set = set()
+        self._collect_suppressions()
+
+    @property
+    def name(self) -> str:
+        return os.path.basename(self.path)
+
+    def _collect_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = SUPPRESS_RE.search(tok.string)
+                if m is None:
+                    continue
+                rules = {r.strip() for r in m.group("rules").split(",")
+                         if r.strip()}
+                if m.group("scope"):
+                    self.file_suppressions |= rules
+                else:
+                    self.line_suppressions.setdefault(
+                        tok.start[0], set()).update(rules)
+        except tokenize.TokenError:
+            pass  # the AST parsed; a trailing tokenize hiccup loses nothing
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """True when ``rule`` is disabled at ``line`` — by a trailing
+        comment on the line itself, a comment on the line directly above,
+        or a file-wide ``disable-file``."""
+        if rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        for ln in (line, line - 1):
+            rules = self.line_suppressions.get(ln)
+            if rules and (rule in rules or "all" in rules):
+                return True
+        return False
+
+
+class Context:
+    """Cross-rule invocation context (project root, tests location)."""
+
+    def __init__(self, root: str, tests_dir: str | None = None) -> None:
+        self.root = root
+        self.tests_dir = tests_dir
+
+
+def _collect_files(root: str) -> list:
+    """(abs_path, relparts) for every .py under ``root``, sorted."""
+    root = os.path.abspath(root)
+    if os.path.isfile(root):
+        return [(root, (os.path.basename(root),))]
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root)
+                out.append((full, tuple(rel.split(os.sep))))
+    return out
+
+
+def load_sources(roots: Sequence[str]) -> list:
+    """Parse every .py under ``roots`` into :class:`SourceFile` objects."""
+    sources = []
+    cwd = os.getcwd()
+    for root in roots:
+        if not os.path.exists(root):
+            raise AnalysisError(f"no such path: {root}")
+        for full, relparts in _collect_files(root):
+            display = os.path.relpath(full, cwd)
+            if display.startswith(".."):
+                display = full
+            with open(full, encoding="utf-8") as fh:
+                text = fh.read()
+            sources.append(SourceFile(display, relparts, text))
+    return sources
+
+
+def all_rules() -> list:
+    from kubegpu_tpu.analysis.rules import ALL_RULES
+
+    return list(ALL_RULES)
+
+
+def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
+                 tests_dir: str | None = None) -> list:
+    """Run the (selected) rules over ``roots``; returns findings sorted by
+    location, with suppressed findings already dropped."""
+    rules = all_rules()
+    if select is not None:
+        wanted = set(select)
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            raise AnalysisError(
+                f"unknown rule(s): {', '.join(sorted(unknown))}")
+        rules = [r for r in rules if r.name in wanted]
+    sources = load_sources(roots)
+    by_path = {s.path: s for s in sources}
+    ctx = Context(root=os.path.abspath(roots[0]) if roots else os.getcwd(),
+                  tests_dir=tests_dir)
+    findings: list = []
+    for rule in rules:
+        for finding in rule.run(sources, ctx):
+            src = by_path.get(finding.path)
+            if src is not None and src.suppressed(finding.rule, finding.line):
+                continue
+            findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,56 @@
+"""Pytest plugin: run the whole suite under the lock-order harness.
+
+Registered from ``tests/conftest.py`` (``pytest_plugins``). While the
+suite runs, every lock the package constructs is instrumented
+(:mod:`kubegpu_tpu.analysis.lockgraph`); at session end the accumulated
+acquisition graph is checked for cycles and the run FAILS if any exist —
+a lock-order inversion is a deadlock waiting for the right interleaving,
+and it must not ride a green build.
+
+Disable with ``KGTPU_LOCKGRAPH=0`` (e.g. when bisecting an unrelated
+failure).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubegpu_tpu.analysis import lockgraph
+
+_ENV_FLAG = "KGTPU_LOCKGRAPH"
+
+
+def _enabled() -> bool:
+    return os.environ.get(_ENV_FLAG, "1") not in ("0", "false", "no")
+
+
+def pytest_configure(config: object) -> None:
+    if _enabled():
+        lockgraph.install()
+
+
+def pytest_unconfigure(config: object) -> None:
+    lockgraph.uninstall()
+
+
+def pytest_terminal_summary(terminalreporter: object, exitstatus: int,
+                            config: object) -> None:
+    if not lockgraph.installed():
+        return
+    edges = len(lockgraph.GLOBAL_GRAPH.edges)
+    cycles = lockgraph.GLOBAL_GRAPH.cycles()
+    if cycles:
+        terminalreporter.section("lock-order inversions", sep="=")
+        terminalreporter.write_line(lockgraph.GLOBAL_GRAPH.render_cycles())
+    else:
+        terminalreporter.write_line(
+            f"lockgraph: {edges} ordering edge(s) observed, no inversions")
+
+
+def pytest_sessionfinish(session: object, exitstatus: int) -> None:
+    if not lockgraph.installed():
+        return
+    if lockgraph.GLOBAL_GRAPH.cycles():
+        # mutating session.exitstatus is the supported way to flip the
+        # final exit code from a sessionfinish hook
+        session.exitstatus = 1

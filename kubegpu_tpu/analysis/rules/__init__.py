@@ -1,0 +1,22 @@
+"""Rule registry. Each rule object exposes ``name``, ``description`` and
+``run(sources, ctx) -> Iterable[Finding]``."""
+
+from __future__ import annotations
+
+from kubegpu_tpu.analysis.rules.clocks import MonotonicTime
+from kubegpu_tpu.analysis.rules.codecs import CodecPairing
+from kubegpu_tpu.analysis.rules.exceptions import NoSwallowedExceptions
+from kubegpu_tpu.analysis.rules.locks import (LockDiscipline,
+                                              NoBlockingUnderLock)
+from kubegpu_tpu.analysis.rules.metricsrule import MetricRegistration
+
+ALL_RULES = [
+    LockDiscipline(),
+    NoBlockingUnderLock(),
+    MonotonicTime(),
+    CodecPairing(),
+    NoSwallowedExceptions(),
+    MetricRegistration(),
+]
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,101 @@
+"""codec-pairing: every annotation encoder has a decoder and a round trip.
+
+The annotation codec IS the wire protocol between the advertiser, the
+scheduler, and the CRI hook (``core/codec.py``). An encoder without a
+decoder is a write nobody can read back — state that silently falls out
+of the checkpoint/restore story (the API server is the only checkpoint).
+The repo's naming convention pairs ``<thing>_to_annotation`` with
+``annotation_to_<thing>``; this rule enforces the pairing both ways and,
+when a tests directory is available, requires both names to appear in the
+codec round-trip tests (``test_codec*.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Iterator
+
+from kubegpu_tpu.analysis.engine import Context, Finding
+
+_ENCODE_RE = re.compile(r"^(?P<stem>\w+)_to_annotation$")
+_DECODE_RE = re.compile(r"^annotation_to_(?P<stem>\w+)$")
+
+
+class CodecPairing:
+    name = "codec-pairing"
+    description = ("every `<x>_to_annotation` encoder needs an "
+                   "`annotation_to_<x>` decoder, and both must appear in a "
+                   "round-trip test")
+
+    def run(self, sources: list, ctx: Context) -> Iterator[Finding]:
+        for src in sources:
+            if src.name != "codec.py":
+                continue
+            encoders: dict = {}
+            decoders: dict = {}
+            for node in src.tree.body:
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                m = _ENCODE_RE.match(node.name)
+                if m:
+                    encoders[m.group("stem")] = node
+                m = _DECODE_RE.match(node.name)
+                if m:
+                    decoders[m.group("stem")] = node
+            test_idents = _codec_test_identifiers(ctx)
+            for stem in sorted(encoders):
+                node = encoders[stem]
+                if stem not in decoders:
+                    yield Finding(
+                        self.name, src.path, node.lineno,
+                        f"encoder `{node.name}` has no decoder "
+                        f"`annotation_to_{stem}` — annotation state that "
+                        f"cannot be read back falls out of the API-server "
+                        f"checkpoint")
+            for stem in sorted(decoders):
+                node = decoders[stem]
+                if stem not in encoders:
+                    yield Finding(
+                        self.name, src.path, node.lineno,
+                        f"decoder `{node.name}` has no encoder "
+                        f"`{stem}_to_annotation` — nothing produces what "
+                        f"this reads")
+            if test_idents is None:
+                continue  # no tests tree in scope: pairing check only
+            for stem in sorted(set(encoders) & set(decoders)):
+                for node in (encoders[stem], decoders[stem]):
+                    if node.name not in test_idents:
+                        yield Finding(
+                            self.name, src.path, node.lineno,
+                            f"`{node.name}` never appears in the codec "
+                            f"round-trip tests (test_codec*.py) — an "
+                            f"untested codec pair drifts")
+
+
+def _codec_test_identifiers(ctx: Context) -> set | None:
+    """Identifiers actually *referenced* (as names or attributes) in the
+    codec round-trip tests. AST-level on purpose: a mention in a comment
+    or docstring — or a longer name that merely contains the target as a
+    substring — must not satisfy the tested-pair requirement."""
+    if ctx.tests_dir is None or not os.path.isdir(ctx.tests_dir):
+        return None
+    idents: set = set()
+    found = False
+    for path in sorted(glob.glob(
+            os.path.join(ctx.tests_dir, "test_codec*.py"))):
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read(), filename=path)
+            except SyntaxError:
+                continue
+        found = True
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                idents.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                idents.add(node.attr)
+    return idents if found else None

@@ -1,0 +1,119 @@
+"""metric-registration: one metrics registry, consistent names.
+
+Every metric the system emits is declared exactly once, at module level,
+in ``metrics.py`` — so dashboards have one place to discover names and a
+renamed metric cannot half-exist. Names are snake_case; counters carry
+the ``_total`` suffix and histograms a unit suffix, Prometheus-style.
+Modules emit through the declared module-level objects
+(``metrics.EVICTIONS.inc()``); referencing an undeclared ``metrics.X``
+is a typo that would otherwise surface as an AttributeError mid-flight.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from kubegpu_tpu.analysis.engine import Context, Finding, SourceFile
+
+_METRIC_TYPES = frozenset({"Counter", "Gauge", "Histogram"})
+_SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_HISTOGRAM_UNITS = ("_microseconds", "_milliseconds", "_seconds", "_us",
+                    "_ms", "_bytes", "_total")
+
+
+def _metric_ctor(node: ast.AST) -> str | None:
+    """'Counter'/'Gauge'/'Histogram' when ``node`` constructs one."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Name) and func.id in _METRIC_TYPES:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _METRIC_TYPES:
+        return func.attr
+    return None
+
+
+class MetricRegistration:
+    name = "metric-registration"
+    description = ("metrics are declared once in metrics.py, snake_case, "
+                   "with the conventional unit suffix")
+
+    def run(self, sources: list, ctx: Context) -> Iterator[Finding]:
+        registry_src = None
+        declared: set = set()
+        for src in sources:
+            if src.name == "metrics.py" and len(src.relparts) == 1:
+                registry_src = src
+        if registry_src is not None:
+            declared = {
+                t.id
+                for node in registry_src.tree.body
+                if isinstance(node, ast.Assign)
+                for t in node.targets
+                if isinstance(t, ast.Name)
+            }
+            yield from self._check_registry(registry_src)
+        for src in sources:
+            yield from self._check_module(src, registry_src, declared)
+
+    def _check_registry(self, src: SourceFile) -> Iterator[Finding]:
+        seen: dict = {}
+        for node in src.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = _metric_ctor(node.value)
+            if kind is None:
+                continue
+            call = node.value
+            if not call.args or not isinstance(call.args[0], ast.Constant) \
+                    or not isinstance(call.args[0].value, str):
+                yield Finding(
+                    self.name, src.path, node.lineno,
+                    f"{kind} declared without a literal name — the "
+                    f"registry must be greppable")
+                continue
+            metric_name = call.args[0].value
+            if metric_name in seen:
+                yield Finding(
+                    self.name, src.path, node.lineno,
+                    f"metric name `{metric_name}` declared twice (first at "
+                    f"line {seen[metric_name]})")
+            seen[metric_name] = node.lineno
+            if not _SNAKE_RE.match(metric_name):
+                yield Finding(
+                    self.name, src.path, node.lineno,
+                    f"metric name `{metric_name}` is not snake_case")
+                continue
+            if kind == "Counter" and not metric_name.endswith("_total"):
+                yield Finding(
+                    self.name, src.path, node.lineno,
+                    f"counter `{metric_name}` must end in `_total`")
+            if kind == "Histogram" and \
+                    not metric_name.endswith(_HISTOGRAM_UNITS):
+                yield Finding(
+                    self.name, src.path, node.lineno,
+                    f"histogram `{metric_name}` needs a unit suffix "
+                    f"({', '.join(_HISTOGRAM_UNITS)})")
+
+    def _check_module(self, src: SourceFile,
+                      registry_src: SourceFile | None,
+                      declared: set) -> Iterator[Finding]:
+        for node in ast.walk(src.tree):
+            kind = _metric_ctor(node)
+            if kind is not None and src is not registry_src:
+                # metric classes may be *defined* anywhere (fixtures,
+                # forks of the registry), but instances live in metrics.py
+                yield Finding(
+                    self.name, src.path, node.lineno,
+                    f"{kind} instantiated outside metrics.py — declare it "
+                    f"in the registry so the name exists exactly once")
+            if registry_src is not None and isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "metrics" and node.attr.isupper() \
+                    and node.attr not in declared:
+                yield Finding(
+                    self.name, src.path, node.lineno,
+                    f"`metrics.{node.attr}` is not declared in metrics.py "
+                    f"— emitting an unregistered metric")

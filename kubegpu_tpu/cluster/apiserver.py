@@ -56,7 +56,7 @@ class InMemoryAPIServer:
         with self._lock:
             name = node["metadata"]["name"]
             self._nodes[name] = copy.deepcopy(node)
-            self._notify("node", "added", self._nodes[name])
+            self._notify_locked("node", "added", self._nodes[name])
             return copy.deepcopy(self._nodes[name])
 
     def get_node(self, name: str) -> dict:
@@ -76,14 +76,18 @@ class InMemoryAPIServer:
             if name not in self._nodes:
                 raise NotFound(f"node {name}")
             _merge(self._nodes[name].setdefault("metadata", {}), metadata_patch)
-            self._notify("node", "modified", self._nodes[name])
+            self._notify_locked("node", "modified", self._nodes[name])
             return copy.deepcopy(self._nodes[name])
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             node = self._nodes.pop(name, None)
-            if node is not None:
-                self._notify("node", "deleted", node)
+            if node is None:
+                # raise like the HTTP transport's 404 and real Kubernetes:
+                # a caller distinguishing "I deleted it" from "it was
+                # already gone" (eviction, preemption) needs the signal
+                raise NotFound(f"node {name}")
+            self._notify_locked("node", "deleted", node)
 
     # ---- pods --------------------------------------------------------------
 
@@ -96,7 +100,7 @@ class InMemoryAPIServer:
             stored.setdefault("spec", {})
             stored.setdefault("status", {"phase": "Pending"})
             self._pods[name] = stored
-            self._notify("pod", "added", stored)
+            self._notify_locked("pod", "added", stored)
             return copy.deepcopy(stored)
 
     def get_pod(self, name: str) -> dict:
@@ -121,7 +125,7 @@ class InMemoryAPIServer:
                 raise NotFound(f"pod {name}")
             meta = self._pods[name].setdefault("metadata", {})
             meta["annotations"] = copy.deepcopy(annotations)
-            self._notify("pod", "modified", self._pods[name])
+            self._notify_locked("pod", "modified", self._pods[name])
             return copy.deepcopy(self._pods[name])
 
     def bind_pod(self, name: str, node_name: str) -> None:
@@ -135,7 +139,7 @@ class InMemoryAPIServer:
                 raise Conflict(f"pod {name} already bound to {bound}")
             pod.setdefault("spec", {})["nodeName"] = node_name
             pod.setdefault("status", {})["phase"] = "Scheduled"
-            self._notify("pod", "modified", pod)
+            self._notify_locked("pod", "modified", pod)
 
     def bind_many(self, bindings: dict, annotations: dict) -> None:
         """Atomically annotate and bind a pod-set (gang commit): either every
@@ -157,13 +161,17 @@ class InMemoryAPIServer:
                 pod.setdefault("status", {})["phase"] = "Scheduled"
                 changed.append(pod)
             for pod in changed:
-                self._notify("pod", "modified", pod)
+                self._notify_locked("pod", "modified", pod)
 
     def delete_pod(self, name: str) -> None:
         with self._lock:
             pod = self._pods.pop(name, None)
-            if pod is not None:
-                self._notify("pod", "deleted", pod)
+            if pod is None:
+                # raise like the HTTP transport's 404 and real Kubernetes
+                # (see delete_node) — this is what keeps the lifecycle
+                # controller's externally-deleted-pod guard alive
+                raise NotFound(f"pod {name}")
+            self._notify_locked("pod", "deleted", pod)
 
     # ---- persistent volumes / claims ---------------------------------------
     # The volume-binding surface the scheduler consumes
@@ -186,7 +194,7 @@ class InMemoryAPIServer:
             stored = copy.deepcopy(pvc)
             stored.setdefault("status", {"phase": "Pending"})
             self._pvcs[name] = stored
-            self._notify("pvc", "added", stored)
+            self._notify_locked("pvc", "added", stored)
             return copy.deepcopy(stored)
 
     def get_pvc(self, name: str) -> dict:
@@ -203,7 +211,7 @@ class InMemoryAPIServer:
         with self._lock:
             pvc = self._pvcs.pop(name, None)
             if pvc is not None:
-                self._notify("pvc", "deleted", pvc)
+                self._notify_locked("pvc", "deleted", pvc)
 
     def create_pv(self, pv: dict) -> dict:
         with self._lock:
@@ -213,7 +221,7 @@ class InMemoryAPIServer:
             stored = copy.deepcopy(pv)
             stored.setdefault("status", {"phase": "Available"})
             self._pvs[name] = stored
-            self._notify("pv", "added", stored)
+            self._notify_locked("pv", "added", stored)
             return copy.deepcopy(stored)
 
     def get_pv(self, name: str) -> dict:
@@ -230,7 +238,7 @@ class InMemoryAPIServer:
         with self._lock:
             pv = self._pvs.pop(name, None)
             if pv is not None:
-                self._notify("pv", "deleted", pv)
+                self._notify_locked("pv", "deleted", pv)
 
     def patch_pv_spec(self, name: str, spec_patch: dict) -> dict:
         """Strategic-merge patch of a PV's spec — the real binder's first
@@ -248,7 +256,7 @@ class InMemoryAPIServer:
             _merge(pv.setdefault("spec", {}), spec_patch or {})
             if pv["spec"].get("claimRef"):
                 pv.setdefault("status", {})["phase"] = "Bound"
-            self._notify("pv", "modified", pv)
+            self._notify_locked("pv", "modified", pv)
             return copy.deepcopy(pv)
 
     def patch_pvc_spec(self, name: str, spec_patch: dict) -> dict:
@@ -265,7 +273,7 @@ class InMemoryAPIServer:
             _merge(pvc.setdefault("spec", {}), spec_patch or {})
             if pvc["spec"].get("volumeName"):
                 pvc.setdefault("status", {})["phase"] = "Bound"
-            self._notify("pvc", "modified", pvc)
+            self._notify_locked("pvc", "modified", pvc)
             return copy.deepcopy(pvc)
 
     def bind_volume(self, pv_name: str, claim_name: str) -> None:
@@ -299,7 +307,7 @@ class InMemoryAPIServer:
             if name in self._pdbs:
                 raise Conflict(f"pdb {name} exists")
             self._pdbs[name] = copy.deepcopy(pdb)
-            self._notify("pdb", "added", self._pdbs[name])
+            self._notify_locked("pdb", "added", self._pdbs[name])
             return copy.deepcopy(self._pdbs[name])
 
     def list_pdbs(self) -> list:
@@ -310,7 +318,7 @@ class InMemoryAPIServer:
         with self._lock:
             pdb = self._pdbs.pop(name, None)
             if pdb is not None:
-                self._notify("pdb", "deleted", pdb)
+                self._notify_locked("pdb", "deleted", pdb)
 
     # ---- selector owners (Services / RCs / RSs / StatefulSets) -------------
     # The reference's SelectorSpreadPriority spreads by the label
@@ -324,7 +332,7 @@ class InMemoryAPIServer:
             if name in store:
                 raise Conflict(f"{kind} {name} exists")
             store[name] = copy.deepcopy(obj)
-            self._notify(kind, "added", store[name])
+            self._notify_locked(kind, "added", store[name])
             return copy.deepcopy(store[name])
 
     def _list_owners(self, kind: str) -> list:
@@ -336,7 +344,7 @@ class InMemoryAPIServer:
         with self._lock:
             obj = self._owners[kind].pop(name, None)
             if obj is not None:
-                self._notify(kind, "deleted", obj)
+                self._notify_locked(kind, "deleted", obj)
 
     def create_service(self, svc: dict) -> dict:
         return self._create_owner("service", svc)
@@ -386,7 +394,7 @@ class InMemoryAPIServer:
             ev = self._events.get(key)
             if ev is not None:
                 ev["count"] += 1
-                self._notify("event", "modified", ev)
+                self._notify_locked("event", "modified", ev)
                 return copy.deepcopy(ev)
             ev = {"involvedObject": {"kind": involved_kind,
                                      "name": involved_name},
@@ -395,7 +403,7 @@ class InMemoryAPIServer:
             self._events[key] = ev
             while len(self._events) > self.MAX_EVENTS:
                 self._events.pop(next(iter(self._events)))
-            self._notify("event", "added", ev)
+            self._notify_locked("event", "added", ev)
             return copy.deepcopy(ev)
 
     def list_events(self, involved_name: str | None = None) -> list:
@@ -414,7 +422,9 @@ class InMemoryAPIServer:
         with self._lock:
             self._watchers.append(fn)
 
-    def _notify(self, kind: str, event: str, obj: dict) -> None:
+    def _notify_locked(self, kind: str, event: str, obj: dict) -> None:
+        # Always called with self._lock held: the lock is what gives every
+        # watcher the same total event order the watch protocol promises.
         obj_copy = copy.deepcopy(obj)
         for fn in list(self._watchers):
             fn(kind, event, obj_copy)

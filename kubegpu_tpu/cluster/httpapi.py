@@ -303,6 +303,15 @@ class HTTPAPIClient:
             except urllib.error.HTTPError as e:
                 payload = e.read().decode()
                 if e.code == 404:
+                    if method == "DELETE" and attempt > 0:
+                        # Our earlier attempt may have landed and lost its
+                        # reply: this 404 is "already deleted", not "was
+                        # never there". Report success so a caller that
+                        # distinguishes its own delete from an external
+                        # one (NodeLifecycle eviction) is not tricked
+                        # into reading a clean not-found — the transport
+                        # retry must not hide the ambiguity it created.
+                        return {}
                     raise NotFound(payload)
                 if e.code == 409:
                     raise Conflict(payload)
@@ -494,7 +503,12 @@ class HTTPAPIClient:
                     try:
                         fn(kind, event, obj)
                     except Exception:
-                        pass
+                        # a bad consumer must not kill the informer, but a
+                        # consumer that throws on every event is a dead
+                        # scheduler cache — it has to be visible
+                        log.warning("watch consumer %r failed on %s %s "
+                                    "event (seq %d)", fn, kind, event,
+                                    ev_seq, exc_info=True)
             seq = max(seq, out.get("seq", seq))
 
     def close(self):

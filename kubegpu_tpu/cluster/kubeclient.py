@@ -28,6 +28,7 @@ speaking the identical grammar (tests/test_kubeclient.py).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import ssl
 import threading
@@ -37,6 +38,8 @@ import urllib.request
 from dataclasses import dataclass, field
 
 from kubegpu_tpu.cluster.apiserver import Conflict, NotFound
+
+log = logging.getLogger(__name__)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 STRATEGIC_MERGE = "application/strategic-merge-patch+json"
@@ -422,7 +425,10 @@ class KubeAPIClient:
             try:
                 fn(kind, event, obj)
             except Exception:
-                pass  # a bad watcher must not kill the informer
+                # a bad watcher must not kill the informer, but it must
+                # not fail invisibly either
+                log.warning("watch consumer %r failed on %s %s event",
+                            fn, kind, event, exc_info=True)
 
     def close(self) -> None:
         self._stop.set()

@@ -45,9 +45,10 @@ def make_pod(name, numchips, pod_requests=None, hbm=0):
 
 
 def _gang_chips(api, name):
-    """Chip-id list a bound pod's allocation annotation pins."""
-    pi = codec.kube_pod_to_pod_info(api.get_pod(name),
-                                    invalidate_existing=False)
+    """Chip-id list a bound pod's allocation annotation pins — the raw
+    persisted decision, read back via the codec's decode half."""
+    pi = codec.annotation_to_pod_info(
+        api.get_pod(name).get("metadata") or {})
     chips = []
     for cont in pi.running_containers.values():
         for path in cont.allocate_from.values():

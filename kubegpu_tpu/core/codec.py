@@ -48,7 +48,7 @@ _QUANTITY_SUFFIXES = {
 _QUANTITY_RE = re.compile(r"^([+-]?[0-9.eE+-]+?)([A-Za-z]*)$")
 
 
-def parse_quantity(val) -> int:
+def parse_quantity(val: "int | float | str") -> int:
     """Parse a Kubernetes resource quantity to a whole number, rounding up.
 
     Accepts ints/floats directly and strings like ``"2"``, ``"500m"``,
@@ -147,6 +147,18 @@ def pod_info_to_annotation(meta: dict, pod_info: PodInfo) -> None:
     _annotations(meta)[POD_ANNOTATION_KEY] = json.dumps(
         pod_info.to_json(), sort_keys=True
     )
+
+
+def annotation_to_pod_info(meta: dict) -> PodInfo:
+    """Decode the scheduler's persisted decision from pod metadata, raw —
+    no pod-spec merge, no invalidation. This is the read-back half of
+    :func:`pod_info_to_annotation`; consumers evaluating a pod against a
+    spec should go through :func:`kube_pod_to_pod_info`, which folds the
+    container requests in on top."""
+    raw = (meta.get("annotations") or {}).get(POD_ANNOTATION_KEY)
+    if raw is None:
+        return PodInfo()
+    return PodInfo.from_json(json.loads(raw))
 
 
 def _merge_kube_containers(

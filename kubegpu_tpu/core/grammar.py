@@ -137,5 +137,5 @@ def coords_from_chip_id(chip_id: str) -> tuple | None:
         return None
 
 
-def chip_id_from_coords(coords) -> str:
+def chip_id_from_coords(coords: "tuple | list") -> str:
     return ".".join(str(int(c)) for c in coords)

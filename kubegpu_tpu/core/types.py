@@ -12,6 +12,7 @@ Scorer selection rides per-resource as a small int enum
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Iterator
 
 # Namespace prefix for group resources (reference: `types/types.go:5-8`).
 # Everything under this prefix is handled by the group allocator; everything
@@ -97,12 +98,12 @@ class PodInfo:
     init_containers: dict = field(default_factory=dict)  # name -> ContainerInfo
     running_containers: dict = field(default_factory=dict)  # name -> ContainerInfo
 
-    def container(self, name: str):
+    def container(self, name: str) -> "ContainerInfo | None":
         if name in self.init_containers:
             return self.init_containers[name]
         return self.running_containers.get(name)
 
-    def all_containers(self):
+    def all_containers(self) -> "Iterator[tuple[str, ContainerInfo, bool]]":
         """(name, info, is_init) triples, deterministic order."""
         for name in sorted(self.running_containers):
             yield name, self.running_containers[name], False

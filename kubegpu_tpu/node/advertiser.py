@@ -32,8 +32,13 @@ class DeviceAdvertiser:
         self.dev_mgr = dev_mgr
         self.node_name = node_name
         self.address = address
-        # wall clock for the cross-process heartbeat stamp; injectable so
-        # lifecycle tests can drive time deterministically
+        # Wall clock for the cross-process heartbeat stamp; injectable so
+        # lifecycle tests can drive time deterministically. Deliberately
+        # NOT monotonic: the stamp crosses process (and potentially host)
+        # boundaries, where monotonic clocks are meaningless — the
+        # consumer (scheduler/lifecycle.py) ages its own local
+        # observations instead of comparing clocks.
+        # analysis: disable=monotonic-time
         self.clock = clock if clock is not None else time.time
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None

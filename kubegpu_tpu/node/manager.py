@@ -10,12 +10,15 @@ container-create time turns ``allocate_from`` into device nodes plus the
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 
 from kubegpu_tpu.core import grammar
 from kubegpu_tpu.core.types import NodeInfo, add_group_resource
 from kubegpu_tpu.node.backend import TPUBackend, TPUInventory
 from kubegpu_tpu.topology.mesh import ICIMesh
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -226,6 +229,10 @@ class DevicesManager:
             try:
                 out.update(probe() or {})
             except Exception:
+                # a dead probe means this device's chips report as
+                # healthy-by-omission — the degradation signal is gone
+                log.warning("chip health probe failed for device %s",
+                            dev.get_name(), exc_info=True)
                 continue
         return out
 

@@ -45,6 +45,9 @@ class Container:
         self.command = list(command)
         self.proc = proc
         self.log_path = log_path
+        # Wall clock on purpose: container status timestamps cross the CRI
+        # boundary and are read by humans/other processes, like kubelet's.
+        # analysis: disable=monotonic-time
         self.started_at = time.time()
         self.finished_at: float | None = None
         self.exit_code: int | None = None
@@ -238,6 +241,7 @@ class WorkloadSupervisor:
         rc = cont.proc.poll()
         if rc is not None:
             cont.exit_code = rc
+            # analysis: disable=monotonic-time  -- CRI status timestamp
             cont.finished_at = time.time()
             self._report(cont)
 

@@ -61,7 +61,8 @@ class CachedNode:
 class NodeSnapshot:
     """Consistent point-in-time copy for lock-free fit/score evaluation.
     Fully self-contained — no live back-references — so a concurrent
-    ``set_node``/``_charge`` cannot tear a fit decision mid-evaluation."""
+    ``set_node``/``_charge_locked`` cannot tear a fit decision
+    mid-evaluation."""
 
     def __init__(self, cached: CachedNode):
         self.name = cached.name
@@ -189,7 +190,8 @@ class SchedulerCache:
 
     # ---- pod lifecycle (`node_info.go:336-398`, `cache.go:40-81`) ----------
 
-    def _charge(self, kube_pod: dict, node_name: str, take: bool) -> None:
+    def _charge_locked(self, kube_pod: dict, node_name: str, take: bool) -> None:
+        # Always called with self._lock held (assume/forget/add/remove/expire).
         # Idempotent per pod: an informer replaying a bound pod that
         # _sync_existing already listed (or a duplicate delete) must not
         # double-charge/double-return device usage — a real k8s watch
@@ -267,7 +269,7 @@ class SchedulerCache:
         allocate and assume — the charge no-ops and bind will fail cleanly."""
         with self._lock:
             name = kube_pod["metadata"]["name"]
-            self._charge(kube_pod, node_name, take=True)
+            self._charge_locked(kube_pod, node_name, take=True)
             node = self.nodes.get(node_name)
             if node is not None:
                 node.pod_names.add(name)
@@ -321,7 +323,7 @@ class SchedulerCache:
             if entry is None:
                 return
             node_name = entry[0]
-            self._charge(entry[2], node_name, take=False)
+            self._charge_locked(entry[2], node_name, take=False)
             node = self.nodes.get(node_name)
             if node:
                 node.pod_names.discard(name)
@@ -334,7 +336,7 @@ class SchedulerCache:
             if name in self._assumed:
                 self._assumed.pop(name)
                 return
-            self._charge(kube_pod, node_name, take=True)
+            self._charge_locked(kube_pod, node_name, take=True)
             if node_name in self.nodes:
                 self.nodes[node_name].pod_names.add(name)
 
@@ -342,7 +344,7 @@ class SchedulerCache:
         with self._lock:
             name = kube_pod["metadata"]["name"]
             self._assumed.pop(name, None)
-            self._charge(kube_pod, node_name, take=False)
+            self._charge_locked(kube_pod, node_name, take=False)
             node = self.nodes.get(node_name)
             if node:
                 node.pod_names.discard(name)
@@ -355,7 +357,7 @@ class SchedulerCache:
             expired = [n for n, (_, dl, _) in self._assumed.items() if dl <= now]
             for name in expired:
                 node_name, _, kube_pod = self._assumed.pop(name)
-                self._charge(kube_pod, node_name, take=False)
+                self._charge_locked(kube_pod, node_name, take=False)
                 node = self.nodes.get(node_name)
                 if node:
                     node.pod_names.discard(name)

@@ -27,6 +27,8 @@ from kubegpu_tpu.scheduler.cache import SchedulerCache
 from kubegpu_tpu.scheduler.equivalence import equivalence_class
 from kubegpu_tpu.scheduler.queue import SchedulingQueue
 
+log = logging.getLogger(__name__)
+
 # Parallel fit evaluation width (reference: 16 workers,
 # `core/generic_scheduler.go:310-383`).
 DEFAULT_PARALLELISM = 16
@@ -185,6 +187,9 @@ class GenericScheduler:
             except Exception:
                 # freed room already retaken: the reservation is dead —
                 # charge nothing (core charges included)
+                log.debug("nominated pod %s no longer charges on %s",
+                          (pod.get("metadata") or {}).get("name"),
+                          snap.name, exc_info=True)
                 continue
             for res, val in _pod_core_requests(pod).items():
                 snap.requested_core[res] = \
@@ -212,6 +217,8 @@ class GenericScheduler:
                     int(c.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
                     for c in info.running_containers.values())
             except Exception:
+                log.debug("unreadable nomination snapshot for %s; "
+                          "reserving nothing for it", name, exc_info=True)
                 continue
             if chips > 0:
                 out[node] = out.get(node, 0) + chips
@@ -700,7 +707,12 @@ class GenericScheduler:
                 state.append({"selector": selector,
                               "allowed": healthy - min_avail})
             except Exception:
-                continue  # malformed PDB: ignore it, don't drop the pod
+                # malformed PDB: ignore it, don't drop the pod — but a
+                # typo'd PDB silently not protecting anything is worse
+                log.warning("ignoring malformed PDB %s",
+                            (pdb.get("metadata") or {}).get("name"),
+                            exc_info=True)
+                continue
         return state
 
     @staticmethod
@@ -1166,8 +1178,11 @@ class Scheduler:
                     self.api.update_pod_annotations(
                         name, pinned["metadata"]["annotations"])
                 except Exception:
-                    pass  # keep the gang shape; the buffer retry below
-                    # is degraded but the pod is not lost
+                    # keep the gang shape; the buffer retry below is
+                    # degraded but the pod is not lost
+                    log.warning("could not strip gang shape off %s; "
+                                "member retries gang-shaped", name,
+                                exc_info=True)
                 self._event(name, "Warning", "FailedScheduling",
                             "gang partially bound; retrying member solo "
                             "pinned to its planned chips")
@@ -1216,7 +1231,10 @@ class Scheduler:
             victim_name = victim["metadata"]["name"]
             self._event(victim_name, "Normal", "Preempted",
                         f"by pod {preemptor} on node {node_name}")
-            self.api.delete_pod(victim_name)
+            try:
+                self.api.delete_pod(victim_name)
+            except KeyError:
+                pass  # victim already gone: the room is free either way
         # record where the preemption made room (upstream's nominated
         # node). Must be persisted via the API: the next scheduling pass
         # re-fetches the pod, so a local-dict-only annotation would be lost.
@@ -1270,6 +1288,11 @@ class Scheduler:
                 info = codec.kube_pod_to_pod_info(
                     p, invalidate_existing=False)
             except Exception:
+                # this pod's chips cannot be attributed to an owner, so
+                # they are invisible to preemption planning
+                log.debug("unreadable device annotation on %s; its chips "
+                          "are not preemptible this pass", name,
+                          exc_info=True)
                 continue
             conts = list(info.running_containers.values()) + \
                 list(info.init_containers.values())
@@ -1338,6 +1361,8 @@ class Scheduler:
                         "(slice defragmentation)")
             try:
                 self.api.delete_pod(victim_name)
+            except KeyError:
+                pass  # victim already gone: the room is free either way
             except Exception:
                 return False  # retry later; cache unchanged for the rest
         # protect the freed block: nominate every member onto its planned
@@ -1351,7 +1376,10 @@ class Scheduler:
                 annotations[self.NOMINATED_NODE_ANNOTATION] = host
                 self.api.update_pod_annotations(name, annotations)
             except Exception:
-                pass
+                # the in-memory nomination below still protects the block;
+                # only restart-safety is degraded — worth a trace
+                log.warning("could not persist nominated-node annotation "
+                            "on %s", name, exc_info=True)
             self.generic.nominate(member, host)
         return True
 

@@ -136,7 +136,7 @@ class GangBuffer:
         self._lock = threading.Lock()
         self._gangs: dict = {}  # gang id -> {pod name: kube_pod}
 
-    def add(self, kube_pod: dict, gang: int, size: int):
+    def add(self, kube_pod: dict, gang: int, size: int) -> list | None:
         with self._lock:
             members = self._gangs.setdefault(gang, {})
             members[kube_pod["metadata"]["name"]] = kube_pod

@@ -32,6 +32,7 @@ import threading
 import time
 
 from kubegpu_tpu import metrics
+from kubegpu_tpu.cluster.apiserver import Conflict
 from kubegpu_tpu.core import codec
 
 log = logging.getLogger(__name__)
@@ -96,7 +97,12 @@ class NodeLifecycle:
         # returning agent re-registers via --register-node. False keeps
         # the node listed (and re-evicts anything that lands there).
         self.delete_lost_nodes = delete_lost_nodes
-        self.clock = clock if clock is not None else time.time
+        # Monotonic: this clock only AGES the controller's own local
+        # observations (it is never compared against the advertiser's
+        # wall-clock stamp — heartbeat values are compared for equality
+        # only), and a wall-clock step here would age every node at once
+        # and mass-evict a healthy cluster.
+        self.clock = clock if clock is not None else time.monotonic
         self.states: dict = {}   # node name -> READY/STALE/LOST
         # Heartbeat observations: node -> (last heartbeat VALUE, when
         # this controller first saw that value, by its own clock). Aging
@@ -230,7 +236,7 @@ class NodeLifecycle:
 
     # ---- eviction ----------------------------------------------------------
 
-    def _evict_node(self, node_name: str) -> tuple:
+    def _evict_node(self, node_name: str) -> tuple[list, bool]:
         """Evict every pod bound to ``node_name``. Returns
         ``(evicted pod names, drained)`` — drained=False means a listing
         or eviction failed and the caller must retry next tick."""
@@ -271,7 +277,7 @@ class NodeLifecycle:
             evicted.extend(done)
         return evicted
 
-    def _evict_victims(self, victims: dict, lost_node: str) -> tuple:
+    def _evict_victims(self, victims: dict, lost_node: str) -> tuple[list, bool]:
         """Evict + requeue a victim set, widened to whole gangs: a gang
         with one member on a lost node is dead everywhere."""
         from kubegpu_tpu.scheduler.gang import gang_key
@@ -298,10 +304,14 @@ class NodeLifecycle:
         evicted = []
         drained = True
         for name in sorted(victims):
-            if self._evict_and_requeue(victims[name], lost_node):
+            status = self._evict_and_requeue(victims[name], lost_node)
+            if status == "evicted":
                 evicted.append(name)
                 metrics.EVICTIONS.inc()
                 self.evicted_total += 1
+                self._pending_evict.pop(name, None)
+            elif status == "gone":
+                # externally deleted: not our eviction, nothing pending
                 self._pending_evict.pop(name, None)
             else:
                 drained = False
@@ -312,30 +322,50 @@ class NodeLifecycle:
                     self._pending_evict[name] = lost_node
         return evicted, drained
 
-    def _evict_and_requeue(self, kube_pod: dict, lost_node: str) -> bool:
-        name = kube_pod["metadata"]["name"]
-        fresh = requeued_copy(kube_pod)
-        ambiguous = False  # a failed delete may still have landed
+    def _retry_write(self, call) -> tuple[str, bool]:
+        """One API write with bounded, stop()-interruptible retries
+        (stop() must not wait out a wide outage's worth of per-pod
+        backoffs; an unset event wait is a plain sleep). Returns
+        ``(status, ambiguous)``: status is ``"ok"``, ``"missing"`` (the
+        object is not there), ``"conflict"`` (it already exists), or
+        ``"failed"`` (attempts exhausted); ``ambiguous`` is True when an
+        earlier attempt errored — a subsequent "missing" may then be our
+        own failed-but-landed delete rather than an external actor's."""
+        ambiguous = False
         for attempt in range(_EVICT_ATTEMPTS):
             try:
-                self.api.delete_pod(name)
-                break
+                call()
+                return "ok", ambiguous
             except KeyError:
-                if not ambiguous:
-                    # gone before we ever touched it: deleted externally
-                    # (user tore the job down) — resurrecting it as a
-                    # pending copy is not this controller's call
-                    return True
-                break  # our own errored delete actually landed
+                return "missing", ambiguous
+            except Conflict:
+                return "conflict", ambiguous
             except Exception:
                 ambiguous = True
-                # interruptible: stop() must not wait out a wide outage's
-                # worth of per-pod backoffs (unset event == plain sleep)
                 self._stop.wait(_EVICT_BACKOFF_S * (attempt + 1))
-        else:
-            log.warning("eviction: could not delete pod %s; retrying "
-                        "next tick", name)
-            return False
+        return "failed", ambiguous
+
+    def _evict_and_requeue(self, kube_pod: dict, lost_node: str) -> str:
+        """Returns "evicted" (deleted + replacement landed), "gone"
+        (externally deleted — nothing to do, nothing to count), or
+        "failed" (retry next tick)."""
+        name = kube_pod["metadata"]["name"]
+        fresh = requeued_copy(kube_pod)
+        status, ambiguous = self._retry_write(
+            lambda: self.api.delete_pod(name))
+        if status == "missing" and not ambiguous:
+            # gone before we ever touched it: deleted externally (user
+            # tore the job down) — resurrecting it as a pending copy is
+            # not this controller's call, and it is no eviction either
+            return "gone"
+        if status in ("failed", "conflict"):
+            # "conflict" is only a success for creates; a 409 on delete
+            # (precondition/resourceVersion against a real API server)
+            # means the pod is still there — retry next tick
+            log.warning("eviction: could not delete pod %s (%s); "
+                        "retrying next tick", name, status)
+            return "failed"
+        # "ok" — or "missing" because our own errored delete landed
         # only now is the pod actually off the API — an event stamped
         # earlier (or re-stamped per retry tick) would report evictions
         # that never happened
@@ -343,28 +373,18 @@ class NodeLifecycle:
                     f"node {lost_node} lost; requeued for rescheduling",
                     kind="Pod", event_type="Warning")
         if self._create_requeued(name, fresh):
-            return True
+            return "evicted"
         # the pod is deleted and its replacement exists only in memory
         # now: park it for per-tick retry rather than dropping it
         self._pending_requeue[name] = fresh
         log.warning("eviction: pod %s deleted but re-create failed; "
                     "parked for retry", name)
-        return False
+        return "failed"
 
     def _create_requeued(self, name: str, fresh: dict) -> bool:
-        from kubegpu_tpu.cluster.apiserver import Conflict
-
-        for attempt in range(_EVICT_ATTEMPTS):
-            try:
-                self.api.create_pod(fresh)
-                return True
-            except Conflict:
-                return True  # a duplicate/earlier create already landed
-            except Exception:
-                # interruptible: stop() must not wait out a wide outage's
-                # worth of per-pod backoffs (unset event == plain sleep)
-                self._stop.wait(_EVICT_BACKOFF_S * (attempt + 1))
-        return False
+        status, _ = self._retry_write(lambda: self.api.create_pod(fresh))
+        # "conflict" = a duplicate/earlier create already landed
+        return status in ("ok", "conflict")
 
     def _flush_pending_evicts(self) -> list:
         """Retry victims whose delete failed. The per-node drain listing
@@ -379,17 +399,21 @@ class NodeLifecycle:
                 self._pending_evict.pop(name, None)  # already gone
                 continue
             except Exception:
-                continue  # API unreachable; retry next tick
+                log.debug("pending evict: get_pod(%s) failed; retrying "
+                          "next tick", name, exc_info=True)
+                continue
             if not (pod.get("spec") or {}).get("nodeName"):
                 self._pending_evict.pop(name, None)  # already pending
                 continue
-            if self._evict_and_requeue(pod, lost_node):
+            status = self._evict_and_requeue(pod, lost_node)
+            if status == "evicted":
                 landed.append(name)
                 metrics.EVICTIONS.inc()
                 self.evicted_total += 1
                 self._pending_evict.pop(name, None)
-            elif name in self._pending_requeue:
-                # the delete landed this time; the requeue path owns it now
+            elif status == "gone" or name in self._pending_requeue:
+                # externally deleted — or the delete landed this time and
+                # the requeue path owns it now
                 self._pending_evict.pop(name, None)
         return landed
 
@@ -407,18 +431,10 @@ class NodeLifecycle:
         return landed
 
     def _delete_node(self, name: str) -> None:
-        for attempt in range(_EVICT_ATTEMPTS):
-            try:
-                self.api.delete_node(name)
-                return
-            except KeyError:
-                return
-            except Exception:
-                # interruptible: stop() must not wait out a wide outage's
-                # worth of per-pod backoffs (unset event == plain sleep)
-                self._stop.wait(_EVICT_BACKOFF_S * (attempt + 1))
-        log.warning("could not delete lost node %s; will retry next tick",
-                    name)
+        status, _ = self._retry_write(lambda: self.api.delete_node(name))
+        if status in ("failed", "conflict"):
+            log.warning("could not delete lost node %s (%s); will retry "
+                        "next tick", name, status)
 
     def _event(self, name: str, reason: str, message: str,
                kind: str = "Node", event_type: str = "Warning") -> None:

@@ -22,6 +22,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Lock-order harness: the suite runs with the package's locks
+# instrumented; a lock-order inversion observed anywhere fails the run
+# (kubegpu_tpu/analysis/pytest_plugin.py). KGTPU_LOCKGRAPH=0 disables.
+pytest_plugins = ("kubegpu_tpu.analysis.pytest_plugin",)
+
 try:
     import jax
 

@@ -1,0 +1,15 @@
+"""Fixture: monotonic-time violations — wall clocks aging liveness."""
+
+import time
+from datetime import datetime
+
+
+class HeartbeatTracker:
+    def __init__(self):
+        self.last_seen = time.time()  # BAD: wall clock in liveness state
+
+    def is_stale(self, grace_s):
+        return (time.time() - self.last_seen) > grace_s  # BAD
+
+    def stamp(self):
+        return datetime.now()  # BAD: wall clock for lifecycle decisions

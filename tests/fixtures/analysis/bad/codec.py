@@ -1,0 +1,13 @@
+"""Fixture: codec-pairing violations — one-way wire protocol."""
+
+import json
+
+
+def inventory_to_annotation(meta, inventory):
+    # BAD: no annotation_to_inventory decoder exists
+    meta.setdefault("annotations", {})["x/Inventory"] = json.dumps(inventory)
+
+
+def annotation_to_lease(meta):
+    # BAD: no lease_to_annotation encoder exists
+    return json.loads(meta.get("annotations", {}).get("x/Lease", "null"))

@@ -1,0 +1,7 @@
+"""Fixture: emitting a metric that is not declared in metrics.py."""
+
+from tests.fixtures.analysis.bad import metrics
+
+
+def on_evict():
+    metrics.UNDECLARED_TOTAL.inc()  # BAD: not in the registry

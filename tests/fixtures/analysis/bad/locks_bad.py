@@ -1,0 +1,34 @@
+"""Fixture: lock-discipline and no-blocking-under-lock violations."""
+
+import threading
+import time
+
+
+class RacyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.peak = 0
+
+    def inc(self):
+        with self._lock:
+            self.count += 1
+            if self.count > self.peak:
+                self.peak = self.count
+
+    def read_unlocked(self):
+        return self.count  # BAD: guarded state read without the lock
+
+    def reset_unlocked(self):
+        self.count = 0  # BAD: guarded state written without the lock
+
+
+class SleepyHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def slow_append(self, item):
+        with self._lock:
+            time.sleep(0.5)  # BAD: blocking call while holding the lock
+            self.items.append(item)

@@ -1,0 +1,19 @@
+"""Fixture: metric-registration violations."""
+
+
+class Counter:
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+
+class Histogram:
+    def __init__(self, name):
+        self.name = name
+
+
+EVICTIONS = Counter("SchedulerEvictions")       # BAD: not snake_case
+ATTEMPTS = Counter("scheduler_attempts")        # BAD: counter without _total
+LATENCY = Histogram("scheduler_bind_latency")   # BAD: histogram without unit
+DUPLICATE = Counter("scheduler_retries_total")
+DUPLICATE2 = Counter("scheduler_retries_total")  # BAD: name declared twice

@@ -1,0 +1,19 @@
+"""Fixture: no-swallowed-exceptions violations — silently dying loops."""
+
+
+def watch_loop(poll):
+    while True:
+        try:
+            poll()
+        except Exception:
+            pass  # BAD: a persistently-failing poll is invisible
+
+
+def retry_all(items, fn):
+    out = []
+    for item in items:
+        try:
+            out.append(fn(item))
+        except Exception:
+            continue  # BAD: broad + silent inside a loop
+    return out

@@ -1,0 +1,17 @@
+"""Fixture: monotonic clocks for liveness, suppressed wall clock for a
+cross-process stamp."""
+
+import time
+
+
+class HeartbeatTracker:
+    def __init__(self):
+        self.last_seen = time.monotonic()
+
+    def is_stale(self, grace_s):
+        return (time.monotonic() - self.last_seen) > grace_s
+
+    def wire_stamp(self):
+        # the stamp crosses a process boundary: wall clock IS the protocol
+        # analysis: disable=monotonic-time
+        return time.time()

@@ -1,0 +1,12 @@
+"""Fixture: paired encoder/decoder. Uses the REAL codec pair names so the
+round-trip-test check resolves against tests/test_codec.py."""
+
+import json
+
+
+def node_info_to_annotation(meta, info):
+    meta.setdefault("annotations", {})["x/NodeInfo"] = json.dumps(info)
+
+
+def annotation_to_node_info(meta):
+    return json.loads(meta.get("annotations", {}).get("x/NodeInfo", "null"))

@@ -1,0 +1,7 @@
+"""Fixture: emitting only declared metrics."""
+
+from tests.fixtures.analysis.good import metrics
+
+
+def on_evict():
+    metrics.EVICTIONS_TOTAL.inc()

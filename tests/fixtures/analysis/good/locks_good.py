@@ -1,0 +1,36 @@
+"""Fixture: the locks_bad patterns, done right."""
+
+import threading
+import time
+
+
+class DisciplinedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.peak = 0
+
+    def inc(self):
+        with self._lock:
+            self.count += 1
+            if self.count > self.peak:
+                self.peak = self.count
+
+    def read(self):
+        with self._lock:
+            return self.count
+
+    def _bump_locked(self):
+        # *_locked convention: caller holds the lock
+        self.count += 1
+
+
+class PatientHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def slow_append(self, item):
+        time.sleep(0.5)  # blocking work happens OUTSIDE the lock
+        with self._lock:
+            self.items.append(item)

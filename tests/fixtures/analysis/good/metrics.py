@@ -1,0 +1,19 @@
+"""Fixture: a clean metric registry."""
+
+
+class Counter:
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, by=1):
+        self.value += by
+
+
+class Histogram:
+    def __init__(self, name):
+        self.name = name
+
+
+EVICTIONS_TOTAL = Counter("scheduler_evictions_total")
+BIND_LATENCY = Histogram("scheduler_bind_latency_microseconds")

@@ -1,0 +1,24 @@
+"""Fixture: loops that surface their failures."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def watch_loop(poll, stopped):
+    while not stopped():
+        try:
+            poll()
+        except ConnectionError:
+            continue  # narrow type: fine without a log
+        except Exception:
+            log.warning("poll failed", exc_info=True)
+
+
+def best_effort_cleanup(items, fn):
+    for item in items:
+        try:
+            fn(item)
+        # analysis: disable=no-swallowed-exceptions -- observability only
+        except Exception:
+            pass

@@ -1,0 +1,204 @@
+"""The analysis suite's own tests: every rule fires on its bad fixture
+and stays quiet on the good twin; the dynamic lock-order harness detects
+an intentional inversion; and the real tree is clean (the meta-test that
+makes the analyzer a gate instead of a toy)."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kubegpu_tpu.analysis import lockgraph, run_analysis
+from kubegpu_tpu.analysis.engine import AnalysisError, all_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+BAD = os.path.join(FIXTURES, "bad")
+GOOD = os.path.join(FIXTURES, "good")
+TESTS_DIR = os.path.join(REPO, "tests")
+
+RULES = ["lock-discipline", "no-blocking-under-lock", "monotonic-time",
+         "codec-pairing", "no-swallowed-exceptions", "metric-registration"]
+
+
+# ---- static rules: bad fixtures flag, good twins pass ----------------------
+
+def findings_for(root, rule=None):
+    select = [rule] if rule else None
+    return run_analysis([root], select=select, tests_dir=TESTS_DIR)
+
+
+def test_rule_registry_is_complete():
+    assert sorted(r.name for r in all_rules()) == sorted(RULES)
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_every_rule_fires_on_bad_fixtures(rule):
+    hits = findings_for(BAD, rule)
+    assert hits, f"rule {rule} found nothing in the bad fixture tree"
+    assert all(f.rule == rule for f in hits)
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_no_rule_fires_on_good_fixtures(rule):
+    assert findings_for(GOOD, rule) == []
+
+
+def test_lock_discipline_details():
+    hits = findings_for(BAD, "lock-discipline")
+    lines = {f.line for f in hits}
+    by_msg = " ".join(f.message for f in hits)
+    assert "RacyCounter.count" in by_msg
+    assert len(lines) == 2  # the unlocked read AND the unlocked write
+
+
+def test_locked_suffix_convention_is_exempt():
+    hits = findings_for(GOOD, "lock-discipline")
+    assert hits == []  # _bump_locked in the good fixture must not flag
+
+
+def test_suppression_requires_matching_rule(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import time\n"
+        "# analysis: disable=codec-pairing -- wrong rule, must NOT silence\n"
+        "t = time.time()\n")
+    hits = run_analysis([str(src)], select=["monotonic-time"])
+    assert len(hits) == 1
+    src.write_text(
+        "import time\n"
+        "# analysis: disable=monotonic-time -- right rule\n"
+        "t = time.time()\n")
+    assert run_analysis([str(src)], select=["monotonic-time"]) == []
+
+
+def test_disable_file_scope(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "# analysis: disable-file=monotonic-time -- whole-file waiver\n"
+        "import time\n"
+        "a = time.time()\n"
+        "b = time.time()\n")
+    assert run_analysis([str(src)], select=["monotonic-time"]) == []
+
+
+def test_unknown_rule_is_an_error():
+    with pytest.raises(AnalysisError):
+        run_analysis([GOOD], select=["not-a-rule"])
+
+
+# ---- the meta-test: the real tree is clean ---------------------------------
+
+def test_repo_tree_is_clean_via_cli():
+    """`python -m kubegpu_tpu.analysis kubegpu_tpu` exits 0 on the repo."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.analysis", "kubegpu_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no findings" in proc.stdout
+
+
+def test_bad_fixtures_fail_via_cli_with_all_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.analysis",
+         os.path.join("tests", "fixtures", "analysis", "bad")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for rule in RULES:
+        assert f"[{rule}]" in proc.stdout, f"{rule} did not fire via CLI"
+
+
+# ---- dynamic harness: lock-order inversions --------------------------------
+
+def test_lockgraph_detects_intentional_inversion():
+    """A -> B in one thread, B -> A in another: the classic inversion.
+    Uses a private graph so the suite-wide gate stays clean."""
+    graph = lockgraph.LockGraph()
+    lock_a = lockgraph.InstrumentedLock(
+        threading.Lock(), "fixture.py:1", graph)
+    lock_b = lockgraph.InstrumentedLock(
+        threading.Lock(), "fixture.py:2", graph)
+
+    def ab():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def ba():
+        with lock_b:
+            with lock_a:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba)
+    t2.start()
+    t2.join()
+    cycles = graph.cycles()
+    assert cycles, "inversion not detected"
+    assert {"fixture.py:1", "fixture.py:2"} <= set(cycles[0])
+    assert "lock-order inversion" in graph.render_cycles()
+
+
+def test_lockgraph_consistent_order_is_clean():
+    graph = lockgraph.LockGraph()
+    lock_a = lockgraph.InstrumentedLock(
+        threading.Lock(), "fixture.py:1", graph)
+    lock_b = lockgraph.InstrumentedLock(
+        threading.Lock(), "fixture.py:2", graph)
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert graph.cycles() == []
+    assert ("fixture.py:1", "fixture.py:2") in graph.edges
+
+
+def test_lockgraph_rlock_reentry_is_not_an_edge():
+    graph = lockgraph.LockGraph()
+    rl = lockgraph.InstrumentedLock(threading.RLock(), "fixture.py:9", graph)
+    with rl:
+        with rl:
+            pass
+    assert graph.edges == {}
+    assert graph.cycles() == []
+
+
+def test_instrumented_condition_wait_keeps_bookkeeping():
+    """Condition round trip through a package-created (and therefore,
+    under the plugin, instrumented) lock: blocking pop waits, push
+    notifies, and the per-thread held stack survives the release/
+    reacquire cycle inside Condition.wait()."""
+    from kubegpu_tpu.scheduler.queue import SchedulingQueue
+
+    q = SchedulingQueue()
+    got = []
+
+    def popper():
+        got.append(q.pop(timeout=5))
+
+    t = threading.Thread(target=popper)
+    t.start()
+    q.push({"metadata": {"name": "p0"}, "spec": {}})
+    t.join(timeout=5)
+    assert got and got[0]["metadata"]["name"] == "p0"
+    # a second pop on the same thread still works (held stack not corrupt)
+    assert q.pop(timeout=0.05) is None
+
+
+def test_plugin_instruments_package_locks_when_enabled():
+    """Under the tier-1 run the conftest plugin has installed the patch:
+    package-created locks are instrumented, stdlib locks are not."""
+    if not lockgraph.installed():
+        pytest.skip("lockgraph plugin disabled (KGTPU_LOCKGRAPH=0)")
+    from kubegpu_tpu.scheduler.gang import GangBuffer
+
+    buf = GangBuffer()
+    assert isinstance(buf._lock, lockgraph.InstrumentedLock)
+    import queue as stdlib_queue
+
+    q = stdlib_queue.Queue()
+    assert not isinstance(q.mutex, lockgraph.InstrumentedLock)

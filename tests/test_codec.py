@@ -162,3 +162,51 @@ def test_parse_quantity_ki_suffix_and_bad_suffix():
         parse_quantity("1Xi")
     with pytest.raises(ValueError):
         parse_quantity("--5")
+
+
+def test_heartbeat_annotation_roundtrip():
+    meta = {"name": "host0"}
+    codec.heartbeat_to_annotation(meta, 1234.5678)
+    decoded = codec.annotation_to_heartbeat(meta)
+    assert decoded == 1234.568  # stamped at millisecond precision
+    assert codec.annotation_to_heartbeat({"name": "bare"}) is None
+    # an unparseable stamp means "liveness not tracked", never an error
+    broken = {"annotations": {codec.NODE_HEARTBEAT_ANNOTATION: "bogus{"}}
+    assert codec.annotation_to_heartbeat(broken) is None
+
+
+def test_chip_health_annotation_roundtrip():
+    health = {"tpu-0.0.0": "healthy", "tpu-0.0.1": "degraded"}
+    meta = {"name": "host0"}
+    codec.chip_health_to_annotation(meta, health)
+    assert codec.annotation_to_chip_health(meta) == health
+    assert codec.annotation_to_chip_health({"name": "bare"}) == {}
+    broken = {"annotations": {codec.NODE_CHIP_HEALTH_ANNOTATION: "[1,2]"}}
+    assert codec.annotation_to_chip_health(broken) == {}
+
+
+def test_pod_info_annotation_raw_roundtrip():
+    """annotation_to_pod_info is the exact inverse of pod_info_to_annotation
+    (no spec merge, no invalidation) — the persisted decision reads back
+    byte-identical."""
+    pod = PodInfo(
+        name="p9",
+        node_name="host3",
+        requests={"alpha.tpu/numchips": 2},
+        running_containers={
+            "main": ContainerInfo(
+                requests={"alpha.tpu/numchips": 2},
+                dev_requests={"alpha/grpresource/tpu/1/chips": 1},
+                allocate_from={
+                    "alpha/grpresource/tpu/1/chips":
+                        "alpha/grpresource/tpu/1.0.0/chips"
+                },
+            )
+        },
+    )
+    meta = {"name": "p9"}
+    codec.pod_info_to_annotation(meta, pod)
+    decoded = codec.annotation_to_pod_info(meta)
+    assert decoded.to_json() == pod.to_json()
+    assert codec.annotation_to_pod_info({"name": "bare"}).to_json() == \
+        PodInfo().to_json()

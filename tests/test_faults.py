@@ -295,3 +295,39 @@ def test_node_vanishes_mid_pass():
     assert drive_until_bound(api, sched, "p1")
     bound = api.get_pod("p1")["spec"]["nodeName"]
     assert bound != tripped["yes"]
+
+
+def test_retried_delete_with_lost_reply_reads_as_success(monkeypatch):
+    """A DELETE that lands but loses its reply retries and gets 404 —
+    that 404 means "already deleted (possibly by us)", NOT a clean
+    external deletion: the client must report success, so the lifecycle
+    controller still requeues the evicted pod. A genuine first-attempt
+    404 still raises NotFound."""
+    from kubegpu_tpu.cluster.apiserver import NotFound
+
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    try:
+        api.create_pod({"metadata": {"name": "p1"}, "spec": {}})
+        real = urllib.request.urlopen
+        state = {"armed": True}
+
+        def lose_first_delete_reply(req, timeout=None):
+            if req.get_method() == "DELETE" and state["armed"]:
+                state["armed"] = False
+                real(req, timeout=timeout).read()  # the delete LANDS
+                raise ConnectionResetError("reply lost")  # ...reply lost
+            return real(req, timeout=timeout)
+
+        monkeypatch.setattr(urllib.request, "urlopen",
+                            lose_first_delete_reply)
+        client.delete_pod("p1")  # must NOT raise: our delete landed
+        with pytest.raises(NotFound):
+            api.get_pod("p1")
+        # a clean first-attempt 404 still surfaces as NotFound
+        with pytest.raises(NotFound):
+            client.delete_pod("never-existed")
+    finally:
+        client.close()
+        server.shutdown()

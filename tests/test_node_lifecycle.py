@@ -409,3 +409,55 @@ def test_gang_rebinds_on_survivors_after_node_loss_under_chaos():
     assert len(firsts) == 1 and len(finals) == 1, (firsts, finals)
     for _, _, recovery_s in runs:
         assert recovery_s > 0.0  # a real, reported recovery time
+
+
+def test_externally_deleted_pod_is_not_resurrected_by_eviction():
+    """A user tearing a pod down in the window between the controller's
+    victim listing and its delete must NOT get the pod recreated as a
+    pending copy — delete_pod signals not-found, and a clean (never-
+    errored) not-found means an external actor owns that deletion."""
+    import pytest as _pytest
+
+    from kubegpu_tpu.cluster.apiserver import NotFound
+
+    clock = {"now": 1000.0}
+    api = InMemoryAPIServer()
+    advs = {}
+    for i, origin in enumerate([(0, 0, 0), (2, 0, 0)]):
+        advs[f"host{i}"], _ = _mesh_host(api, f"host{i}", origin,
+                                         clock=lambda: clock["now"])
+    sched = make_scheduler(api)
+    try:
+        api.create_pod(tpu_pod("p1", 2))
+        assert drive_until_bound(api, sched, "p1")
+        victim_node = api.get_pod("p1")["spec"]["nodeName"]
+        survivor = next(n for n in advs if n != victim_node)
+        lc = NodeLifecycle(api, stale_after_s=2.0, lost_after_s=5.0,
+                           clock=lambda: clock["now"])
+        lc.tick()
+        clock["now"] = 1010.0
+        advs[survivor].advertise_once()
+        # user tears the pod down between the listing and the delete:
+        # intercept the controller's listing to delete p1 right after
+        real_list = api.list_pods
+
+        def list_then_user_deletes(node_name=None):
+            out = real_list(node_name=node_name)
+            if any(p["metadata"]["name"] == "p1" for p in out):
+                api.delete_pod("p1")  # the external actor
+            return out
+
+        api.list_pods = list_then_user_deletes
+        out = lc.tick()
+        api.list_pods = real_list
+        assert out["states"][victim_node] == LOST
+        # the controller must not have resurrected the user's deletion
+        assert "p1" not in out["evicted"]
+        with _pytest.raises(NotFound):
+            api.get_pod("p1")
+        # and nothing is parked for retry either
+        assert lc.tick()["evicted"] == []
+        with _pytest.raises(NotFound):
+            api.get_pod("p1")
+    finally:
+        sched.stop()
