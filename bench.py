@@ -403,20 +403,40 @@ def config_gang_preempt():
     return lat
 
 
-def config6_scale():
+def config6_scale(n_hosts: int = 64, n_pods: int = 48):
     """Beyond the BASELINE set: a 64-host / 256-chip cluster under a
     sustained mixed-size pod stream — scheduler throughput at cluster
-    scale (parallel fit + equivalence cache + slim snapshots earn their
-    keep here). Reported separately; the headline p50 stays defined over
-    the five BASELINE configs."""
-    c = Cluster([v5p_host_inventory() for _ in range(64)])
+    scale (parallel fit + equivalence cache + generation-cached cycle
+    snapshots earn their keep here). Reported separately; the headline
+    p50 stays defined over the five BASELINE configs. Parameterized so
+    the CI smoke job can run the same config at tiny N."""
+    c = Cluster([v5p_host_inventory() for _ in range(n_hosts)])
     lat = []
     sizes = [1, 2, 4, 1, 2, 1, 4, 2]
-    for i in range(48):
+    for i in range(n_pods):
         t = c.schedule_timed(make_pod(f"s{i}", sizes[i % len(sizes)]))
         assert t is not None
         lat.append(t)
     return lat
+
+
+def config_throughput(n_hosts: int = 256, n_pods: int = 360):
+    """Steady-state scheduler throughput: a stream of mixed pod classes
+    (three sizes cycling) submitted up front against an n_hosts cluster,
+    drained in one loop — pods per second of pure schedule+bind work.
+    This is the regression gate for the incremental hot path: every
+    placement invalidates exactly one node, so the fit memo must hold the
+    per-pod cost near O(changed nodes), not O(cluster)."""
+    c = Cluster([v5p_host_inventory() for _ in range(n_hosts)])
+    sizes = [1, 2, 4]
+    for i in range(n_pods):
+        c.api.create_pod(make_pod(f"t{i}", sizes[i % len(sizes)]))
+    t0 = time.perf_counter()
+    c.sched.run_until_idle()
+    wall = time.perf_counter() - t0
+    for i in range(n_pods):
+        assert c.api.get_pod(f"t{i}")["spec"].get("nodeName"), f"t{i}"
+    return round(n_pods / wall, 1)
 
 
 def config7_scale256():
@@ -1173,6 +1193,11 @@ def workload_metrics() -> dict:
     return out
 
 
+def _p95_ms(lat) -> float:
+    s = sorted(lat)
+    return round(s[int(0.95 * (len(s) - 1))] * 1e3, 3)
+
+
 def main():
     metrics.reset_all()
     configs = [config1, config2, config3, config4, config5]
@@ -1190,12 +1215,13 @@ def main():
         per_config[f"config{i}_p50_ms"] = round(
             statistics.median(lat) * 1e3, 3)
     p50_ms = statistics.median(all_lat) * 1e3
+    # p50 alongside p95 for every scale_*/preempt_* config: the tail is
+    # where cold caches and victim searches show, and the incremental
+    # hot path is regression-gated on it
     scale_lat = config6_scale()
     per_config["scale_64node_p50_ms"] = round(
         statistics.median(scale_lat) * 1e3, 3)
-    # the tail is where cold caches show: first pod of a class pays the
-    # allocator search; the shape cache makes that once-per-class, not
-    # once-per-node
+    per_config["scale_64node_p95_ms"] = _p95_ms(scale_lat)
     per_config["scale_64node_max_ms"] = round(max(scale_lat) * 1e3, 3)
     http_lat = config_http()
     per_config["http_transport_p50_ms"] = round(
@@ -1203,15 +1229,19 @@ def main():
     preempt_lat = config_preempt()
     per_config["preempt_64node_p50_ms"] = round(
         statistics.median(preempt_lat) * 1e3, 3)
+    per_config["preempt_64node_p95_ms"] = _p95_ms(preempt_lat)
     gang_preempt_lat = config_gang_preempt()
     per_config["gang_preempt_64node_p50_ms"] = round(
         statistics.median(gang_preempt_lat) * 1e3, 3)
+    per_config["gang_preempt_64node_p95_ms"] = _p95_ms(gang_preempt_lat)
     s256 = sorted(config7_scale256())
     per_config["scale_256node_p50_ms"] = round(
         statistics.median(s256) * 1e3, 3)
-    per_config["scale_256node_p95_ms"] = round(
-        s256[int(0.95 * (len(s256) - 1))] * 1e3, 3)
+    per_config["scale_256node_p95_ms"] = _p95_ms(s256)
     per_config["scale_256node_max_ms"] = round(s256[-1] * 1e3, 3)
+    per_config["sched_throughput_pods_per_s"] = config_throughput()
+    per_config["fit_cache_hits_total"] = metrics.FIT_CACHE_HITS.value
+    per_config["fit_cache_misses_total"] = metrics.FIT_CACHE_MISSES.value
     # Robustness trajectory: kill one node agent of a 2-node gang under
     # the seeded chaos transport; time from agent death to the gang fully
     # rebound on surviving nodes (detection grace included) with zero
@@ -1241,5 +1271,29 @@ def main():
     print(json.dumps(result))
 
 
+def smoke():
+    """CI smoke: the scale config + throughput stream at tiny N,
+    CPU-only — proves the perf plumbing (cycle snapshots, fit memo,
+    adaptive fit pool, metrics) end to end and fails on any crash or a
+    dead cache. Prints one JSON line like main()."""
+    metrics.reset_all()
+    lat = config6_scale(n_hosts=8, n_pods=12)   # 25 of 32 chips
+    throughput = config_throughput(n_hosts=16, n_pods=24)  # 56 of 64
+    while _LIVE_CLUSTERS:
+        _LIVE_CLUSTERS.pop().close()
+    hits = metrics.FIT_CACHE_HITS.value
+    assert hits > 0, "fit memo never hit during the smoke stream"
+    print(json.dumps({
+        "metric": "bench_smoke",
+        "scale_8node_p50_ms": round(statistics.median(lat) * 1e3, 3),
+        "scale_8node_p95_ms": _p95_ms(lat),
+        "sched_throughput_pods_per_s": throughput,
+        "fit_cache_hits_total": hits,
+        "fit_cache_misses_total": metrics.FIT_CACHE_MISSES.value,
+        "fit_cache_invalidations_total":
+            metrics.FIT_CACHE_INVALIDATIONS.value,
+    }))
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(smoke() if "--smoke" in sys.argv[1:] else main())

@@ -71,12 +71,19 @@ class InMemoryAPIServer:
 
     def patch_node_metadata(self, name: str, metadata_patch: dict) -> dict:
         """Strategic-merge-patch of node metadata
-        (`kubeinterface.go:145-158`)."""
+        (`kubeinterface.go:145-158`). A patch that changes nothing
+        delivers NO watch event: every node event is an invalidation
+        source for the scheduler's fit memo (and requeues unschedulable
+        pods), so an idempotent re-advertise must not masquerade as a
+        node change."""
         with self._lock:
             if name not in self._nodes:
                 raise NotFound(f"node {name}")
-            _merge(self._nodes[name].setdefault("metadata", {}), metadata_patch)
-            self._notify_locked("node", "modified", self._nodes[name])
+            meta = self._nodes[name].setdefault("metadata", {})
+            before = copy.deepcopy(meta)
+            _merge(meta, metadata_patch)
+            if meta != before:
+                self._notify_locked("node", "modified", self._nodes[name])
             return copy.deepcopy(self._nodes[name])
 
     def delete_node(self, name: str) -> None:

@@ -18,6 +18,7 @@ import argparse
 import json
 import time
 
+from kubegpu_tpu import metrics
 from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
 from kubegpu_tpu.core import codec, grammar
 from kubegpu_tpu.core.types import ContainerInfo, PodInfo
@@ -42,6 +43,15 @@ def make_pod(name, numchips, pod_requests=None, hbm=0):
     return {"metadata": meta,
             "spec": {"containers": [{"name": "main",
                                      "resources": {"requests": {"cpu": "1"}}}]}}
+
+
+def _fit_cache_summary() -> dict:
+    """Fit-memo effectiveness of the run (metrics.py counters): a dead
+    cache (zero hits on a multi-pod workload) is a perf regression the
+    summary makes visible without a profiler."""
+    return {"hits": metrics.FIT_CACHE_HITS.value,
+            "misses": metrics.FIT_CACHE_MISSES.value,
+            "invalidations": metrics.FIT_CACHE_INVALIDATIONS.value}
 
 
 def _gang_chips(api, name):
@@ -152,6 +162,7 @@ def run_chaos_scenario(seed: int = 0, lost_after_s: float = 0.9,
                 "first_placement": first,
                 "final_placement": final,
                 "evicted_pods": lifecycle.evicted_total,
+                "fit_cache": _fit_cache_summary(),
                 "chaos_faults": {f"{c}:{k}": n for (c, k), n
                                  in sorted(net.faults.items())}}
     finally:
@@ -251,14 +262,19 @@ def main(argv=None) -> int:
                 .get("volumeName", "<unbound>")
         rows.append(row)
 
+    fit_cache = _fit_cache_summary()
     if args.json:
-        print(json.dumps(rows, indent=2))
+        print(json.dumps({"placements": rows, "fit_cache": fit_cache},
+                         indent=2))
     else:
         width = max(len(r["pod"]) for r in rows) + 2
         print(f"{'POD':<{width}}{'NODE':<10}{'CHIPS':<28}{'BOUNDS':<8}VOLUME")
         for r in rows:
             print(f"{r['pod']:<{width}}{r['node']:<10}{r['chips']:<28}"
                   f"{r['bounds']:<8}{r.get('volume', '')}")
+        print(f"fit cache: {fit_cache['hits']} hits / "
+              f"{fit_cache['misses']} misses / "
+              f"{fit_cache['invalidations']} invalidations")
     sched.stop()
     return 0
 

@@ -101,6 +101,15 @@ NATIVE_FALLBACKS = Counter("allocator_native_fallbacks_total")
 NODE_READY = Gauge("scheduler_node_ready")
 NODE_LOST = Counter("scheduler_node_lost_total")
 EVICTIONS = Counter("scheduler_evictions_total")
+# Scheduling hot path (scheduler/cache.py + scheduler/equivalence.py):
+# fit-memo effectiveness. Hits/misses count equivalence-cache lookups in
+# the filter pass; invalidations count per-node generation bumps — every
+# fit-relevant node change (watch update, pod charge/release,
+# assume/forget, eviction) retires that node's memoized verdicts and its
+# cached cycle snapshot.
+FIT_CACHE_HITS = Counter("fit_cache_hits_total")
+FIT_CACHE_MISSES = Counter("fit_cache_misses_total")
+FIT_CACHE_INVALIDATIONS = Counter("fit_cache_invalidations_total")
 
 
 def reset_all() -> None:
@@ -108,7 +117,8 @@ def reset_all() -> None:
     for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY):
         h.__init__(h.name)
     for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS,
-              INTERNAL_ERRORS, NATIVE_FALLBACKS, NODE_LOST, EVICTIONS):
+              INTERNAL_ERRORS, NATIVE_FALLBACKS, NODE_LOST, EVICTIONS,
+              FIT_CACHE_HITS, FIT_CACHE_MISSES, FIT_CACHE_INVALIDATIONS):
         c.__init__(c.name)
     NODE_READY.__init__(NODE_READY.name)
 

@@ -12,9 +12,11 @@ cache entirely from node/pod annotations (SURVEY.md §6 checkpoint/resume).
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 
+from kubegpu_tpu import metrics
 from kubegpu_tpu.core import codec
 from kubegpu_tpu.core.types import NodeInfo
 from kubegpu_tpu.scheduler import interpod
@@ -34,6 +36,7 @@ class CacheCorruption(RuntimeError):
 class CachedNode:
     def __init__(self, kube_node: dict):
         self.kube_node = kube_node
+        self.fit_fingerprint: str = ""
         self.node_ex: NodeInfo = NodeInfo()
         self.pod_names: set = set()
         self.requested_core: dict = {}  # prechecked (cpu/memory) accounting
@@ -76,6 +79,26 @@ class NodeSnapshot:
         self.core_allocatable = cached.core_allocatable()
 
 
+def _fit_fingerprint(kube_node: dict) -> str:
+    """Stable digest of every node field a fit/score decision reads —
+    labels, annotations (device inventory and chip health included),
+    taints, unschedulable, conditions, allocatable, images — EXCLUDING
+    the liveness heartbeat stamp. The advertiser re-patches the heartbeat
+    every pass; without this carve-out every heartbeat would bump the
+    node's fit generation and the memo could never survive a single
+    advertise interval on a live cluster."""
+    meta = kube_node.get("metadata") or {}
+    spec = kube_node.get("spec") or {}
+    status = kube_node.get("status") or {}
+    ann = {k: v for k, v in (meta.get("annotations") or {}).items()
+           if k != codec.NODE_HEARTBEAT_ANNOTATION}
+    return json.dumps(
+        (meta.get("labels") or {}, ann, spec.get("taints") or [],
+         spec.get("unschedulable"), status.get("conditions") or [],
+         status.get("allocatable") or {}, status.get("images") or []),
+        sort_keys=True, default=str)
+
+
 def _slim_node_copy(kube_node: dict) -> dict:
     """Copy only what predicates/priorities read (labels, annotations,
     taints, unschedulable, conditions, allocatable). The snapshot runs on
@@ -115,13 +138,51 @@ class SchedulerCache:
         self._charged: set = set()      # pod names currently accounted
         self._affinity_pods = 0         # placed pods carrying ANY pod(Anti)Affinity
         self._required_anti_pods = 0    # subset with REQUIRED anti-affinity
+        # Per-node fit generation: bumped on every fit-relevant change
+        # (set_node with changed state, add/remove/assume/forget/expire of
+        # a pod, node delete). The memoized fit verdicts AND the cycle
+        # snapshots below are keyed by it — bump = both retired at once.
+        # Entries deliberately outlive their node so a delete + re-add
+        # cannot restart the counter and resurrect stale verdicts.
+        self._gen: dict = {}            # node name -> generation
+        self._snap: dict = {}           # node name -> (generation, NodeSnapshot)
         self.equivalence = EquivalenceCache()
+
+    # ---- generations / invalidation ----------------------------------------
+
+    def _invalidate_locked(self, name: str, record: bool = True) -> None:
+        # Always called with self._lock held: the bump must be atomic with
+        # the state change it publishes. ``record=False`` keeps first-time
+        # node registration out of fit_cache_invalidations_total — a
+        # fresh node retires nothing.
+        self._gen[name] = self._gen.get(name, 0) + 1
+        self._snap.pop(name, None)
+        if record:
+            metrics.FIT_CACHE_INVALIDATIONS.inc()
+
+    def _invalidate_all_locked(self) -> None:
+        # Only LIVE nodes: a departed node's retained generation already
+        # exceeds anything an in-flight pass captured before its delete
+        # (remove_node bumped it), so stale stores for it can never be
+        # served — bumping the dead entries would only make this flush
+        # O(every node name ever seen) under the cache lock.
+        for name in self.nodes:
+            self._gen[name] = self._gen.get(name, 0) + 1
+        self._snap.clear()
+        metrics.FIT_CACHE_INVALIDATIONS.inc(len(self.nodes))
+
+    def node_generation(self, name: str) -> int:
+        with self._lock:
+            return self._gen.get(name, 0)
 
     # ---- nodes (`node_info.go:456-492`) ------------------------------------
 
     def set_node(self, kube_node: dict) -> None:
         """Add/update a node: decode its device annotation (preserving the
-        in-memory ``used``) and (re-)register with the device scheduler."""
+        in-memory ``used``) and (re-)register with the device scheduler.
+        The fit generation bumps only when fit-relevant state actually
+        changed — a heartbeat re-patch delivered through the watch must
+        not retire the node's memoized verdicts."""
         with self._lock:
             name = kube_node["metadata"]["name"]
             cached = self.nodes.get(name)
@@ -139,15 +200,26 @@ class SchedulerCache:
                 cached.kube_node = kube_node
             cached.node_ex = node_ex
             self.device_scheduler.add_node(name, node_ex)
+            fingerprint = _fit_fingerprint(kube_node)
+            changed = old_labels is None or \
+                fingerprint != cached.fit_fingerprint
+            cached.fit_fingerprint = fingerprint
+            if not changed:
+                return
+            if old_labels is None:
+                # first registration: bump (a re-added name must move past
+                # any generation an old pass captured) but don't count it
+                # as an invalidation — a fresh node retires nothing
+                self._invalidate_locked(name, record=False)
+                return
             new_labels = (kube_node.get("metadata") or {}).get("labels") or {}
-            if self._required_anti_pods and old_labels is not None \
-                    and old_labels != new_labels:
+            if self._required_anti_pods and old_labels != new_labels:
                 # topology-domain labels moved: the symmetry veto from
                 # placed required-anti-affinity pods may flip memoized
                 # verdicts on OTHER nodes sharing the domain
-                self.equivalence.invalidate_all()
+                self._invalidate_all_locked()
             else:
-                self.equivalence.invalidate_node(name)
+                self._invalidate_locked(name)
 
     def remove_node(self, name: str) -> None:
         with self._lock:
@@ -165,10 +237,14 @@ class SchedulerCache:
                     for aff in cached.pod_affinity.values())
                 self._required_anti_pods -= departed_anti
                 self.device_scheduler.remove_node(name)
+                # the departed node's own generation must always move —
+                # it is no longer in self.nodes, so the all-flush below
+                # would skip it and a re-add could resume at a generation
+                # an in-flight pass still holds
+                self._invalidate_locked(name)
                 if departed_anti:
-                    self.equivalence.invalidate_all()
-                else:
-                    self.equivalence.invalidate_node(name)
+                    self._invalidate_all_locked()
+                self.equivalence.drop_node(name)
 
     def get_node(self, name: str) -> CachedNode | None:
         with self._lock:
@@ -258,9 +334,9 @@ class SchedulerCache:
             # invalidation is not enough (the upstream equivalence-cache
             # affinity bug class). Preferred-only terms never flip a
             # predicate verdict, so they don't pay this flush.
-            self.equivalence.invalidate_all()
+            self._invalidate_all_locked()
         else:
-            self.equivalence.invalidate_node(node_name)
+            self._invalidate_locked(node_name)
 
     def assume_pod(self, kube_pod: dict, node_name: str,
                    now: float | None = None) -> None:
@@ -277,12 +353,46 @@ class SchedulerCache:
             self._assumed[name] = (node_name, deadline, kube_pod)
 
     def snapshot_node(self, name: str):
-        """``NodeSnapshot`` for lock-free fit/score evaluation, or None."""
+        """A PRIVATE ``NodeSnapshot`` for lock-free fit/score evaluation,
+        or None. Always freshly built: callers (preemption simulation,
+        nominated-demand charging) may mutate it freely."""
         with self._lock:
             cached = self.nodes.get(name)
             if cached is None:
                 return None
             return NodeSnapshot(cached)
+
+    def cycle_snapshot(self) -> tuple:
+        """``(names, snapshots, generations)`` for one scheduling pass
+        under ONE lock acquisition — the per-pod-per-node ``snapshot_node``
+        storm was the hot loop's biggest fixed cost at 256 nodes.
+
+        Snapshots are generation-cached and SHARED across passes: a node
+        whose generation has not moved hands out the same object it did
+        for the previous pod, so a stream of identical pods re-snapshots
+        only the nodes that changed. Callers must treat these snapshots
+        as immutable; anything that needs to mutate one (nominated-demand
+        charging, eviction simulation) takes a private ``snapshot_node``.
+
+        Generations are captured atomically with the snapshots, BEFORE
+        the caller builds the cluster-wide inter-pod metadata: a watcher
+        invalidation racing the metadata build moves the live generation,
+        so the eventual memo store lands under a generation that is never
+        served again instead of poisoning the cache (the upstream
+        equivalence-cache race)."""
+        with self._lock:
+            names = sorted(self.nodes)
+            snaps: dict = {}
+            gens: dict = {}
+            for name in names:
+                gen = self._gen.get(name, 0)
+                gens[name] = gen
+                entry = self._snap.get(name)
+                if entry is None or entry[0] != gen:
+                    entry = (gen, NodeSnapshot(self.nodes[name]))
+                    self._snap[name] = entry
+                snaps[name] = entry[1]
+            return names, snaps, gens
 
     def has_affinity_pods(self) -> bool:
         """Fast gate: any placed pod carrying pod(Anti)Affinity? Lets the

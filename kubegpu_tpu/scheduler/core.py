@@ -24,7 +24,8 @@ from kubegpu_tpu import metrics
 from kubegpu_tpu.core import codec, grammar
 from kubegpu_tpu.scheduler import factory, interpod, predicates, priorities
 from kubegpu_tpu.scheduler.cache import SchedulerCache
-from kubegpu_tpu.scheduler.equivalence import equivalence_class
+from kubegpu_tpu.scheduler.equivalence import (devolumed_class,
+                                               equivalence_class)
 from kubegpu_tpu.scheduler.queue import SchedulingQueue
 
 log = logging.getLogger(__name__)
@@ -87,6 +88,18 @@ class GenericScheduler:
         self._nom_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
                                         thread_name_prefix="fit")
+        # Memo-safety gate (see predicates.py): every configured predicate
+        # must declare what it reads, or the equivalence memo stays off
+        # for every pod — the generation counters can only invalidate
+        # reads they know about. The volume-reading subset is what the
+        # devolumed-split path re-runs against the real pod.
+        self._memo_safe = all(
+            getattr(fn, "reads", None) is not None
+            for _, fn in self.algorithm.predicates)
+        self._volume_predicates = [
+            (name, fn) for name, fn in self.algorithm.predicates
+            if getattr(fn, "reads", factory.VOLUME_READS)
+            & factory.VOLUME_READS]
 
     def _parallel_map(self, fn, items):
         """Order-preserving pool map in node-list chunks, not one task
@@ -94,9 +107,17 @@ class GenericScheduler:
         Executor.map dominated the (mostly GIL-serialized) per-node work
         — ~9.7k futures per preemption bench run, ~0.6 s of pure
         dispatch. One chunk per worker keeps the native-allocator calls
-        (which DO release the GIL) running concurrently."""
+        (which DO release the GIL) running concurrently.
+
+        The effective width adapts to the live item count each cycle:
+        a 2-node cluster submits 2 chunks, so the (lazily-spawned) pool
+        never grows past 2 threads for it — 16 workers for a handful of
+        nodes was pure dispatch overhead."""
         items = list(items)
-        n = max(1, -(-len(items) // self.parallelism))
+        width = min(self.parallelism, len(items))
+        if width <= 1:
+            return [fn(x) for x in items]
+        n = -(-len(items) // width)
         chunks = [items[i:i + n] for i in range(0, len(items), n)]
         out = []
         for part in self._pool.map(lambda c: [fn(x) for x in c], chunks):
@@ -153,24 +174,30 @@ class GenericScheduler:
         with self._nom_lock:
             self._nominations.pop(pod_name, None)
 
-    def _nominated_pods_on(self, node_name: str, exclude: str,
-                           min_priority: int) -> list:
-        """Live nominations on ``node_name`` that an incoming pod of
-        ``min_priority`` must respect: only nominated pods of >= priority
-        hold their room (a strictly higher-priority pod may take it, like
-        upstream), and a pod never blocks on its own nomination."""
+    def _nominations_by_node(self, exclude: str, min_priority: int) -> dict:
+        """Live nominations grouped by node in ONE lock pass. The filter
+        pass consults this instead of `_nominated_pods_on` per node — a
+        lock round per node per pod from 16 workers convoyed here."""
         now = time.monotonic()
-        out = []
+        out: dict = {}
         with self._nom_lock:
             for name in list(self._nominations):
                 node, expires, pod = self._nominations[name]
                 if expires <= now:
                     del self._nominations[name]
                     continue
-                if node == node_name and name != exclude and \
-                        _pod_priority(pod) >= min_priority:
-                    out.append(pod)
+                if name != exclude and _pod_priority(pod) >= min_priority:
+                    out.setdefault(node, []).append(pod)
         return out
+
+    def _nominated_pods_on(self, node_name: str, exclude: str,
+                           min_priority: int) -> list:
+        """Live nominations on ``node_name`` that an incoming pod of
+        ``min_priority`` must respect: only nominated pods of >= priority
+        hold their room (a strictly higher-priority pod may take it, like
+        upstream), and a pod never blocks on its own nomination."""
+        return self._nominations_by_node(exclude, min_priority) \
+            .get(node_name, [])
 
     def _charge_nominated(self, nominated: list, snap) -> None:
         """Charge nominated pods' demand onto a (private) fit snapshot:
@@ -233,55 +260,99 @@ class GenericScheduler:
 
     def _fits_on_node(self, kube_pod: dict, node_name: str,
                       eq_class: str | None = None,
-                      out_snaps: dict | None = None,
                       meta=_AUTO_META, pod_info_get=None,
                       device_class=_AUTO_META, eq_gen: int | None = None,
-                      vol=_AUTO_META):
+                      vol=_AUTO_META, snap=None, vol_split=None,
+                      nominated=None, memo_checked=False, sibling_hit=None,
+                      out_snaps=None):
         """The full predicate chain against a point-in-time snapshot so
         concurrent watcher mutations of node usage cannot tear mid-fit.
         Order mirrors the reference providers: cheap node gates first, the
-        device predicate (`devicepredicate.go:11-26`) last. A snapshot
-        taken here is stashed in ``out_snaps`` so the scoring pass can
-        reuse it instead of re-snapshotting."""
-        nominated = self._nominated_pods_on(
-            node_name, exclude=kube_pod["metadata"]["name"],
-            min_priority=_pod_priority(kube_pod))
-        if nominated:
-            # nomination-dependent verdicts must not be memoized: the
-            # reservation expires outside any node event
-            eq_class = None
-        if eq_class is not None:
-            hit = self.cache.equivalence.lookup(node_name, eq_class)
+        device predicate (`devicepredicate.go:11-26`) last.
+
+        ``snap`` is the node's shared cycle snapshot (read-only; the pass
+        obtains all of them in one lock acquisition); a direct call
+        without one takes a private snapshot. ``eq_gen`` is the node's fit
+        generation captured with that snapshot — it must predate
+        EVERYTHING the verdict reads, the inter-pod metadata included, so
+        a node change while we compute makes the stored result land under
+        a generation that is never served again instead of poisoning the
+        cache (the upstream equivalence-cache race).
+
+        Memoized verdicts are keyed by (class, generation, nominated-
+        reservation fingerprint): a verdict computed with preemption-freed
+        room charged stays reusable while the same reservations stand and
+        naturally misses once they bind or expire. ``vol_split`` routes a
+        PVC-referencing pod through its devolumed sibling class (see
+        `equivalence.devolumed_class`): the expensive non-volume chain is
+        shared with the volume-less class, then only the volume-reading
+        predicates run against the real pod.
+
+        The filter pass precomputes ``nominated`` (one lock pass for the
+        whole cluster) and resolves the memo serially via ``lookup_many``
+        — it passes ``memo_checked=True`` (with any positive sibling
+        verdict as ``sibling_hit``) so only the store happens here. A
+        direct call does its own per-node lookups."""
+        if nominated is None:
+            nominated = self._nominated_pods_on(
+                node_name, exclude=kube_pod["metadata"]["name"],
+                min_priority=_pod_priority(kube_pod))
+        nom_fp = tuple(sorted(p["metadata"]["name"] for p in nominated))
+        if eq_gen is None and (eq_class is not None or vol_split is not None):
+            eq_gen = self.cache.node_generation(node_name)
+        if eq_class is not None and not memo_checked:
+            hit = self.cache.equivalence.lookup(
+                node_name, eq_class, eq_gen, nom_fp)
             if hit is not None:
                 return hit
-            # The generation must predate EVERYTHING the verdict reads —
-            # the inter-pod metadata included. The filter pass captures all
-            # generations before building the metadata and hands ours in
-            # via ``eq_gen``; a direct call reads it here, before the
-            # snapshot. Either way, a node change while we compute makes
-            # store() drop the now-stale result instead of poisoning the
-            # cache (the upstream equivalence-cache race).
-            gen = eq_gen if eq_gen is not None \
-                else self.cache.equivalence.generation(node_name)
         if meta is self._AUTO_META:
             meta = self._interpod_meta(kube_pod)
         if vol is self._AUTO_META:
             vol = self._volume_snapshot(kube_pod)
-        snap = self.cache.snapshot_node(node_name)
+        if snap is None or nominated:
+            # no shared snapshot, or about to charge nominated demand:
+            # take a private (mutable) one — shared cycle snapshots are
+            # immutable by contract
+            snap = self.cache.snapshot_node(node_name)
         if snap is None:
             return False, ["node gone"], 0.0
         if nominated:
             self._charge_nominated(nominated, snap)
+            if out_snaps is not None:
+                # hand the charged private snapshot back so the scoring
+                # pass ranks this node with the reservation's demand
+                # accounted, not the uncharged cycle snapshot
+                out_snaps[node_name] = snap
         if device_class is self._AUTO_META:
             device_class = self._device_class(kube_pod)
+        if vol_split is not None:
+            sibling_class, stripped_pod = vol_split
+            stored = sibling_hit
+            if stored is None and not memo_checked:
+                stored = self.cache.equivalence.lookup(
+                    node_name, sibling_class, eq_gen, nom_fp)
+            if stored is None:
+                stored = self._run_predicates(
+                    stripped_pod, snap, meta, pod_info_get, device_class,
+                    vol)
+                self.cache.equivalence.store(
+                    node_name, sibling_class, eq_gen, stored, nom_fp)
+            if not stored[0]:
+                # verdicts are monotone in volumes: the sibling's failure
+                # is the real pod's failure — this is what prunes a full
+                # fleet down to the nodes worth evaluating
+                return stored
+            ctx = factory.PredicateContext(kube_pod, snap, meta, vol)
+            for _name, pred in self._volume_predicates:
+                ok, reasons = pred(ctx)
+                if not ok:
+                    return False, reasons, 0.0
+            return stored
         result = self._run_predicates(
             kube_pod, snap, meta, pod_info_get, device_class, vol)
-        if out_snaps is not None and result[0]:
-            # Only feasible nodes are scored; don't pin snapshots of the
-            # (typically many) infeasible ones for the whole pass.
-            out_snaps[node_name] = snap
         if eq_class is not None:
-            self.cache.equivalence.store(node_name, eq_class, result, gen)
+            self.cache.equivalence.store(
+                node_name, eq_class, eq_gen, result, nom_fp)
         return result
 
     MAX_DEVICE_VERDICTS = 4096
@@ -311,9 +382,13 @@ class GenericScheduler:
 
     @staticmethod
     def _device_class(kube_pod: dict, auto_topology: bool | None = None) -> str | None:
-        """Identity of a pod's device demand: the raw device annotation
+        """Identity of a pod's device demand: the device annotation
         (INCLUDING allocate_from, so gang-pinned pods never share entries)
-        plus the container resource blocks. Unlike `equivalence_class`,
+        plus the container resource blocks. The pod's own name and node
+        pin are canonicalized OUT of the annotation — they are identity,
+        not demand — so a steady stream of same-shaped pods shares one
+        verdict per node shape across passes instead of re-running the
+        backtracking search once per pod. Unlike `equivalence_class`,
         this must key only what `pod_fits_device` reads. None = do not
         cache (auto-topology pods, see `_requests_auto_topology`);
         callers that already computed the flag pass it to skip the
@@ -327,6 +402,14 @@ class GenericScheduler:
             return None
         meta = kube_pod.get("metadata") or {}
         ann = (meta.get("annotations") or {}).get(codec.POD_ANNOTATION_KEY) or ""
+        if ann:
+            try:
+                dev = _json.loads(ann)
+                dev.pop("podname", None)
+                dev.pop("nodename", None)
+                ann = _json.dumps(dev, sort_keys=True, default=str)
+            except (TypeError, ValueError):
+                pass  # unparseable: the raw string is still a sound key
         spec = kube_pod.get("spec") or {}
         res = _json.dumps(
             [(c.get("name"), c.get("resources")) for c in
@@ -361,7 +444,13 @@ class GenericScheduler:
             registered = False
             with self._device_lock:
                 hit = self._device_verdicts.get(dev_key)
-                if hit is None:
+                if hit is not None:
+                    # refresh insertion order so capacity eviction (which
+                    # drops the oldest quarter) behaves as LRU — a hot
+                    # long-lived class must not be the first casualty
+                    del self._device_verdicts[dev_key]
+                    self._device_verdicts[dev_key] = hit
+                else:
                     wait_for = self._device_inflight.get(dev_key)
                     if wait_for is None:
                         self._device_inflight[dev_key] = threading.Event()
@@ -386,7 +475,12 @@ class GenericScheduler:
             if dev_key is not None:
                 with self._device_lock:
                     if len(self._device_verdicts) >= self.MAX_DEVICE_VERDICTS:
-                        self._device_verdicts.clear()
+                        # evict the oldest quarter (insertion order), not
+                        # the whole map: a full clear() re-cold-started
+                        # every live class at once mid-stream
+                        drop = max(1, len(self._device_verdicts) // 4)
+                        for key in list(self._device_verdicts)[:drop]:
+                            del self._device_verdicts[key]
                     self._device_verdicts[dev_key] = result
             return result
         finally:
@@ -404,9 +498,11 @@ class GenericScheduler:
 
     def find_nodes_that_fit(self, kube_pod: dict):
         """Parallel filter over all nodes (`generic_scheduler.go:310-383`),
-        memoized per equivalence class, then extender callouts. The
-        inter-pod metadata is built ONCE here and shared by every worker."""
-        names = self.cache.node_names()
+        memoized per equivalence class, then extender callouts. The cycle
+        snapshot (one lock acquisition for every node's snapshot + fit
+        generation) and the inter-pod metadata are built ONCE here and
+        shared by every worker — and, via the generation cache, with the
+        passes that follow."""
         # A pod declaring REQUIRED inter-pod (anti-)affinity must NOT be
         # memoized: its verdict depends on every other pod's labels, so any
         # plain pod landing anywhere could invalidate it — per-node
@@ -416,30 +512,77 @@ class GenericScheduler:
         # Auto-topology pods are likewise uncacheable (cluster-wide shape
         # dependence, `_requests_auto_topology`).
         auto_topology = self._requests_auto_topology(kube_pod)
-        # PVC-referencing pods are likewise uncacheable: their verdict
-        # moves with cluster-wide PV state (creates, binds, reservations),
-        # which per-node invalidation cannot express.
+        # PVC-referencing pods: their own verdict moves with cluster-wide
+        # PV state, which per-node invalidation cannot express — but the
+        # non-volume chain is shared with the pod's devolumed sibling
+        # class (`devolumed_class`), so only the volume-reading predicates
+        # run uncached.
         vol = self._volume_snapshot(kube_pod)
-        eq_class = None if interpod.pod_requires_interpod_affinity(kube_pod) \
-            or auto_topology or vol is not None else equivalence_class(kube_pod)
-        # Generations BEFORE the metadata snapshot: a watcher invalidation
-        # racing the metadata build must make the eventual store() a no-op
-        # — a verdict computed from pre-invalidation metadata stored under
-        # a post-invalidation generation would persist wrongly.
-        eq_gens = self.cache.equivalence.generations(names) \
-            if eq_class is not None else {}
+        memo_ok = self._memo_safe and not auto_topology and \
+            not interpod.pod_requires_interpod_affinity(kube_pod)
+        eq_class = vol_split = None
+        if memo_ok and vol is None:
+            eq_class = equivalence_class(kube_pod)
+        elif memo_ok:
+            vol_split = devolumed_class(kube_pod)
+        # Snapshots + generations BEFORE the metadata snapshot: a watcher
+        # invalidation racing the metadata build must make the eventual
+        # store() land under a never-served generation — a verdict
+        # computed from pre-invalidation metadata stored under a
+        # post-invalidation generation would persist wrongly.
+        names, snaps, eq_gens = self.cache.cycle_snapshot()
         meta = self._interpod_meta(kube_pod)
         pod_info_get = self._pod_info_provider(kube_pod)
         device_class = self._device_class(kube_pod, auto_topology)
-        snaps: dict = {}
-        results = self._parallel_map(
-            lambda n: (n, *self._fits_on_node(kube_pod, n, eq_class, snaps,
-                                              meta, pod_info_get,
-                                              device_class, eq_gens.get(n),
-                                              vol)),
-            names)
-        feasible = {n: score for n, ok, _, score in results if ok}
-        failures = {n: reasons for n, ok, reasons, _ in results if not ok}
+        # Nominations and memo hits resolve serially, up front: the
+        # nominations in one lock pass, the memo in one `lookup_many` —
+        # per-node lookups from 16 workers convoyed on those locks and
+        # cost more than the dict reads they guarded. Only the MISSES are
+        # dispatched to the pool; a warm pass dispatches almost nothing.
+        nom_by_node = self._nominations_by_node(
+            exclude=kube_pod["metadata"]["name"],
+            min_priority=_pod_priority(kube_pod))
+        nom_fps = {n: tuple(sorted(p["metadata"]["name"] for p in pods))
+                   for n, pods in nom_by_node.items()}
+        lookup_class = eq_class if eq_class is not None else \
+            (vol_split[0] if vol_split is not None else None)
+        hits: dict = {}
+        if lookup_class is not None:
+            hits = self.cache.equivalence.lookup_many(
+                lookup_class, eq_gens, nom_fps)
+        results: dict = {}
+        pending = []
+        for n in names:
+            hit = hits.get(n)
+            if hit is not None and (vol_split is None or not hit[0]):
+                # a positive sibling verdict still owes the volume-
+                # reading predicates a run against the real pod
+                results[n] = hit
+                if hit[0] and n in nom_by_node:
+                    # memoized-feasible on a node with live reservations:
+                    # the verdict is reusable (fingerprint-keyed) but
+                    # scoring still needs the reservation's demand
+                    # charged onto a private snapshot
+                    psnap = self.cache.snapshot_node(n)
+                    if psnap is not None:
+                        self._charge_nominated(nom_by_node[n], psnap)
+                        snaps[n] = psnap
+            else:
+                pending.append(n)
+        charged_snaps: dict = {}  # nominated nodes: scoring must see the
+        # reservation's demand, not the uncharged cycle snapshot
+        computed = self._parallel_map(
+            lambda n: (n, self._fits_on_node(kube_pod, n, eq_class,
+                                             meta, pod_info_get,
+                                             device_class, eq_gens.get(n),
+                                             vol, snaps.get(n), vol_split,
+                                             nom_by_node.get(n, []), True,
+                                             hits.get(n), charged_snaps)),
+            pending)
+        results.update(computed)
+        snaps.update(charged_snaps)
+        feasible = {n: r[2] for n, r in results.items() if r[0]}
+        failures = {n: r[1] for n, r in results.items() if not r[0]}
         for ext in self.extenders:
             if not feasible:
                 break
@@ -459,8 +602,9 @@ class GenericScheduler:
         """Map-reduce the configured priority functions over feasible nodes
         (`generic_scheduler.go:526-...`): stock priorities + the device
         score from the fit pass + extender scores, weighted-summed.
-        ``snaps`` reuses snapshots the fit pass already took; nodes the
-        equivalence cache short-circuited are snapshotted here."""
+        ``snaps`` are the fit pass's shared cycle snapshots (read-only);
+        a feasible node missing from them (direct callers) is snapshotted
+        here."""
         pod_requests = _pod_core_requests(kube_pod)
         snaps = snaps or {}
         facts: dict = {}
@@ -620,7 +764,25 @@ class GenericScheduler:
         meta = self._interpod_meta(kube_pod)
         vol = self._volume_snapshot(kube_pod)
         pdb_state = self._pdb_state()
-        names = self.cache.node_names()
+        names, cycle_snaps, gens = self.cache.cycle_snapshot()
+        if failures is None:
+            # Direct call without a fit pass: the memo's stored negatives
+            # stand in for one — a node whose cached verdict failed on an
+            # unresolvable reason (taints, selectors, conditions) cannot
+            # be helped by eviction. Peeking (record=False) keeps the fit
+            # pass's hit-rate accounting honest.
+            memo_ok = self._memo_safe and \
+                not self._requests_auto_topology(kube_pod) and \
+                not interpod.pod_requires_interpod_affinity(kube_pod)
+            if memo_ok:
+                lookup_class = equivalence_class(kube_pod) if vol is None \
+                    else devolumed_class(kube_pod)[0]
+                failures = {}
+                for n in names:
+                    stored = self.cache.equivalence.lookup(
+                        n, lookup_class, gens[n], record=False)
+                    if stored is not None and not stored[0]:
+                        failures[n] = stored[1]
         if failures is not None:
             names = [n for n in names
                      if self._preemption_might_help(failures.get(n) or [])]
@@ -635,6 +797,19 @@ class GenericScheduler:
                             for p in api.list_pods()}
         except Exception:
             return None
+        # Eviction can only change a verdict where something evictable
+        # exists: drop nodes with no bound pod below the preemptor's
+        # priority before paying a private snapshot + full simulation —
+        # on a big cluster this removes every empty node and every node
+        # running only peers (cheap reads off the shared cycle snapshot).
+        def _has_evictable(node_name: str) -> bool:
+            snap = cycle_snaps.get(node_name)
+            if snap is None:
+                return True  # defensive: let the simulation decide
+            return any(_pod_priority(pods_by_name[p]) < prio
+                       for p in snap.pod_names if p in pods_by_name)
+
+        names = [n for n in names if _has_evictable(n)]
         pod_info_get = self._pod_info_provider(kube_pod)
         device_class = self._device_class(kube_pod)
 

@@ -63,6 +63,40 @@ class AlgorithmConfig:
 
 # ---- fit predicate registry -------------------------------------------------
 # name -> builder(args: dict | None) -> fn(ctx) -> (ok, reasons)
+#
+# Every registered predicate DECLARES what state it reads (``fn.reads``)
+# so the engine can prove its memoization sound (see predicates.py module
+# docstring for the contract). The vocabulary:
+#
+#   "pod"             the incoming pod object (captured by its class)
+#   "node"            the node object + device inventory/usage
+#   "node_pods"       placed pods' ports/labels/volumes on that node
+#   "cluster_pods"    every pod in the cluster (inter-pod affinity)
+#   "pod_volumes"     the incoming pod's spec.volumes
+#   "cluster_volumes" cluster-wide PV/PVC state
+#
+# "node"/"node_pods" reads are invalidated by that node's fit generation;
+# "cluster_pods" by the required-anti-affinity flush discipline in
+# SchedulerCache; volume reads route the pod through the engine's
+# devolumed-sibling split. A predicate WITHOUT a declaration disables
+# memoization for the whole algorithm — the sound default for an
+# out-of-tree predicate the engine knows nothing about.
+
+VOLUME_READS = frozenset({"pod_volumes", "cluster_volumes"})
+
+
+def _declare(*reads):
+    """Wrap a predicate builder so every built fn carries its read-set."""
+    read_set = frozenset(reads)
+
+    def wrap(builder):
+        def build(args):
+            fn = builder(args)
+            setattr(fn, "reads", read_set)
+            return fn
+        return build
+    return wrap
+
 
 def _p_host(args):
     return lambda ctx: predicates.pod_fits_host(ctx.kube_pod, ctx.snap.kube_node)
@@ -201,24 +235,28 @@ def _p_label_presence(args):
 
 
 FIT_PREDICATES = {
-    "PodFitsHost": _p_host,
-    "HostName": _p_host,
-    "MatchNodeSelector": _p_selector,
-    "PodFitsHostPorts": _p_ports,
-    "PodFitsPorts": _p_ports,  # upstream back-compat alias
-    "PodToleratesNodeTaints": _p_taints,
-    "CheckNodeCondition": _p_condition,
-    "CheckNodeMemoryPressure": _p_memory_pressure,
-    "CheckNodeDiskPressure": _p_disk_pressure,
-    "PodFitsResources": _p_resources,
-    "NoDiskConflict": _p_disk_conflict,
-    "MaxEBSVolumeCount": _p_max_volumes("awsElasticBlockStore", 39),
-    "MaxGCEPDVolumeCount": _p_max_volumes("gcePersistentDisk", 16),
-    "NoVolumeZoneConflict": _p_volume_zone,
-    "CheckVolumeBinding": _p_volume_binding,
-    "GeneralPredicates": _p_general,
-    "MatchInterPodAffinity": _p_interpod,
-    "CheckNodeLabelPresence": _p_label_presence,
+    "PodFitsHost": _declare("pod", "node")(_p_host),
+    "HostName": _declare("pod", "node")(_p_host),
+    "MatchNodeSelector": _declare("pod", "node")(_p_selector),
+    "PodFitsHostPorts": _declare("pod", "node_pods")(_p_ports),
+    # upstream back-compat alias
+    "PodFitsPorts": _declare("pod", "node_pods")(_p_ports),
+    "PodToleratesNodeTaints": _declare("pod", "node")(_p_taints),
+    "CheckNodeCondition": _declare("pod", "node")(_p_condition),
+    "CheckNodeMemoryPressure": _declare("pod", "node")(_p_memory_pressure),
+    "CheckNodeDiskPressure": _declare("pod", "node")(_p_disk_pressure),
+    "PodFitsResources": _declare("pod", "node", "node_pods")(_p_resources),
+    "NoDiskConflict": _declare("pod_volumes", "node_pods")(_p_disk_conflict),
+    "MaxEBSVolumeCount": _declare("pod_volumes", "node_pods")(
+        _p_max_volumes("awsElasticBlockStore", 39)),
+    "MaxGCEPDVolumeCount": _declare("pod_volumes", "node_pods")(
+        _p_max_volumes("gcePersistentDisk", 16)),
+    "NoVolumeZoneConflict": _declare("pod_volumes", "node")(_p_volume_zone),
+    "CheckVolumeBinding": _declare("pod_volumes", "cluster_volumes")(
+        _p_volume_binding),
+    "GeneralPredicates": _declare("pod", "node", "node_pods")(_p_general),
+    "MatchInterPodAffinity": _declare("pod", "cluster_pods")(_p_interpod),
+    "CheckNodeLabelPresence": _declare("pod", "node")(_p_label_presence),
 }
 
 

@@ -18,7 +18,10 @@ the binding, pinned allocation, process contract, and nomination stripped
 (but the gang membership kept), so the scheduler re-plans the pod-set on
 surviving nodes from intent, exactly like a fresh submission. The watch
 events from the deletions return every chip through the scheduler cache —
-zero leaked chips by construction.
+zero leaked chips by construction — and every such charge/release bumps
+the affected node's fit generation (`SchedulerCache._invalidate_locked`),
+so eviction can never leave a stale memoized "does not fit" verdict
+standing on a node whose chips it just freed.
 
 Nodes without a heartbeat annotation (registered out-of-band, or an older
 advertiser) are exempt: liveness is simply not tracked for them.

@@ -35,6 +35,18 @@ Each predicate returns ``(fits: bool, reasons: list[str])`` and is pure
 over the pod dict plus a point-in-time node snapshot, so the chain can run
 inside the parallel filter workers and its results can be memoized by the
 equivalence cache.
+
+Memo-safety contract: a predicate's registration in ``factory.py`` MUST
+declare the state slices its verdict reads (``fn.reads`` — "pod", "node",
+"node_pods", "cluster_pods", "pod_volumes", "cluster_volumes"). The
+engine only memoizes a verdict per (equivalence class, node generation)
+when every configured predicate carries a declaration, because the
+per-node generation can only invalidate what it knows a verdict read:
+node-local reads are covered by that node's generation, cluster-wide pod
+reads by the required-anti-affinity flush in ``SchedulerCache``, and
+volume reads by the devolumed-sibling split in the engine. An undeclared
+predicate therefore disables memoization entirely rather than risk a
+stale verdict it cannot invalidate.
 """
 
 from __future__ import annotations

@@ -186,10 +186,12 @@ def test_simulate_cli_runs():
          "--json"],
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert out.returncode == 0, out.stderr
-    rows = json.loads(out.stdout)
-    placed = {r["pod"]: r["node"] for r in rows}
+    doc = json.loads(out.stdout)
+    placed = {r["pod"]: r["node"] for r in doc["placements"]}
     assert placed["plain-2chip"] != "<pending>"
     assert placed["contig-4chip"] != "<pending>"
+    # the fit-memo summary rides along: a dead cache would read 0 hits
+    assert set(doc["fit_cache"]) == {"hits", "misses", "invalidations"}
 
 
 def test_prometheus_text_renders():

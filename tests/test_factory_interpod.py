@@ -408,8 +408,8 @@ def test_device_cache_distinguishes_pinned_variant():
     provider = gen._pod_info_provider(q)
     dc = gen._device_class(q)
     # annotated node first — would poison a variant-blind cache
-    r_bound = gen._fits_on_node(q, bound, None, None, None, provider, dc)
-    r_other = gen._fits_on_node(q, other, None, None, None, provider, dc)
+    r_bound = gen._fits_on_node(q, bound, None, None, provider, dc)
+    r_other = gen._fits_on_node(q, other, None, None, provider, dc)
     assert not r_bound[0]   # pinned chips are taken
     assert r_other[0]       # free search on the other node succeeds
 
@@ -421,8 +421,8 @@ def test_device_cache_distinguishes_pinned_variant():
     assert sched.cache.snapshot_node(bound).node_ex.shape_key() == \
         sched.cache.snapshot_node(other).node_ex.shape_key()
     gen._device_verdicts.clear()
-    r_bound = gen._fits_on_node(q, bound, None, None, None, provider, dc)
-    r_other = gen._fits_on_node(q, other, None, None, None, provider, dc)
+    r_bound = gen._fits_on_node(q, bound, None, None, provider, dc)
+    r_other = gen._fits_on_node(q, other, None, None, provider, dc)
     assert r_bound[0] and r_other[0]
     assert {k[2] for k in gen._device_verdicts} == {True, False}
 
@@ -453,21 +453,21 @@ def test_preferred_only_affinity_keeps_equivalence_cache_warm():
     cache = SchedulerCache(ds)
     for name in ("n0", "n1"):
         cache.set_node(flat_tpu_node(name))
-    gen_other = cache.equivalence.generation("n1")
+    gen_other = cache.node_generation("n1")
 
     soft = tpu_pod("soft", 1)
     soft["spec"]["affinity"] = {"podAntiAffinity": {
         "preferredDuringSchedulingIgnoredDuringExecution": [
             {"weight": 1, "podAffinityTerm": required_term({"a": "b"})}]}}
     cache.add_pod(soft, "n0")
-    assert cache.equivalence.generation("n1") == gen_other  # untouched
+    assert cache.node_generation("n1") == gen_other  # untouched
 
     hard = tpu_pod("hard", 1)
     hard["spec"]["affinity"] = {"podAntiAffinity": {
         "requiredDuringSchedulingIgnoredDuringExecution":
         [required_term({"a": "b"})]}}
     cache.add_pod(hard, "n0")
-    assert cache.equivalence.generation("n1") > gen_other  # flushed
+    assert cache.node_generation("n1") > gen_other  # flushed
 
 
 # ---- end-to-end through the engine ------------------------------------------

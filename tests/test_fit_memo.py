@@ -1,0 +1,340 @@
+"""Incremental scheduling hot path: generation-tracked invalidation,
+equivalence-class fit memoization, nomination fingerprints, the devolumed
+volume split, and the adaptive fit pool.
+
+The invalidation contract under test: a node change (pod bound, chip
+degraded via health annotation, node deleted, assume/forget) between two
+identical pods must invalidate exactly that node's cached verdict — and a
+heartbeat re-patch must invalidate nothing.
+"""
+
+import threading
+import time
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec
+from kubegpu_tpu.scheduler.cache import SchedulerCache
+from kubegpu_tpu.scheduler.equivalence import (devolumed_class,
+                                               equivalence_class)
+from kubegpu_tpu.scheduler.registry import DevicesScheduler
+from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+
+
+def make_cache():
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    return SchedulerCache(ds)
+
+
+# ---- memo hit rate (acceptance: identical second pod hits the cache) -------
+
+
+def test_second_identical_pod_hits_memo_mutated_node_misses():
+    api = InMemoryAPIServer()
+    for i in range(3):
+        api.create_node(flat_tpu_node(f"host{i}", chips=4))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("p0", 1))
+    sched.run_until_idle()
+    bound = api.get_pod("p0")["spec"]["nodeName"]
+    hits_before = sched.cache.equivalence.hits
+    gens_before = {f"host{i}": sched.cache.node_generation(f"host{i}")
+                   for i in range(3)}
+    api.create_pod(tpu_pod("p1", 1))
+    sched.run_until_idle()
+    assert api.get_pod("p1")["spec"].get("nodeName")
+    # the two untouched nodes served their memoized verdicts; the node
+    # that absorbed p0 was invalidated (generation moved) and missed
+    assert sched.cache.equivalence.hits >= hits_before + 2
+    for i in range(3):
+        name = f"host{i}"
+        if name == bound:
+            assert sched.cache.node_generation(name) > gens_before[name]
+        else:
+            assert sched.cache.node_generation(name) == gens_before[name]
+
+
+def test_fit_cache_metrics_counters_move():
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    for i in range(2):
+        api.create_node(flat_tpu_node(f"host{i}", chips=4))
+    sched = make_scheduler(api)
+    for i in range(3):
+        api.create_pod(tpu_pod(f"p{i}", 1))
+    sched.run_until_idle()
+    assert metrics.FIT_CACHE_HITS.value > 0
+    assert metrics.FIT_CACHE_MISSES.value > 0
+    assert metrics.FIT_CACHE_INVALIDATIONS.value > 0
+
+
+# ---- invalidation sources ---------------------------------------------------
+
+
+def test_pod_charge_invalidates_exactly_that_node():
+    cache = make_cache()
+    for name in ("n0", "n1"):
+        cache.set_node(flat_tpu_node(name))
+    g0, g1 = cache.node_generation("n0"), cache.node_generation("n1")
+    cache.add_pod(tpu_pod("a", 1), "n0")
+    assert cache.node_generation("n0") > g0
+    assert cache.node_generation("n1") == g1
+    g0 = cache.node_generation("n0")
+    cache.remove_pod(tpu_pod("a", 1), "n0")
+    assert cache.node_generation("n0") > g0
+    assert cache.node_generation("n1") == g1
+
+
+def test_assume_and_forget_bump_generations():
+    """The would-be-stale-hit guard: an optimistic assume (and its
+    rollback) changes what fits — if either failed to bump, the memo
+    would keep serving the pre-assume verdict."""
+    cache = make_cache()
+    cache.set_node(flat_tpu_node("n0"))
+    gen = cache.node_generation("n0")
+    # a verdict memoized at the pre-assume generation...
+    cache.equivalence.store("n0", "cls", gen, (True, [], 1.0))
+    pod = tpu_pod("a", 2)
+    cache.assume_pod(pod, "n0")
+    after_assume = cache.node_generation("n0")
+    assert after_assume > gen, "assume_pod must bump the fit generation"
+    # ...is dead at the post-assume generation
+    assert cache.equivalence.lookup("n0", "cls", after_assume) is None
+    cache.forget_pod(pod)
+    assert cache.node_generation("n0") > after_assume, \
+        "forget_pod must bump the fit generation"
+
+
+def test_stale_fits_verdict_not_served_after_bind():
+    """End to end: identical pods against one 4-chip node. The first
+    bind's charge must invalidate the node so the second pod recomputes
+    against the reduced free set instead of reusing 'fits'."""
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    sched.preemption_enabled = False
+    api.create_pod(tpu_pod("big0", 3))
+    sched.run_until_idle()
+    assert api.get_pod("big0")["spec"].get("nodeName") == "host0"
+    api.create_pod(tpu_pod("big1", 3))
+    sched.run_until_idle()
+    # a stale hit would have routed big1 into allocate_devices and an
+    # internal error; the honest path is an ordinary FitError
+    assert not api.get_pod("big1")["spec"].get("nodeName")
+    assert metrics.INTERNAL_ERRORS.value == 0
+    assert metrics.SCHEDULE_FAILURES.value >= 1
+
+
+def test_chip_health_invalidates_heartbeat_does_not():
+    cache = make_cache()
+    node = flat_tpu_node("n0")
+    codec.heartbeat_to_annotation(node["metadata"], 100.0)
+    cache.set_node(node)
+    gen = cache.node_generation("n0")
+    # heartbeat-only re-patch: fit-irrelevant, generation must hold
+    codec.heartbeat_to_annotation(node["metadata"], 161.0)
+    cache.set_node(node)
+    assert cache.node_generation("n0") == gen
+    # a chip degrading via the health annotation is fit-relevant
+    codec.chip_health_to_annotation(node["metadata"], {"dev0": "degraded"})
+    cache.set_node(node)
+    assert cache.node_generation("n0") > gen
+
+
+def test_node_delete_invalidates_and_drops_memo():
+    cache = make_cache()
+    cache.set_node(flat_tpu_node("n0"))
+    gen = cache.node_generation("n0")
+    cache.equivalence.store("n0", "cls", gen, (True, [], 1.0))
+    cache.remove_node("n0")
+    assert cache.node_generation("n0") > gen  # survives the node
+    assert cache.equivalence.lookup(
+        "n0", "cls", cache.node_generation("n0")) is None
+    # a re-added node must not resurrect pre-delete verdicts
+    cache.set_node(flat_tpu_node("n0"))
+    assert cache.node_generation("n0") > gen
+
+
+def test_eviction_deletion_invalidates_via_watch():
+    """The lifecycle controller evicts by deleting pods through the API;
+    the watch event must bump the node's generation (free chips => old
+    'does not fit' verdicts are dead)."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("victim", 4))
+    sched.run_until_idle()
+    assert api.get_pod("victim")["spec"]["nodeName"] == "host0"
+    gen = sched.cache.node_generation("host0")
+    api.delete_pod("victim")  # what NodeLifecycle._evict_and_requeue does
+    assert sched.cache.node_generation("host0") > gen
+
+
+def test_cycle_snapshot_reused_until_generation_moves():
+    cache = make_cache()
+    cache.set_node(flat_tpu_node("n0"))
+    _, snaps1, gens1 = cache.cycle_snapshot()
+    _, snaps2, _ = cache.cycle_snapshot()
+    assert snaps1["n0"] is snaps2["n0"]  # shared while unchanged
+    cache.add_pod(tpu_pod("a", 1), "n0")
+    _, snaps3, gens3 = cache.cycle_snapshot()
+    assert snaps3["n0"] is not snaps1["n0"]
+    assert gens3["n0"] > gens1["n0"]
+
+
+# ---- nominated-reservation fingerprint --------------------------------------
+
+
+def test_nomination_fingerprint_keys_memo():
+    """A verdict computed with a nominated reservation charged must not
+    be served once the reservation clears (and vice versa) — the
+    fingerprint in the memo key replaces the old blanket no-memoization
+    of nominated nodes."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    sched.preemption_enabled = False
+    # a preemptor's nomination reserves the whole node's chips
+    sched.generic.nominate(tpu_pod("preemptor", 4), "host0")
+    api.create_pod(tpu_pod("y", 2))
+    sched.run_until_idle()
+    assert not api.get_pod("y")["spec"].get("nodeName")  # room is spoken for
+    sched.generic.clear_nomination("preemptor")
+    sched.queue.move_all_to_active()
+    sched.run_until_idle()
+    # the reservation-charged verdict must not outlive the reservation
+    assert api.get_pod("y")["spec"].get("nodeName") == "host0"
+
+
+def test_scoring_sees_nominated_reservation_charge():
+    """Feasible nodes carrying a live reservation must reach the scoring
+    pass with the reservation's demand charged — on both the computed
+    path and the memo-hit path. Two shape-identical empty nodes would
+    otherwise score as exact ties; the charge on host0 must break the
+    symmetry."""
+    api = InMemoryAPIServer()
+    for i in range(2):
+        api.create_node(flat_tpu_node(f"host{i}", chips=4))
+    sched = make_scheduler(api)
+    sched.generic.nominate(tpu_pod("pre", 2), "host0")
+    gen = sched.generic
+    for attempt in ("computed", "memo-hit"):
+        probe = tpu_pod("probe", 1)
+        feasible, _, snaps, meta = gen.find_nodes_that_fit(probe)
+        assert set(feasible) == {"host0", "host1"}, attempt
+        # the snapshot handed to scoring carries the charged demand
+        used0 = sum(v for k, v in snaps["host0"].node_ex.used.items()
+                    if k.endswith("/chips"))
+        used1 = sum(v for k, v in snaps["host1"].node_ex.used.items()
+                    if k.endswith("/chips"))
+        assert (used0, used1) == (2, 0), (attempt, used0, used1)
+        scored = gen.prioritize_nodes(probe, feasible, snaps, meta)
+        assert scored["host0"] != scored["host1"], attempt
+    # the second round was served from the memo under the fingerprint key
+    assert sched.cache.equivalence.hits > 0
+
+
+# ---- devolumed split for PVC pods -------------------------------------------
+
+
+def test_devolumed_class_matches_volume_less_twin():
+    plain = tpu_pod("a", 1)
+    with_vol = tpu_pod("b", 1)
+    with_vol["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": "c"}}]
+    assert equivalence_class(plain) != equivalence_class(with_vol)
+    sibling, stripped = devolumed_class(with_vol)
+    assert sibling == equivalence_class(plain)
+    assert "volumes" not in stripped["spec"]
+    assert "volumes" in with_vol["spec"]  # the real pod is untouched
+
+
+def test_volume_pod_reuses_sibling_negatives_and_binds_by_pv():
+    api = InMemoryAPIServer()
+    for i in range(2):
+        node = flat_tpu_node(f"host{i}", chips=1)
+        node["metadata"]["labels"] = {"kubernetes.io/hostname": f"host{i}"}
+        api.create_node(node)
+    sched = make_scheduler(api)
+    sched.preemption_enabled = False
+    # fill host0 so the (shared) sibling class records a negative there
+    pin = tpu_pod("filler", 1)
+    pin["spec"]["nodeSelector"] = {"kubernetes.io/hostname": "host0"}
+    api.create_pod(pin)
+    sched.run_until_idle()
+    assert api.get_pod("filler")["spec"]["nodeName"] == "host0"
+    api.create_pvc({"metadata": {"name": "claim"},
+                    "spec": {"resources": {"requests": {"storage": "1Gi"}},
+                             "storageClassName": ""}})
+    api.create_pv({"metadata": {"name": "vol"},
+                   "spec": {"capacity": {"storage": "1Gi"},
+                            "storageClassName": ""}})
+    hits_before = sched.cache.equivalence.hits
+    vol_pod = tpu_pod("v", 1)
+    vol_pod["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": "claim"}}]
+    api.create_pod(vol_pod)
+    sched.run_until_idle()
+    assert api.get_pod("v")["spec"].get("nodeName") == "host1"
+    # ...and a plain pod of the same shape shares verdicts with the
+    # sibling class the volume pod just populated
+    api.create_pod(tpu_pod("w", 1))
+    sched.run_until_idle()
+    assert sched.cache.equivalence.hits > hits_before
+
+
+# ---- adaptive fit pool ------------------------------------------------------
+
+
+def test_two_node_cluster_schedules_without_spawning_16_threads():
+    api = InMemoryAPIServer()
+    for i in range(2):
+        api.create_node(flat_tpu_node(f"host{i}", chips=4))
+    sched = make_scheduler(api)
+    api.create_pod(tpu_pod("p0", 1))
+    sched.run_until_idle()
+    assert api.get_pod("p0")["spec"].get("nodeName")
+    # chunking adapts to the live node count, so the lazily-spawned pool
+    # never grew past one thread per node
+    assert len(sched.generic._pool._threads) <= 2
+    sched.stop()
+
+
+def test_parallel_map_single_item_runs_inline():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    seen = []
+    out = sched.generic._parallel_map(
+        lambda x: seen.append(threading.current_thread().name) or x, [1])
+    assert out == [1]
+    assert seen == [threading.main_thread().name]
+    sched.stop()
+
+
+def test_noop_node_patch_delivers_no_watch_event():
+    """Watch delivery is the memo's invalidation source: an idempotent
+    re-advertise (same annotations) must not fire a node event at all."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0"))
+    events = []
+    api.add_watcher(lambda kind, event, obj: events.append((kind, event)))
+    same = api.get_node("host0")["metadata"]["annotations"]
+    api.patch_node_metadata("host0", {"annotations": dict(same)})
+    assert events == []
+    api.patch_node_metadata("host0", {"labels": {"zone": "a"}})
+    assert events == [("node", "modified")]
+
+
+def test_expire_assumed_bumps_generation():
+    cache = make_cache()
+    cache.set_node(flat_tpu_node("n0"))
+    cache.assume_pod(tpu_pod("a", 1), "n0", now=time.monotonic())
+    gen = cache.node_generation("n0")
+    expired = cache.expire_assumed(now=time.monotonic() + 120.0)
+    assert expired == ["a"]
+    assert cache.node_generation("n0") > gen

@@ -452,11 +452,16 @@ def test_equivalence_class_owner_wins():
 
 def test_equivalence_cache_hit_and_invalidate():
     eq = EquivalenceCache()
-    eq.store("n0", "cls", (True, [], 0.5))
-    assert eq.lookup("n0", "cls") == (True, [], 0.5)
+    eq.store("n0", "cls", 0, (True, [], 0.5))
+    assert eq.lookup("n0", "cls", 0) == (True, [], 0.5)
     assert eq.hits == 1
-    eq.invalidate_node("n0")
-    assert eq.lookup("n0", "cls") is None
+    # a generation bump (any fit-relevant node change) retires the entry
+    assert eq.lookup("n0", "cls", 1) is None
+    # nomination-fingerprinted entries are distinct from the plain one
+    eq.store("n0", "cls", 0, (False, ["reserved"], 0.0), nom_fp=("pre",))
+    assert eq.lookup("n0", "cls", 0, nom_fp=("pre",)) == \
+        (False, ["reserved"], 0.0)
+    assert eq.lookup("n0", "cls", 0) == (True, [], 0.5)
 
 
 def test_scheduler_uses_equivalence_cache():
@@ -887,13 +892,13 @@ def test_port_refcount_survives_one_removal():
 
 def test_equivalence_store_dropped_on_stale_generation():
     eq = EquivalenceCache()
-    gen = eq.generation("n0")
-    eq.invalidate_node("n0")  # concurrent charge happened mid-computation
-    eq.store("n0", "cls", (True, [], 1.0), gen)
-    assert eq.lookup("n0", "cls") is None  # stale result was not stored
-    gen = eq.generation("n0")
-    eq.store("n0", "cls", (True, [], 1.0), gen)
-    assert eq.lookup("n0", "cls") == (True, [], 1.0)
+    # a concurrent charge bumped the node's generation to 1 while the
+    # verdict was computed against generation 0: the store lands under
+    # the old generation and is never served
+    eq.store("n0", "cls", 0, (True, [], 1.0))
+    assert eq.lookup("n0", "cls", 1) is None
+    eq.store("n0", "cls", 1, (True, [], 1.0))
+    assert eq.lookup("n0", "cls", 1) == (True, [], 1.0)
 
 
 def test_equivalence_cache_bounded():
@@ -901,5 +906,5 @@ def test_equivalence_cache_bounded():
 
     eq = EquivalenceCache()
     for i in range(MAX_CLASSES_PER_NODE + 10):
-        eq.store("n0", f"cls{i}", (True, [], 0.0))
+        eq.store("n0", f"cls{i}", 0, (True, [], 0.0))
     assert len(eq._by_node["n0"]) <= MAX_CLASSES_PER_NODE

@@ -262,17 +262,25 @@ def test_pdname_less_gce_pds_do_not_all_collide():
 
 
 def test_equivalence_store_rejects_pre_invalidation_generation():
-    from kubegpu_tpu.scheduler.equivalence import EquivalenceCache
+    from kubegpu_tpu.scheduler.cache import SchedulerCache
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
 
-    eq = EquivalenceCache()
-    gens = eq.generations(["n1"])          # captured BEFORE the "metadata"
-    eq.invalidate_node("n1")               # racing watcher invalidation
-    eq.store("n1", "cls", (True, [], 1.0), gens["n1"])
-    assert eq.lookup("n1", "cls") is None  # stale store dropped
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    cache = SchedulerCache(ds)
+    cache.set_node({"metadata": {"name": "n1"},
+                    "status": {"allocatable": {"cpu": "8"}}})
+    gen = cache.node_generation("n1")      # captured BEFORE the "metadata"
+    cache.add_pod({"metadata": {"name": "x"}, "spec": {}}, "n1")  # racing
+    cache.equivalence.store("n1", "cls", gen, (True, [], 1.0))
+    # the store landed under the pre-invalidation generation: never served
+    assert cache.equivalence.lookup(
+        "n1", "cls", cache.node_generation("n1")) is None
 
-    gens = eq.generations(["n1"])
-    eq.store("n1", "cls", (True, [], 1.0), gens["n1"])
-    assert eq.lookup("n1", "cls") == (True, [], 1.0)
+    gen = cache.node_generation("n1")
+    cache.equivalence.store("n1", "cls", gen, (True, [], 1.0))
+    assert cache.equivalence.lookup("n1", "cls", gen) == (True, [], 1.0)
 
 
 def test_device_verdict_pinned_variant_keys_are_distinct():
