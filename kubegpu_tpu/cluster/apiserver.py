@@ -47,6 +47,13 @@ class InMemoryAPIServer:
         # key IS the dedup identity, so record_event is O(1) not a scan
         self._events: dict = {}
         self._watchers: list = []
+        # Secondary pod indexes, maintained under self._lock by every pod
+        # mutator (the same discipline as _notify_locked): lifecycle
+        # eviction, gang lookup, and preemption's victim scan read
+        # pods-by-node / bound / by-phase slices instead of sweeping
+        # every pod in the cluster.
+        self._pods_by_node: dict = {}   # node name -> {pod names}
+        self._pods_by_phase: dict = {}  # status.phase -> {pod names}
 
     MAX_EVENTS = 5000
 
@@ -98,6 +105,36 @@ class InMemoryAPIServer:
 
     # ---- pods --------------------------------------------------------------
 
+    def _index_pod_locked(self, pod: dict) -> None:
+        # Always called with self._lock held, right after a pod mutation:
+        # the index entry must be atomic with the object state it mirrors.
+        name = pod["metadata"]["name"]
+        node = (pod.get("spec") or {}).get("nodeName")
+        phase = (pod.get("status") or {}).get("phase")
+        if node:
+            self._pods_by_node.setdefault(node, set()).add(name)
+        if phase:
+            self._pods_by_phase.setdefault(phase, set()).add(name)
+
+    def _deindex_pod_locked(self, pod: dict) -> None:
+        # Always called with self._lock held, BEFORE a mutation that may
+        # move the pod between index buckets (bind, delete).
+        name = pod["metadata"]["name"]
+        node = (pod.get("spec") or {}).get("nodeName")
+        phase = (pod.get("status") or {}).get("phase")
+        if node:
+            bucket = self._pods_by_node.get(node)
+            if bucket is not None:
+                bucket.discard(name)
+                if not bucket:
+                    del self._pods_by_node[node]
+        if phase:
+            bucket = self._pods_by_phase.get(phase)
+            if bucket is not None:
+                bucket.discard(name)
+                if not bucket:
+                    del self._pods_by_phase[phase]
+
     def create_pod(self, pod: dict) -> dict:
         with self._lock:
             name = pod["metadata"]["name"]
@@ -107,6 +144,7 @@ class InMemoryAPIServer:
             stored.setdefault("spec", {})
             stored.setdefault("status", {"phase": "Pending"})
             self._pods[name] = stored
+            self._index_pod_locked(stored)
             self._notify_locked("pod", "added", stored)
             return copy.deepcopy(stored)
 
@@ -116,12 +154,28 @@ class InMemoryAPIServer:
                 raise NotFound(f"pod {name}")
             return copy.deepcopy(self._pods[name])
 
-    def list_pods(self, node_name: str | None = None) -> list:
+    def list_pods(self, node_name: str | None = None,
+                  phase: str | None = None, bound: bool = False) -> list:
+        """List pods, optionally narrowed by the secondary indexes:
+        ``node_name`` (pods-by-node), ``phase`` (pods-by-phase), or
+        ``bound=True`` (any pod with ``spec.nodeName`` set — the union of
+        the node index). Each narrowed form copies only its slice, so the
+        eviction / victim-scan / gang-lookup consumers stop paying
+        O(all-pods) per call."""
         with self._lock:
-            pods = [p for _, p in sorted(self._pods.items())]
             if node_name is not None:
+                names = self._pods_by_node.get(node_name, ())
+            elif bound:
+                names = [n for bucket in self._pods_by_node.values()
+                         for n in bucket]
+            elif phase is not None:
+                names = self._pods_by_phase.get(phase, ())
+            else:
+                names = self._pods
+            pods = [self._pods[n] for n in sorted(names) if n in self._pods]
+            if phase is not None:
                 pods = [p for p in pods
-                        if p.get("spec", {}).get("nodeName") == node_name]
+                        if (p.get("status") or {}).get("phase") == phase]
             return [copy.deepcopy(p) for p in pods]
 
     def update_pod_annotations(self, name: str, annotations: dict) -> dict:
@@ -135,6 +189,24 @@ class InMemoryAPIServer:
             self._notify_locked("pod", "modified", self._pods[name])
             return copy.deepcopy(self._pods[name])
 
+    def update_pod_annotations_many(self, annotations: dict) -> None:
+        """Batched `update_pod_annotations`: {pod name -> annotation dict}
+        applied in one request / one lock acquisition, validated up front
+        so a missing pod fails the batch before anything is written. This
+        is the multi-key write the gang paths use so N members' stamps
+        ride one transport round trip instead of N."""
+        with self._lock:
+            for name in annotations:
+                if name not in self._pods:
+                    raise NotFound(f"pod {name}")
+            changed = []
+            for name, ann in annotations.items():
+                meta = self._pods[name].setdefault("metadata", {})
+                meta["annotations"] = copy.deepcopy(ann)
+                changed.append(self._pods[name])
+            for pod in changed:
+                self._notify_locked("pod", "modified", pod)
+
     def bind_pod(self, name: str, node_name: str) -> None:
         """The bind subresource: sets spec.nodeName exactly once."""
         with self._lock:
@@ -144,8 +216,10 @@ class InMemoryAPIServer:
             bound = pod.get("spec", {}).get("nodeName")
             if bound and bound != node_name:
                 raise Conflict(f"pod {name} already bound to {bound}")
+            self._deindex_pod_locked(pod)
             pod.setdefault("spec", {})["nodeName"] = node_name
             pod.setdefault("status", {})["phase"] = "Scheduled"
+            self._index_pod_locked(pod)
             self._notify_locked("pod", "modified", pod)
 
     def bind_many(self, bindings: dict, annotations: dict) -> None:
@@ -164,8 +238,10 @@ class InMemoryAPIServer:
                 pod = self._pods[name]
                 meta = pod.setdefault("metadata", {})
                 meta["annotations"] = copy.deepcopy(annotations.get(name, {}))
+                self._deindex_pod_locked(pod)
                 pod.setdefault("spec", {})["nodeName"] = node_name
                 pod.setdefault("status", {})["phase"] = "Scheduled"
+                self._index_pod_locked(pod)
                 changed.append(pod)
             for pod in changed:
                 self._notify_locked("pod", "modified", pod)
@@ -178,6 +254,7 @@ class InMemoryAPIServer:
                 # (see delete_node) — this is what keeps the lifecycle
                 # controller's externally-deleted-pod guard alive
                 raise NotFound(f"pod {name}")
+            self._deindex_pod_locked(pod)
             self._notify_locked("pod", "deleted", pod)
 
     # ---- persistent volumes / claims ---------------------------------------
@@ -412,6 +489,17 @@ class InMemoryAPIServer:
                 self._events.pop(next(iter(self._events)))
             self._notify_locked("event", "added", ev)
             return copy.deepcopy(ev)
+
+    def record_events(self, events: list) -> None:
+        """Batched ``record_event``: a list of ``{kind, name, type,
+        reason, message}`` dicts recorded in one request / one lock pass
+        (the RLock is reentrant) — the binder pool's per-batch Scheduled
+        stamps ride one round trip instead of one per pod."""
+        with self._lock:
+            for e in events:
+                self.record_event(e.get("kind", "Pod"), e["name"],
+                                  e.get("type", "Normal"), e["reason"],
+                                  e.get("message", ""))
 
     def list_events(self, involved_name: str | None = None) -> list:
         with self._lock:

@@ -27,15 +27,18 @@ Leases implement the scheduler's HA leader election (reference:
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import random
+import socket
 import threading
 import time
 import urllib.error
-import urllib.request
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from kubegpu_tpu import metrics
 from kubegpu_tpu.cluster.apiserver import Conflict, InMemoryAPIServer, NotFound
 
 
@@ -63,6 +66,47 @@ class LeaseTable:
             return current[0]
 
 
+def coalesce_events(events: list) -> tuple:
+    """Fold one watch window per the informer compression table:
+    added+modified -> added(latest), modified+modified -> modified(latest),
+    added+deleted -> nothing (the client never saw the object),
+    modified+deleted -> deleted. Chains never merge ACROSS a deleted
+    event — a re-create is a new object history, and collapsing
+    delete+add into a modify would skip the consumer's teardown path.
+
+    Cross-object order follows each chain's first event, and a merged
+    chain carries its LAST event's sequence number and object — so
+    per-object versions only ever move forward and the client's
+    seq-resume cursor lands exactly where a full replay would have put
+    it. Returns ``(events, folded_count)``."""
+    out: list = []
+    tail: dict = {}  # (kind, object name) -> index of its chain in out
+    folded = 0
+    for ev in events:
+        seq, kind, etype, obj = ev
+        name = (obj.get("metadata") or {}).get("name") \
+            if isinstance(obj, dict) else None
+        key = (kind, name)
+        idx = tail.get(key)
+        prev = out[idx] if idx is not None else None
+        if name is None or prev is None or prev[2] == "deleted" or \
+                etype not in ("modified", "deleted"):
+            tail[key] = len(out)
+            out.append(ev)
+            continue
+        if etype == "modified":
+            out[idx] = (seq, kind, prev[2], obj)
+            folded += 1
+        elif prev[2] == "added":
+            out[idx] = None
+            tail.pop(key)
+            folded += 2
+        else:
+            out[idx] = (seq, kind, "deleted", obj)
+            folded += 1
+    return [e for e in out if e is not None], folded
+
+
 class _EventLog:
     """Bounded sequence-numbered event log backing /watch long-polls."""
 
@@ -81,13 +125,34 @@ class _EventLog:
                 self._events = self._events[-self.limit:]
             self._lock.notify_all()
 
-    def since(self, seq: int, timeout: float = 10.0):
+    def since(self, seq: int, timeout: float = 10.0, batch_s: float = 0.0,
+              kinds: frozenset | None = None):
+        """Events after ``seq``, coalesced per-object. ``batch_s`` > 0
+        lingers that long after the first pending event so a burst in
+        progress rides THIS response instead of costing another poll;
+        ``kinds`` narrows the stream server-side (a scheduler that never
+        consumes Event records must not pay their encode/decode).
+        Returns ``(events, latest_seq, folded_count)`` — the resume
+        contract is unchanged: every returned event keeps a sequence
+        number > ``seq``, and ``latest_seq`` advances the cursor past
+        anything folded away or filtered out."""
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:
-                out = [e for e in self._events if e[0] > seq]
-                if out or time.monotonic() >= deadline:
-                    return out, self._seq
+                out = [e for e in self._events
+                       if e[0] > seq and (kinds is None or e[1] in kinds)]
+                if out:
+                    if batch_s > 0:
+                        end = min(time.monotonic() + batch_s, deadline)
+                        while time.monotonic() < end:
+                            self._lock.wait(end - time.monotonic())
+                        out = [e for e in self._events
+                               if e[0] > seq
+                               and (kinds is None or e[1] in kinds)]
+                    out, folded = coalesce_events(out)
+                    return out, self._seq, folded
+                if time.monotonic() >= deadline:
+                    return [], self._seq, 0
                 self._lock.wait(min(0.5, deadline - time.monotonic()))
 
 
@@ -98,6 +163,14 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
     leases = LeaseTable()
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 so keep-alive works: every _send sets Content-Length,
+        # which is what lets the connection persist across requests — a
+        # fresh TCP handshake per API call was the single largest fixed
+        # cost on the transport bench. Nagle off: small JSON replies must
+        # not wait out a delayed-ACK window.
+        protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True
+
         def log_message(self, *args):  # quiet
             pass
 
@@ -141,9 +214,14 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
             if parts == ["healthz"]:
                 return self._send(200, {"ok": True})
             if parts == ["watch"]:
-                events, seq = log.since(int(query.get("since", 0)),
-                                        float(query.get("timeout", 10.0)))
-                return self._send(200, {"events": events, "seq": seq})
+                kinds = frozenset(query["kinds"].split(",")) \
+                    if query.get("kinds") else None
+                events, seq, folded = log.since(
+                    int(query.get("since", 0)),
+                    float(query.get("timeout", 10.0)),
+                    float(query.get("batch", 0.0)), kinds)
+                return self._send(200, {"events": events, "seq": seq,
+                                        "coalesced": folded})
             if parts and parts[0] == "leases" and method == "POST":
                 body = self._body()
                 ok = leases.acquire(parts[1], body["holder"],
@@ -163,10 +241,15 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
                 if method == "PATCH" and parts[2:] == ["metadata"]:
                     return self._send(200, api.patch_node_metadata(
                         parts[1], self._body()))
+            if parts == ["podannotations"] and method == "PUT":
+                api.update_pod_annotations_many(self._body())
+                return self._send(200)
             if parts and parts[0] == "pods":
                 if method == "GET" and len(parts) == 1:
                     return self._send(200, {"items": api.list_pods(
-                        node_name=query.get("node"))})
+                        node_name=query.get("node"),
+                        phase=query.get("phase"),
+                        bound=query.get("bound") in ("1", "true"))})
                 if method == "POST" and len(parts) == 1:
                     return self._send(201, api.create_pod(self._body()))
                 if method == "GET":
@@ -232,6 +315,9 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
                         involved_name=query.get("involved"))})
                 if method == "POST":
                     body = self._body()
+                    if isinstance(body, list):  # batched form
+                        api.record_events(body)
+                        return self._send(200)
                     return self._send(201, api.record_event(
                         body.get("kind", "Pod"), body["name"],
                         body.get("type", "Normal"), body["reason"],
@@ -263,7 +349,13 @@ class HTTPAPIClient:
     """Client with the same surface as `InMemoryAPIServer`, over HTTP.
 
     ``add_watcher`` spawns a long-poll thread replaying the server's event
-    log, so informer-style consumers (the scheduler) work unchanged.
+    log, so informer-style consumers (the scheduler) work unchanged;
+    ``add_batch_watcher`` delivers each poll's whole event batch to one
+    callback so a consumer can apply it under a single cache lock.
+
+    Requests ride a per-thread keep-alive connection (HTTP/1.1): the old
+    urllib path paid a fresh TCP connect per call, which dominated the
+    transport bench's per-request cost.
     """
 
     # Verbs safe to resend when the transport (not the server) failed:
@@ -275,14 +367,65 @@ class HTTPAPIClient:
     RETRY_BASE_S = 0.05
     RETRY_CAP_S = 0.5
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 watch_batch_s: float = 0.0,
+                 watch_kinds: tuple | None = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # server-side linger per watch poll: >0 trades first-event latency
+        # for fuller (more coalesced) batches under bursty streams
+        self.watch_batch_s = watch_batch_s
+        # server-side kind filter: a consumer that only reads nodes/pods
+        # must not pay the encode/decode of every Event record the
+        # cluster emits. None = the full stream.
+        self.watch_kinds = tuple(watch_kinds) if watch_kinds else None
         self._watchers: list = []
+        self._batch_watchers: list = []
         self._watch_thread = None
         self._stop = threading.Event()
+        self._local = threading.local()  # per-thread keep-alive connection
+        self._conn_lock = threading.Lock()
+        self._conns: set = set()  # every live connection, for close()
         self.retry_count = 0   # transport-level retries performed
         self.watch_errors = 0  # failed watch polls survived
+
+    def _roundtrip(self, method: str, path: str, data, timeout: float):
+        """One request over this thread's keep-alive connection; returns
+        ``(status, body bytes)``. Any transport fault closes the cached
+        connection so the next attempt reconnects cleanly — this is the
+        single seam tests use to inject transport failures."""
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            split = urllib.parse.urlsplit(self.base_url)
+            cls = http.client.HTTPSConnection if split.scheme == "https" \
+                else http.client.HTTPConnection
+            conn = cls(split.hostname, split.port, timeout=timeout)
+            self._local.conn = conn
+            with self._conn_lock:
+                self._conns.add(conn)
+        try:
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            else:
+                conn.timeout = timeout
+                conn.connect()
+                # small JSON requests must not sit out a Nagle window
+                conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+                conn.sock.settimeout(timeout)
+            conn.request(method, path, body=data,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except Exception:
+            self._local.conn = None
+            with self._conn_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
 
     def _req(self, method: str, path: str, body=None, timeout=None):
         """One API round trip. Idempotent verbs retry transient transport
@@ -293,31 +436,11 @@ class HTTPAPIClient:
         attempts = self.RETRY_ATTEMPTS \
             if method in self.IDEMPOTENT_METHODS else 1
         for attempt in range(attempts):
-            req = urllib.request.Request(
-                self.base_url + path, data=data, method=method,
-                headers={"Content-Type": "application/json"})
             try:
-                with urllib.request.urlopen(
-                        req, timeout=timeout or self.timeout) as resp:
-                    return json.loads(resp.read().decode() or "{}")
-            except urllib.error.HTTPError as e:
-                payload = e.read().decode()
-                if e.code == 404:
-                    if method == "DELETE" and attempt > 0:
-                        # Our earlier attempt may have landed and lost its
-                        # reply: this 404 is "already deleted", not "was
-                        # never there". Report success so a caller that
-                        # distinguishes its own delete from an external
-                        # one (NodeLifecycle eviction) is not tricked
-                        # into reading a clean not-found — the transport
-                        # retry must not hide the ambiguity it created.
-                        return {}
-                    raise NotFound(payload)
-                if e.code == 409:
-                    raise Conflict(payload)
-                raise RuntimeError(f"HTTP {e.code}: {payload}")
-            except (urllib.error.URLError, ConnectionError,
-                    TimeoutError, OSError):
+                status, payload = self._roundtrip(
+                    method, path, data, timeout or self.timeout)
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, TimeoutError, OSError):
                 if attempt + 1 >= attempts:
                     raise
                 self.retry_count += 1
@@ -325,6 +448,24 @@ class HTTPAPIClient:
                               self.RETRY_BASE_S * 2 ** attempt)
                 # jitter so a fleet of clients doesn't resend in lockstep
                 self._stop.wait(backoff * (0.5 + random.random() / 2.0))
+                continue
+            if status < 400:
+                return json.loads(payload.decode() or "{}")
+            text = payload.decode()
+            if status == 404:
+                if method == "DELETE" and attempt > 0:
+                    # Our earlier attempt may have landed and lost its
+                    # reply: this 404 is "already deleted", not "was
+                    # never there". Report success so a caller that
+                    # distinguishes its own delete from an external
+                    # one (NodeLifecycle eviction) is not tricked
+                    # into reading a clean not-found — the transport
+                    # retry must not hide the ambiguity it created.
+                    return {}
+                raise NotFound(text)
+            if status == 409:
+                raise Conflict(text)
+            raise RuntimeError(f"HTTP {status}: {text}")
 
     # -- node/pod surface ---------------------------------------------------
 
@@ -349,12 +490,20 @@ class HTTPAPIClient:
     def get_pod(self, name):
         return self._req("GET", f"/pods/{name}")
 
-    def list_pods(self, node_name=None):
-        path = "/pods" + (f"?node={node_name}" if node_name else "")
+    def list_pods(self, node_name=None, phase=None, bound=False):
+        q = [p for p in (f"node={node_name}" if node_name else "",
+                         f"phase={phase}" if phase else "",
+                         "bound=1" if bound else "") if p]
+        path = "/pods" + ("?" + "&".join(q) if q else "")
         return self._req("GET", path)["items"]
 
     def update_pod_annotations(self, name, annotations):
         return self._req("PUT", f"/pods/{name}/annotations", annotations)
+
+    def update_pod_annotations_many(self, annotations):
+        """{pod name -> annotations} replaced in ONE request (and one
+        server lock pass) — the gang paths' N-member stamp."""
+        return self._req("PUT", "/podannotations", annotations)
 
     def bind_pod(self, name, node_name):
         return self._req("POST", f"/pods/{name}/bind", {"node": node_name})
@@ -448,6 +597,10 @@ class HTTPAPIClient:
                          {"kind": kind, "name": name, "type": event_type,
                           "reason": reason, "message": message})
 
+    def record_events(self, events):
+        """Batched event recording: one POST for the whole list."""
+        return self._req("POST", "/events", list(events))
+
     def list_events(self, involved_name=None):
         path = "/events" + (f"?involved={involved_name}"
                             if involved_name else "")
@@ -465,6 +618,16 @@ class HTTPAPIClient:
 
     def add_watcher(self, fn):
         self._watchers.append(fn)
+        self._ensure_watch_thread()
+
+    def add_batch_watcher(self, fn):
+        """Register ``fn(events)`` called once per poll with the whole
+        batch (``[(kind, event, obj), ...]``) — the consumer applies it
+        under ONE cache lock instead of a lock round-trip per event."""
+        self._batch_watchers.append(fn)
+        self._ensure_watch_thread()
+
+    def _ensure_watch_thread(self):
         if self._watch_thread is None:
             self._watch_thread = threading.Thread(
                 target=self._watch_loop, daemon=True, name="api-watch")
@@ -477,14 +640,19 @@ class HTTPAPIClient:
         whole control loop. Failed polls back off exponentially (capped),
         are counted in ``watch_errors``, logged once per failure streak,
         and every recovery resumes from the last seen sequence number —
-        no events skipped, none replayed."""
+        no events skipped, none replayed (the server may COALESCE events
+        per object, but never reorders or rewinds an object's history)."""
         log = logging.getLogger(__name__)
         seq = 0
         failures = 0
         while not self._stop.is_set():
+            path = f"/watch?since={seq}&timeout=5"
+            if self.watch_batch_s > 0:
+                path += f"&batch={self.watch_batch_s}"
+            if self.watch_kinds:
+                path += "&kinds=" + ",".join(self.watch_kinds)
             try:
-                out = self._req("GET", f"/watch?since={seq}&timeout=5",
-                                timeout=30.0)
+                out = self._req("GET", path, timeout=30.0)
             except Exception:
                 self.watch_errors += 1
                 failures += 1
@@ -497,19 +665,47 @@ class HTTPAPIClient:
                 log.info("watch recovered after %d failed polls; "
                          "resuming from seq %d", failures, seq)
                 failures = 0
-            for ev_seq, kind, event, obj in out.get("events", []):
-                seq = max(seq, ev_seq)
-                for fn in list(self._watchers):
+            events = out.get("events", [])
+            if events:
+                metrics.WATCH_BATCH_SIZE.set(len(events))
+                folded = int(out.get("coalesced", 0) or 0)
+                if folded:
+                    metrics.WATCH_COALESCED.inc(folded)
+                batch = []
+                for ev_seq, kind, event, obj in events:
+                    seq = max(seq, ev_seq)
+                    batch.append((kind, event, obj))
+                for bfn in list(self._batch_watchers):
                     try:
-                        fn(kind, event, obj)
+                        bfn(batch)
                     except Exception:
-                        # a bad consumer must not kill the informer, but a
-                        # consumer that throws on every event is a dead
-                        # scheduler cache — it has to be visible
-                        log.warning("watch consumer %r failed on %s %s "
-                                    "event (seq %d)", fn, kind, event,
-                                    ev_seq, exc_info=True)
+                        log.warning("batch watch consumer %r failed on a "
+                                    "%d-event batch", bfn, len(batch),
+                                    exc_info=True)
+                for kind, event, obj in batch:
+                    for fn in list(self._watchers):
+                        try:
+                            fn(kind, event, obj)
+                        except Exception:
+                            # a bad consumer must not kill the informer,
+                            # but a consumer that throws on every event is
+                            # a dead scheduler cache — it must be visible
+                            log.warning("watch consumer %r failed on %s "
+                                        "%s event", fn, kind, event,
+                                        exc_info=True)
             seq = max(seq, out.get("seq", seq))
 
     def close(self):
         self._stop.set()
+        # tear down every thread's keep-alive connection — the old
+        # per-request transport released sockets implicitly; this one
+        # must not leak them past the client's lifetime. A thread caught
+        # mid-request sees a connection error, which is what close means.
+        with self._conn_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -213,12 +213,25 @@ class KubeAPIClient:
     def get_pod(self, name: str) -> dict:
         return self._req("GET", self._pod_path(name))
 
-    def list_pods(self, node_name: str | None = None) -> list:
+    def list_pods(self, node_name: str | None = None,
+                  phase: str | None = None, bound: bool = False) -> list:
         path = self._pod_path()
+        selectors = []
         if node_name:
-            sel = urllib.parse.quote(f"spec.nodeName={node_name}")
+            selectors.append(f"spec.nodeName={node_name}")
+        if phase:
+            selectors.append(f"status.phase={phase}")
+        if selectors:
+            sel = urllib.parse.quote(",".join(selectors))
             path += f"?fieldSelector={sel}"
-        return self._req("GET", path).get("items") or []
+        items = self._req("GET", path).get("items") or []
+        if bound:
+            # the real apiserver has no "nodeName is set" field selector;
+            # filtering client-side keeps the surface identical to the
+            # in-memory/HTTP servers' bound index
+            items = [p for p in items
+                     if (p.get("spec") or {}).get("nodeName")]
+        return items
 
     def update_pod_annotations(self, name: str, annotations: dict) -> dict:
         """Annotation-only strategic-merge patch — `UpdatePodMetadata`'s
@@ -227,6 +240,14 @@ class KubeAPIClient:
             "PATCH", self._pod_path(name),
             {"metadata": {"annotations": annotations}},
             content_type=STRATEGIC_MERGE)
+
+    def update_pod_annotations_many(self, annotations: dict) -> None:
+        """Batched annotation replace. Kubernetes has no multi-object
+        patch, so this degrades to one PATCH per pod — callers written
+        against the batched surface stay correct on a real cluster and
+        get the single-request form on the in-memory/HTTP servers."""
+        for name, ann in annotations.items():
+            self.update_pod_annotations(name, ann)
 
     def bind_pod(self, name: str, node_name: str) -> None:
         """POST the v1 Binding subresource (`scheduler.go:405-417`)."""

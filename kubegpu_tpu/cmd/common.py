@@ -47,21 +47,22 @@ def prometheus_text() -> str:
     """Render the process's metrics in Prometheus exposition format."""
     lines = []
     for h in (metrics.E2E_SCHEDULING_LATENCY, metrics.ALGORITHM_LATENCY,
-              metrics.BINDING_LATENCY):
+              metrics.BINDING_LATENCY, metrics.BIND_LATENCY_MS):
         lines.append(f"# TYPE {h.name} histogram")
         cumulative = 0
         for bound, count in zip(h.buckets, h.counts):
             cumulative += count
-            lines.append(f'{h.name}_bucket{{le="{bound:.0f}"}} {cumulative}')
+            lines.append(f'{h.name}_bucket{{le="{bound:g}"}} {cumulative}')
         lines.append(f'{h.name}_bucket{{le="+Inf"}} {h.n}')
-        lines.append(f"{h.name}_sum {h.total:.0f}")
+        lines.append(f"{h.name}_sum {h.total:.6g}")
         lines.append(f"{h.name}_count {h.n}")
     for c in (metrics.SCHEDULE_ATTEMPTS, metrics.SCHEDULE_FAILURES,
               metrics.PREEMPTION_VICTIMS, metrics.NODE_LOST,
-              metrics.EVICTIONS):
+              metrics.EVICTIONS, metrics.WATCH_COALESCED):
         lines.append(f"# TYPE {c.name} counter")
         lines.append(f"{c.name} {c.value}")
-    for g in (metrics.NODE_READY,):
+    for g in (metrics.NODE_READY, metrics.BIND_INFLIGHT,
+              metrics.WATCH_BATCH_SIZE):
         lines.append(f"# TYPE {g.name} gauge")
         lines.append(f"{g.name} {g.value}")
     return "\n".join(lines) + "\n"

@@ -57,16 +57,32 @@ def build_scheduler(client, args, config: dict | None = None) -> Scheduler:
                       parallelism=args.parallelism,
                       extenders=extenders,
                       priority_weights=config.get("priorityWeights"),
-                      algorithm=algorithm)
+                      algorithm=algorithm,
+                      bind_workers=getattr(args, "bind_workers", 4))
     sched.preemption_enabled = not args.disable_preemption
     return sched
 
 
 def main(argv=None) -> int:
+    # Latency-sensitive control loop sharing its process with watch,
+    # binder, and fit-pool threads: the default 5 ms GIL switch interval
+    # lets any one of them stall the cycle for whole milliseconds.
+    import sys
+
+    sys.setswitchinterval(0.0005)
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--api", default="http://127.0.0.1:8070")
     parser.add_argument("--parallelism", type=int, default=16)
-    parser.add_argument("--bind-async", action="store_true")
+    parser.add_argument("--bind-async", action="store_true",
+                        help="pipelined binder: the scheduling cycle "
+                             "stops at assume and a bounded worker pool "
+                             "overlaps the bind round trips")
+    parser.add_argument("--bind-workers", type=int, default=4,
+                        help="bind worker pool width (with --bind-async)")
+    parser.add_argument("--watch-batch-ms", type=float, default=0.0,
+                        help="server-side linger per watch poll: trades "
+                             "first-event latency for fuller, coalesced "
+                             "event batches")
     parser.add_argument("--disable-preemption", action="store_true")
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--lease-ttl", type=float, default=15.0)
@@ -87,9 +103,14 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     config = common.load_config(args.config)
     common.merge_flags(args, config, ["api", "parallelism", "lease_ttl",
-                                      "node_grace_s", "node_stale_s"])
+                                      "node_grace_s", "node_stale_s",
+                                      "bind_workers", "watch_batch_ms"])
 
-    client = HTTPAPIClient(args.api)
+    # kind-filtered watch: the scheduler consumes node/pod/pv/pvc events
+    # only, so Event records never pay encode/decode on this stream
+    client = HTTPAPIClient(args.api,
+                           watch_batch_s=args.watch_batch_ms / 1e3,
+                           watch_kinds=("node", "pod", "pv", "pvc"))
     holder = f"{os.uname().nodename}-{os.getpid()}"
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

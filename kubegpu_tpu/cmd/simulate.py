@@ -54,6 +54,17 @@ def _fit_cache_summary() -> dict:
             "invalidations": metrics.FIT_CACHE_INVALIDATIONS.value}
 
 
+def _data_plane_summary() -> dict:
+    """Binder-pipeline and watch-batching health (metrics.py): bind
+    latency p50/count, live binder depth, last watch batch size, and
+    events the server coalesced away before delivery."""
+    return {"bind_p50_ms": round(metrics.BIND_LATENCY_MS.percentile(0.5), 3),
+            "bind_count": metrics.BIND_LATENCY_MS.n,
+            "bind_inflight": metrics.BIND_INFLIGHT.value,
+            "watch_batch_size": metrics.WATCH_BATCH_SIZE.value,
+            "watch_coalesced_total": metrics.WATCH_COALESCED.value}
+
+
 def _gang_chips(api, name):
     """Chip-id list a bound pod's allocation annotation pins — the raw
     persisted decision, read back via the codec's decode half."""
@@ -163,6 +174,7 @@ def run_chaos_scenario(seed: int = 0, lost_after_s: float = 0.9,
                 "final_placement": final,
                 "evicted_pods": lifecycle.evicted_total,
                 "fit_cache": _fit_cache_summary(),
+                "data_plane": _data_plane_summary(),
                 "chaos_faults": {f"{c}:{k}": n for (c, k), n
                                  in sorted(net.faults.items())}}
     finally:
@@ -213,7 +225,9 @@ def main(argv=None) -> int:
 
     ds = DevicesScheduler()
     ds.add_device(TPUScheduler())
-    sched = Scheduler(api, ds)
+    # pipelined binder, like the real binary: the data-plane summary
+    # below then reports live bind pipeline numbers
+    sched = Scheduler(api, ds, bind_async=True)
 
     api.create_pod(make_pod("plain-2chip", 2))
     api.create_pod(make_pod("hbm-floored", 1, hbm=90 * 2**30))
@@ -263,9 +277,10 @@ def main(argv=None) -> int:
         rows.append(row)
 
     fit_cache = _fit_cache_summary()
+    data_plane = _data_plane_summary()
     if args.json:
-        print(json.dumps({"placements": rows, "fit_cache": fit_cache},
-                         indent=2))
+        print(json.dumps({"placements": rows, "fit_cache": fit_cache,
+                          "data_plane": data_plane}, indent=2))
     else:
         width = max(len(r["pod"]) for r in rows) + 2
         print(f"{'POD':<{width}}{'NODE':<10}{'CHIPS':<28}{'BOUNDS':<8}VOLUME")
@@ -275,6 +290,11 @@ def main(argv=None) -> int:
         print(f"fit cache: {fit_cache['hits']} hits / "
               f"{fit_cache['misses']} misses / "
               f"{fit_cache['invalidations']} invalidations")
+        print(f"data plane: {data_plane['bind_count']} binds "
+              f"(p50 {data_plane['bind_p50_ms']} ms, "
+              f"{data_plane['bind_inflight']} in flight); last watch "
+              f"batch {data_plane['watch_batch_size']}, "
+              f"{data_plane['watch_coalesced_total']} events coalesced")
     sched.stop()
     return 0
 

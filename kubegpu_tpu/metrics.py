@@ -110,17 +110,30 @@ EVICTIONS = Counter("scheduler_evictions_total")
 FIT_CACHE_HITS = Counter("fit_cache_hits_total")
 FIT_CACHE_MISSES = Counter("fit_cache_misses_total")
 FIT_CACHE_INVALIDATIONS = Counter("fit_cache_invalidations_total")
+# Data plane (scheduler/core.py binder pool + cluster/httpapi.py watch):
+# bind_latency_ms spans submit -> bound (queue wait + every transport
+# round trip) per bind work item; bind_inflight is the live depth of the
+# binder pool (queued + executing). watch_batch_size is the size of the
+# last delivered watch batch; watch_coalesced_total counts events the
+# server folded away (per-object latest-wins) before delivery.
+BIND_LATENCY_MS = Histogram("bind_latency_ms", start_us=0.25)
+BIND_INFLIGHT = Gauge("bind_inflight")
+WATCH_BATCH_SIZE = Gauge("watch_batch_size")
+WATCH_COALESCED = Counter("watch_coalesced_total")
 
 
 def reset_all() -> None:
     """Fresh metric state (tests and bench runs)."""
-    for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY):
-        h.__init__(h.name)
+    for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY,
+              BIND_LATENCY_MS):
+        h.__init__(h.name, start_us=h.buckets[0])
     for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS,
               INTERNAL_ERRORS, NATIVE_FALLBACKS, NODE_LOST, EVICTIONS,
-              FIT_CACHE_HITS, FIT_CACHE_MISSES, FIT_CACHE_INVALIDATIONS):
+              FIT_CACHE_HITS, FIT_CACHE_MISSES, FIT_CACHE_INVALIDATIONS,
+              WATCH_COALESCED):
         c.__init__(c.name)
-    NODE_READY.__init__(NODE_READY.name)
+    for g in (NODE_READY, BIND_INFLIGHT, WATCH_BATCH_SIZE):
+        g.__init__(g.name)
 
 
 class Trace:

@@ -459,6 +459,15 @@ class SchedulerCache:
             if node:
                 node.pod_names.discard(name)
 
+    def apply_batch(self, ops: list) -> None:
+        """Apply a list of ``(bound method, args)`` informer mutations
+        under ONE lock acquisition (the RLock is reentrant): a watch
+        batch of N events costs one lock round-trip instead of N, and
+        no fit pass can observe a half-applied batch."""
+        with self._lock:
+            for fn, args in ops:
+                fn(*args)
+
     def expire_assumed(self, now: float | None = None) -> list:
         """Drop assumed pods whose bind never confirmed (TTL 30s,
         `cache.go:40-81`). Returns expired pod names."""

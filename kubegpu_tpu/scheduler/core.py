@@ -18,6 +18,7 @@ import copy
 import logging
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 from kubegpu_tpu import metrics
@@ -27,6 +28,7 @@ from kubegpu_tpu.scheduler.cache import SchedulerCache
 from kubegpu_tpu.scheduler.equivalence import (devolumed_class,
                                                equivalence_class)
 from kubegpu_tpu.scheduler.queue import SchedulingQueue
+from kubegpu_tpu.utils import list_bound_pods
 
 log = logging.getLogger(__name__)
 
@@ -789,12 +791,16 @@ class GenericScheduler:
         # One pod-list fetch and ONE preemptor parse for the whole pass —
         # the simulation re-checks fit ~2x per candidate per node, so
         # per-check API fetches/JSON decodes would dominate at 64 nodes.
+        # Only BOUND pods (the apiserver's node index): a victim must be
+        # placed to be evictable, and an assumed-but-still-binding pod is
+        # deliberately invisible — deleting a pod mid-bind would race its
+        # own commit.
         api = getattr(self, "api", None)
         if api is None:
             return None
         try:
             pods_by_name = {p["metadata"]["name"]: p
-                            for p in api.list_pods()}
+                            for p in list_bound_pods(api)}
         except Exception:
             return None
         # Eviction can only change a verdict where something evictable
@@ -861,8 +867,7 @@ class GenericScheduler:
             pdbs = list_pdbs()
             if not pdbs:
                 return []
-            bound = [p for p in api.list_pods()
-                     if (p.get("spec") or {}).get("nodeName")]
+            bound = list_bound_pods(api)
         except Exception:
             return []
         state = []
@@ -1033,15 +1038,112 @@ class GenericScheduler:
         return victims, violations
 
 
+class BindWorkerPool:
+    """Bounded pool of bind workers — the data-plane half of the
+    assume-cache design: the scheduling cycle stops at ``assume`` and
+    hands every transport round trip (volume bind, annotation write,
+    binding POST) to this pool, so N binds overlap on the wire and the
+    cycle's latency is independent of transport RTT (upstream
+    kube-scheduler's asynchronous binder).
+
+    Work items are ``(run, on_crash)`` closures from the Scheduler. A
+    worker does its HTTP strictly outside any cache lock (the closures
+    only touch the cache through its own locked methods), and a crashed
+    item can never strand its pods: the catch-all runs ``on_crash``,
+    which forgets the assumes and requeues — requeued, not lost."""
+
+    def __init__(self, workers: int = 4):
+        self.workers = max(1, int(workers))
+        self._cond = threading.Condition()
+        self._items: deque = deque()  # (run, on_crash, submitted_at)
+        self._inflight = 0            # queued + executing
+        self._stopped = False
+        self._threads: list = []
+
+    def submit(self, run, on_crash) -> bool:
+        """Queue a work item. Returns False (instead of raising) when the
+        pool is stopped — a shutdown racing a cycle must let the caller
+        run the item inline rather than strand an assumed pod."""
+        with self._cond:
+            if self._stopped:
+                return False
+            self._items.append((run, on_crash))
+            self._inflight += 1
+            metrics.BIND_INFLIGHT.set(self._inflight)
+            if not self._threads:
+                for i in range(self.workers):
+                    t = threading.Thread(target=self._worker, daemon=True,
+                                         name=f"bind-{i}")
+                    self._threads.append(t)
+                    t.start()
+            self._cond.notify()
+        return True
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._items and not self._stopped:
+                    self._cond.wait(0.5)
+                if not self._items:
+                    return  # stopped and drained
+                run, on_crash = self._items.popleft()
+            try:
+                run()
+            except Exception:
+                # a crashed bind worker must not strand its pods — the
+                # handler releases their assumes and requeues them
+                log.exception("bind work item crashed; requeueing its pods")
+                try:
+                    on_crash()
+                except Exception:
+                    log.exception("bind crash handler failed")
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    metrics.BIND_INFLIGHT.set(self._inflight)
+                    self._cond.notify_all()
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Wait until every submitted item finished. Returns True when
+        there was anything to wait for — the caller then re-checks its
+        queue, because failed binds requeue pods."""
+        waited = False
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._inflight > 0:
+                waited = True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(min(0.5, remaining))
+        return waited
+
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+
 class Scheduler:
     """The control loop: queue -> schedule -> assume -> bind
     (`kube-scheduler/pkg/scheduler.go:174-502`)."""
+
+    # Transport retries inside one bind work item: the bind subresource
+    # is idempotent for the same node (a duplicated or replayed bind is a
+    # no-op), so resending after a lost reply converges — cheaper than a
+    # forget + full replan for every transient blip.
+    BIND_ATTEMPTS = 3
 
     def __init__(self, api, device_scheduler, bind_async: bool = False,
                  parallelism: int = DEFAULT_PARALLELISM,
                  extenders: list | None = None,
                  priority_weights: dict | None = None,
-                 algorithm: factory.AlgorithmConfig | None = None):
+                 algorithm: factory.AlgorithmConfig | None = None,
+                 bind_workers: int = 4):
         from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
 
         self.api = api
@@ -1060,12 +1162,65 @@ class Scheduler:
         self.gang_buffer = GangBuffer()
         self.gang_planner = GangPlanner(self.cache)
         self.bind_async = bind_async
+        # bind_async now means the pipelined binder pool, not a thread
+        # per bind: the cycle stops at assume and the pool overlaps the
+        # transport round trips of up to ``bind_workers`` binds.
+        self._binder = BindWorkerPool(bind_workers) if bind_async else None
+        # single-pod binds spool here and ONE drainer at a time commits
+        # whole runs of them via bind_many — the write-path analogue of
+        # watch delta batching (see _drain_bind_spool). Batch size adapts
+        # to backlog: while the drainer is on the wire the spool grows,
+        # so higher transport RTT yields bigger batches automatically.
+        self._spool_lock = threading.Lock()
+        self._bind_spool: deque = deque()
+        self._spool_draining = False
+        # coordinator ports promised to gangs whose commit is still in
+        # flight (assumed but not yet bound): the port claim only becomes
+        # API-visible when the annotations land, so a concurrent gang
+        # plan must see these or two gangs could share a coordinator port
+        self._gang_lock = threading.Lock()
+        self._gang_ports_inflight: dict = {}  # gang id -> (node, port)
+        # Informer pod mirror, maintained from watch events: the cycle's
+        # per-pod freshness check reads this instead of paying a GET
+        # round trip per pod (upstream kube-scheduler trusts its
+        # informer the same way). Falls back to get_pod on a miss.
+        self._view_lock = threading.Lock()
+        self._pod_view: dict = {}  # pod name -> latest watched object
         self.preemption_enabled = True
         self._stop = threading.Event()
-        api.add_watcher(self._on_event)
+        # A transport exposing batched watch delivery (HTTPAPIClient)
+        # gets the whole batch applied under one cache lock; the
+        # in-process server keeps the per-event path.
+        add_batch = getattr(api, "add_batch_watcher", None)
+        if add_batch is not None:
+            add_batch(self._on_event_batch)
+        else:
+            api.add_watcher(self._on_event)
         self._sync_existing()
 
     # ---- informer plumbing -------------------------------------------------
+
+    def _view_store(self, obj: dict) -> None:
+        with self._view_lock:
+            self._pod_view[obj["metadata"]["name"]] = obj
+
+    def _view_drop(self, name: str) -> None:
+        with self._view_lock:
+            self._pod_view.pop(name, None)
+
+    def _view_get(self, name: str) -> dict | None:
+        with self._view_lock:
+            obj = self._pod_view.get(name)
+        if obj is None:
+            return None
+        # shallow-copy the mutation path (metadata.annotations): the
+        # cycle writes the allocation annotation into its working copy,
+        # which must not corrupt this mirror of server state
+        meta = dict(obj.get("metadata") or {})
+        meta["annotations"] = dict(meta.get("annotations") or {})
+        out = dict(obj)
+        out["metadata"] = meta
+        return out
 
     def _sync_existing(self) -> None:
         """Cold start / restart: rebuild state from the API server — the
@@ -1073,6 +1228,7 @@ class Scheduler:
         for node in self.api.list_nodes():
             self.cache.set_node(node)
         for pod in self.api.list_pods():
+            self._view_store(pod)
             node_name = (pod.get("spec") or {}).get("nodeName")
             if node_name:
                 self.cache.add_pod(pod, node_name)
@@ -1097,12 +1253,15 @@ class Scheduler:
                 self.cache.remove_node(name)
         elif kind == "pod":
             node_name = (obj.get("spec") or {}).get("nodeName")
+            if event in ("added", "modified"):
+                self._view_store(obj)
             if event == "added" and not node_name:
                 self.queue.push(obj)
             elif event == "added" and node_name:
                 # externally-bound pod (static pod / other binder): charge it
                 self.cache.add_pod(obj, node_name)
             elif event == "deleted":
+                self._view_drop(obj["metadata"]["name"])
                 self.queue.forget(obj["metadata"]["name"])
                 self.generic.clear_nomination(obj["metadata"]["name"])
                 self.gang_buffer.discard_pod(obj["metadata"]["name"])
@@ -1114,6 +1273,51 @@ class Scheduler:
             # feasible (unbound-PVC pods wait for a matching PV)
             self.queue.move_all_to_active()
 
+    def _on_event_batch(self, events: list) -> None:
+        """Batched informer apply (HTTP transport): the whole watch batch
+        becomes cache mutations under ONE cache lock (`apply_batch`),
+        then the queue/gang side effects run outside it, and the queue
+        wake-up fires once per batch instead of once per event. Event
+        order within the batch is preserved for cache ops and for queue
+        ops independently; nothing interleaves across the two groups that
+        either side observes."""
+        ops: list = []
+        post: list = []
+        wake = False
+        for kind, event, obj in events:
+            if kind == "node":
+                if event in ("added", "modified"):
+                    ops.append((self.cache.set_node, (obj,)))
+                    wake = True
+                elif event == "deleted":
+                    ops.append((self.cache.remove_node,
+                                (obj["metadata"]["name"],)))
+            elif kind == "pod":
+                name = obj["metadata"]["name"]
+                node_name = (obj.get("spec") or {}).get("nodeName")
+                if event in ("added", "modified"):
+                    self._view_store(obj)
+                if event == "added" and not node_name:
+                    post.append((self.queue.push, (obj,)))
+                elif event == "added" and node_name:
+                    ops.append((self.cache.add_pod, (obj, node_name)))
+                elif event == "deleted":
+                    self._view_drop(name)
+                    post.append((self.queue.forget, (name,)))
+                    post.append((self.generic.clear_nomination, (name,)))
+                    post.append((self.gang_buffer.discard_pod, (name,)))
+                    if node_name:
+                        ops.append((self.cache.remove_pod, (obj, node_name)))
+                    wake = True
+            elif kind in ("pv", "pvc"):
+                wake = True
+        if ops:
+            self.cache.apply_batch(ops)
+        for fn, args in post:
+            fn(*args)
+        if wake:
+            self.queue.move_all_to_active()
+
     # ---- the loop (`scheduler.go:439-502`) ---------------------------------
 
     def schedule_one(self, timeout: float = 0.0) -> bool:
@@ -1122,16 +1326,23 @@ class Scheduler:
         if kube_pod is None:
             return False
         name = kube_pod["metadata"]["name"]
-        try:
-            current = self.api.get_pod(name)
-        except KeyError:
-            return True  # deleted while queued
-        except Exception:
-            # transient transport failure: the pod was already popped, so
-            # dropping it here would lose it forever — park it with
-            # backoff instead and let the next pass re-fetch
-            self.queue.add_unschedulable(kube_pod)
-            return True
+        # Freshness check against the informer mirror (no GET round trip
+        # per pod — the upstream scheduler trusts its informer the same
+        # way); the API is consulted only when the mirror misses. A copy
+        # stale by one watch delivery converges: a deleted pod fails its
+        # bind, gets requeued, and the next pass sees the mirror updated.
+        current = self._view_get(name)
+        if current is None:
+            try:
+                current = self.api.get_pod(name)
+            except KeyError:
+                return True  # deleted while queued
+            except Exception:
+                # transient transport failure: the pod was already popped,
+                # so dropping it here would lose it forever — park it with
+                # backoff instead and let the next pass re-fetch
+                self.queue.add_unschedulable(kube_pod)
+                return True
         if (current.get("spec") or {}).get("nodeName"):
             return True  # already bound elsewhere
         kube_pod = current
@@ -1185,12 +1396,164 @@ class Scheduler:
             return True
 
         self.cache.assume_pod(kube_pod, host)
-        if self.bind_async:
-            threading.Thread(target=self._bind, args=(kube_pod, host, t0),
-                             daemon=True).start()
+        if self._binder is not None:
+            # the cycle stops here: the transport half runs on a bind
+            # worker, overlapping with the next pod's scheduling pass
+            self._submit_bind(kube_pod, host, t0)
         else:
             self._bind(kube_pod, host, t0)
         return True
+
+    def _submit_bind(self, kube_pod: dict, host: str, t0: float) -> None:
+        binder_ext = next((e for e in self.generic.extenders
+                           if getattr(e, "bind_verb", None)), None)
+        if binder_ext is not None:
+            # a bind-verb extender is not promised thread safety (the
+            # gang path keeps extender binds on this thread for the same
+            # reason), so its binds never ride the worker pool
+            self._bind(kube_pod, host, t0)
+            return
+        with self._spool_lock:
+            self._bind_spool.append((kube_pod, host, t0,
+                                     time.perf_counter()))
+            if self._spool_draining:
+                return  # the active drainer's loop will pick this up
+            self._spool_draining = True
+        if not self._binder.submit(self._drain_bind_spool,
+                                   self._spool_crashed):
+            # pool stopped (shutdown race): drain inline so the assumed
+            # pod is bound or requeued, never silently dropped
+            self._drain_bind_spool()
+
+    def _spool_crashed(self) -> None:
+        """Crash handler for the spool drainer: clear the draining flag
+        (items already popped were requeued by the drainer's own
+        handling) and re-arm if work remains."""
+        with self._spool_lock:
+            self._spool_draining = bool(self._bind_spool)
+            rearm = self._spool_draining
+        if rearm and not self._binder.submit(self._drain_bind_spool,
+                                             self._spool_crashed):
+            self._drain_bind_spool()
+
+    def _bind_failed(self, kube_pod: dict) -> None:
+        """Crash handler for a bind work item: whatever died mid-bind,
+        the pod's assumed chips are released and the pod is requeued —
+        requeued, never lost."""
+        self.volume_binder.forget(kube_pod["metadata"]["name"])
+        self.cache.forget_pod(kube_pod)
+        self.queue.add_unschedulable(kube_pod)
+
+    # A spool drain caps its batch so one worker cannot hoard the whole
+    # backlog while its siblings idle.
+    MAX_BIND_BATCH = 16
+
+    def _drain_bind_spool(self) -> None:
+        """The spool drainer: loop popping runs of spooled single-pod
+        binds and committing each run as ONE ``bind_many`` (annotations +
+        bindings in a single round trip) until the spool is empty. Only
+        one drainer runs at a time — that is what makes batching engage:
+        while this loop is on the wire the cycle keeps spooling, so the
+        next run is bigger. A crash mid-run releases every popped pod's
+        assume and requeues it."""
+        while True:
+            with self._spool_lock:
+                count = min(len(self._bind_spool), self.MAX_BIND_BATCH)
+                items = [self._bind_spool.popleft() for _ in range(count)]
+                if not items:
+                    self._spool_draining = False
+                    return
+            try:
+                self._process_bind_items(items)
+            except Exception:
+                log.exception("bind batch crashed; requeueing its pods")
+                for kube_pod, _, _, _ in items:
+                    try:
+                        self._bind_failed(kube_pod)
+                    except Exception:
+                        log.exception("bind crash handler failed for %s",
+                                      kube_pod["metadata"]["name"])
+
+    def _process_bind_items(self, items: list) -> None:
+        if getattr(self.api, "bind_many", None) is None:
+            # no batch verb on this transport: per-pod writes
+            # (bind-verb extenders never reach here — _submit_bind keeps
+            # their binds on the scheduling thread)
+            for kube_pod, host, t0, ts in items:
+                if self._bind(kube_pod, host, t0,
+                              attempts=self.BIND_ATTEMPTS):
+                    metrics.BIND_LATENCY_MS.observe(
+                        (time.perf_counter() - ts) * 1e3)
+            return
+        # even a single pod rides the batch form: bind_many carries its
+        # annotations AND binding in one round trip (vs two)
+        self._bind_batch(items)
+
+    def _bind_batch(self, items: list) -> None:
+        """Coalesced single-pod binds through one ``bind_many``. NOT
+        semantically all-or-nothing (these pods are independent): if the
+        batch write fails, each pod degrades to its own per-pod bind so
+        one bad pod (deleted mid-flight, bound elsewhere) cannot requeue
+        its batch-mates."""
+        ready = []
+        for kube_pod, host, t0, ts in items:
+            name = kube_pod["metadata"]["name"]
+            if not self.volume_binder.bind(name):
+                self.cache.forget_pod(kube_pod)
+                self._event(name, "Warning", "FailedScheduling",
+                            "volume bind conflict; rescheduling")
+                self.queue.add_unschedulable(kube_pod)
+                continue
+            ready.append((kube_pod, host, t0, ts))
+        if not ready:
+            return
+        tb = time.perf_counter()
+        try:
+            self._gang_bind_write(
+                [(p["metadata"]["name"], host, p)
+                 for p, host, _, _ in ready],
+                attempts=self.BIND_ATTEMPTS)
+        except Exception:
+            # degrade to per-pod binds with the same in-place retry
+            # budget (volume binds above are already committed and
+            # bind() re-entry no-ops on them) — one bad pod fails alone
+            for kube_pod, host, t0, ts in ready:
+                if self._bind(kube_pod, host, t0,
+                              attempts=self.BIND_ATTEMPTS):
+                    metrics.BIND_LATENCY_MS.observe(
+                        (time.perf_counter() - ts) * 1e3)
+            return
+        now = time.perf_counter()
+        events = []
+        for kube_pod, host, t0, ts in ready:
+            name = kube_pod["metadata"]["name"]
+            self.cache.confirm_pod(name)
+            self.generic.clear_nomination(name)
+            self.queue.forget(name)
+            events.append({"kind": "Pod", "name": name, "type": "Normal",
+                           "reason": "Scheduled",
+                           "message": f"Successfully assigned {name} "
+                                      f"to {host}"})
+            metrics.BIND_LATENCY_MS.observe((now - ts) * 1e3)
+            metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
+            metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
+        self._events_batch(events)
+
+    def _events_batch(self, events: list) -> None:
+        """Batched Event recording — observability only (an API hiccup
+        must never affect scheduling); one request for the whole batch
+        when the transport offers it."""
+        if not events:
+            return
+        record_many = getattr(self.api, "record_events", None)
+        if record_many is not None:
+            try:
+                record_many(events)
+            except Exception:
+                pass
+            return
+        for e in events:
+            self._event(e["name"], e["type"], e["reason"], e["message"])
 
     def _handle_gang_pod(self, kube_pod: dict, gang: int, size: int) -> None:
         """Buffer gang members; when complete, place the whole pod-set onto
@@ -1230,9 +1593,18 @@ class Scheduler:
             self.generic.clear_nomination(name)
         # Write each member's process contract (rank/count/coordinator)
         # so the runtime hook can hand the gang a jax.distributed mesh.
+        # Ports promised to gangs whose pipelined commit is still in
+        # flight are not API-visible yet, so they ride in explicitly —
+        # without this, two overlapping gangs could share a coordinator.
         from kubegpu_tpu.scheduler.gang import annotate_gang_processes
 
-        annotate_gang_processes(members, assignment, gang, api=self.api)
+        with self._gang_lock:
+            inflight_ports = set(self._gang_ports_inflight.values())
+        coord = annotate_gang_processes(members, assignment, gang,
+                                        api=self.api,
+                                        extra_used=inflight_ports)
+        with self._gang_lock:
+            self._gang_ports_inflight[gang] = coord
         # Pin every member, then validate each against its host through the
         # full predicate stack (HBM floors, core resources) — the planner
         # only reasons about chips and must not bypass feasibility.
@@ -1253,6 +1625,7 @@ class Scheduler:
                                                     meta=meta)
             if not fits:
                 metrics.SCHEDULE_FAILURES.inc()
+                self._release_gang_port(gang)
                 self.queue.add_unschedulable(kube_pod)
                 return
         # Volumes: reserve every member's pvc->pv pairings before any pod
@@ -1267,32 +1640,90 @@ class Scheduler:
                 for done in vol_assumed:
                     self.volume_binder.forget(done)
                 metrics.SCHEDULE_FAILURES.inc()
+                self._release_gang_port(gang)
                 self.queue.add_unschedulable(kube_pod)
                 return
         self.gang_buffer.drop_gang(gang)
-        # Two-phase commit: assume everything (reversible), then bind the
-        # pod-set. Without a delegated binder the bind is one atomic
-        # `bind_many` (all-or-nothing). A bind-verb extender owns EVERY
-        # binding (same contract as the single-pod path) and binds members
-        # one at a time — atomicity then holds only up to the first
-        # failure, and members already bound stay bound.
+        # Two-phase commit: assume everything HERE, in the scheduling
+        # cycle (reversible, and the very next pod must see the charges),
+        # then bind the pod-set. Without a delegated binder the bind is
+        # one atomic `bind_many` (all-or-nothing) — with the pipelined
+        # binder it runs on a bind worker, overlapping the next cycle. A
+        # bind-verb extender owns EVERY binding (same contract as the
+        # single-pod path), binds members one at a time, and stays on the
+        # scheduling thread (extenders are not promised thread safety) —
+        # atomicity then holds only up to the first failure, and members
+        # already bound stay bound.
         binder = next((e for e in self.generic.extenders
                        if getattr(e, "bind_verb", None)), None)
         assumed: list = []
-        committed: list = []
         try:
             for _, node_name, pinned in pinned_members:
                 self.cache.assume_pod(pinned, node_name)
                 assumed.append(pinned)
+        except Exception:
+            metrics.SCHEDULE_FAILURES.inc()
+            for pinned in assumed:
+                self.cache.forget_pod(pinned)
+            for name, _, _ in pinned_members:
+                self.volume_binder.forget(name)
+            self._release_gang_port(gang)
+            for member in members:
+                self.queue.add_unschedulable(member)
+            return
+        if self._binder is not None and binder is None:
+            queued = self._binder.submit(
+                lambda: self._commit_gang(members, pinned_members, gang,
+                                          t0, None,
+                                          attempts=self.BIND_ATTEMPTS),
+                lambda: self._gang_commit_failed(members, pinned_members,
+                                                 gang))
+            if queued:
+                return
+            # pool stopped (shutdown race): commit inline rather than
+            # strand a fully-assumed gang
+        self._commit_gang(members, pinned_members, gang, t0, binder)
+
+    def _gang_bind_write(self, pinned_members: list,
+                         attempts: int = 1) -> None:
+        """One atomic ``bind_many`` with bounded transient-failure retry
+        (pipelined binder only): re-applying the identical bind_many
+        converges — every pod rebinding to its own node is a no-op — so
+        a lost reply is resent instead of costing the gang a replan.
+        Conflict (a member bound elsewhere) and NotFound (a member
+        deleted mid-flight) are definitive server answers: never
+        retried."""
+        from kubegpu_tpu.cluster.apiserver import Conflict, NotFound
+
+        bindings = {n: node for n, node, _ in pinned_members}
+        annotations = {n: p["metadata"].get("annotations") or {}
+                       for n, _, p in pinned_members}
+        attempts = max(1, attempts)
+        for attempt in range(attempts):
+            try:
+                self.api.bind_many(bindings, annotations)
+                return
+            except (Conflict, NotFound):
+                raise
+            except Exception:
+                if attempt + 1 >= attempts:
+                    raise
+                self._stop.wait(0.02 * (attempt + 1))
+
+    def _commit_gang(self, members: list, pinned_members: list, gang: int,
+                     t0: float, binder, attempts: int = 1) -> None:
+        """The transport half of a gang commit: volume binds, then the
+        atomic batch bind (or the delegated binder's per-member path).
+        All members are already assumed; ANY failure forgets every
+        non-committed sibling's assume — zero leaked chips — and
+        requeues."""
+        committed: list = []
+        try:
             for name, _, _ in pinned_members:
                 if not self.volume_binder.bind(name):
                     raise RuntimeError(f"volume bind conflict for {name}")
             if binder is None:
-                self.api.bind_many(
-                    {n: node for n, node, _ in pinned_members},
-                    {n: p["metadata"].get("annotations") or {}
-                     for n, _, p in pinned_members},
-                )
+                self._gang_bind_write(pinned_members, attempts)
                 committed = [n for n, _, _ in pinned_members]
             else:
                 for name, node_name, pinned in pinned_members:
@@ -1325,9 +1756,7 @@ class Scheduler:
                     self.queue.forget(name)
                     continue
                 self.volume_binder.forget(name)
-            for pinned in assumed:
-                if pinned["metadata"]["name"] not in done:
-                    self.cache.forget_pod(pinned)
+                self.cache.forget_pod(pinned)
             if not done:
                 # nothing bound: the whole gang re-buffers and retries
                 for member in members:
@@ -1362,6 +1791,26 @@ class Scheduler:
                             "gang partially bound; retrying member solo "
                             "pinned to its planned chips")
                 self.queue.add_unschedulable(pinned)
+        finally:
+            self._release_gang_port(gang)
+
+    def _gang_commit_failed(self, members: list, pinned_members: list,
+                            gang: int) -> None:
+        """Crash handler for a gang bind work item: the atomic batch's
+        all-or-nothing contract holds even when the commit path itself
+        dies — forget EVERY sibling's assume and requeue the whole
+        gang."""
+        metrics.SCHEDULE_FAILURES.inc()
+        for name, _, pinned in pinned_members:
+            self.volume_binder.forget(name)
+            self.cache.forget_pod(pinned)
+        self._release_gang_port(gang)
+        for member in members:
+            self.queue.add_unschedulable(member)
+
+    def _release_gang_port(self, gang: int) -> None:
+        with self._gang_lock:
+            self._gang_ports_inflight.pop(gang, None)
 
     NOMINATED_NODE_ANNOTATION = "scheduler.alpha.kubernetes.io/nominated-node-name"
 
@@ -1443,7 +1892,9 @@ class Scheduler:
         from kubegpu_tpu.scheduler.gang import gang_key
 
         try:
-            pods = self.api.list_pods()
+            # bound pods only (node-index slice): ownership of chips and
+            # evictability both require a placed pod
+            pods = list_bound_pods(self.api)
         except Exception:
             return False
         pods_by_name: dict = {}
@@ -1541,21 +1992,31 @@ class Scheduler:
             except Exception:
                 return False  # retry later; cache unchanged for the rest
         # protect the freed block: nominate every member onto its planned
-        # host (restart-safe via the persisted annotation, like _try_preempt)
+        # host (restart-safe via the persisted annotation, like
+        # _try_preempt). The stamps ride ONE batched request when the
+        # transport offers it — N members' nominations were N round trips.
+        batch: dict = {}
         for member in members:
             name = member["metadata"]["name"]
-            host = assignment[name][0]
-            try:
-                annotations = dict(
-                    (member.get("metadata") or {}).get("annotations") or {})
-                annotations[self.NOMINATED_NODE_ANNOTATION] = host
-                self.api.update_pod_annotations(name, annotations)
-            except Exception:
-                # the in-memory nomination below still protects the block;
-                # only restart-safety is degraded — worth a trace
-                log.warning("could not persist nominated-node annotation "
-                            "on %s", name, exc_info=True)
-            self.generic.nominate(member, host)
+            annotations = dict(
+                (member.get("metadata") or {}).get("annotations") or {})
+            annotations[self.NOMINATED_NODE_ANNOTATION] = assignment[name][0]
+            batch[name] = annotations
+        update_many = getattr(self.api, "update_pod_annotations_many", None)
+        try:
+            if update_many is not None:
+                update_many(batch)
+            else:
+                for name, annotations in batch.items():
+                    self.api.update_pod_annotations(name, annotations)
+        except Exception:
+            # the in-memory nominations below still protect the block;
+            # only restart-safety is degraded — worth a trace
+            log.warning("could not persist nominated-node annotations on "
+                        "gang %s", sorted(batch), exc_info=True)
+        for member in members:
+            self.generic.nominate(member,
+                                  assignment[member["metadata"]["name"]][0])
         return True
 
     def _assume_volumes(self, kube_pod: dict, host: str) -> bool:
@@ -1567,11 +2028,15 @@ class Scheduler:
             return False
         return self.volume_binder.assume(kube_pod, snap.kube_node)
 
-    def _bind(self, kube_pod: dict, host: str, t0: float) -> None:
+    def _bind(self, kube_pod: dict, host: str, t0: float,
+              attempts: int = 1) -> bool:
         """Volumes first (the kubelet must find claims bound when the pod
         lands), then annotation, then the binding — the kubelet-side hook
         must see allocate_from the moment the pod lands
-        (`scheduler.go:405-417`)."""
+        (`scheduler.go:405-417`). ``attempts`` > 1 (the pipelined binder)
+        retries transient transport failures in place before falling back
+        to forget + requeue. Returns True only when the pod actually
+        bound (failures requeue and return False)."""
         name = kube_pod["metadata"]["name"]
         tb = time.perf_counter()
         if not self.volume_binder.bind(name):
@@ -1581,29 +2046,13 @@ class Scheduler:
             self._event(name, "Warning", "FailedScheduling",
                         "volume bind conflict; rescheduling")
             self.queue.add_unschedulable(kube_pod)
-            return
+            return False
         try:
-            self.api.update_pod_annotations(
-                name, kube_pod["metadata"].get("annotations") or {})
-            # an extender declaring a bind verb owns the binding
-            # (`extender.go:44,90`); an ignorable binder that errors
-            # falls back to the API binding, a non-ignorable one fails
-            # the bind like any API error
-            binder = next((e for e in self.generic.extenders
-                           if getattr(e, "bind_verb", None)), None)
-            if binder is None:
-                self.api.bind_pod(name, host)
-            else:
-                try:
-                    binder.bind(name, host)
-                except Exception:
-                    if not binder.ignorable:
-                        raise
-                    self.api.bind_pod(name, host)
+            self._bind_write(name, kube_pod, host, attempts)
         except Exception:
             self.cache.forget_pod(kube_pod)
             self.queue.add_unschedulable(kube_pod)
-            return
+            return False
         self.cache.confirm_pod(name)
         self.generic.clear_nomination(name)  # reservation served its purpose
         self.queue.forget(name)  # clears any leftover backoff state
@@ -1612,13 +2061,60 @@ class Scheduler:
         now = time.perf_counter()
         metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
         metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
+        return True
+
+    def _bind_write(self, name: str, kube_pod: dict, host: str,
+                    attempts: int = 1) -> None:
+        """The transport half of one bind: annotation write, then the
+        binding. Retried up to ``attempts`` times on transient failures —
+        safe because both writes converge on resend (the annotation
+        replace is idempotent; the bind subresource re-applied for the
+        SAME node is a no-op, so a duplicated or lost-reply bind cannot
+        double-apply). Conflict (bound elsewhere) and NotFound (deleted
+        mid-flight) are the server speaking and are never retried."""
+        from kubegpu_tpu.cluster.apiserver import Conflict, NotFound
+
+        # an extender declaring a bind verb owns the binding
+        # (`extender.go:44,90`); an ignorable binder that errors falls
+        # back to the API binding, a non-ignorable one fails the bind
+        # like any API error
+        binder = next((e for e in self.generic.extenders
+                       if getattr(e, "bind_verb", None)), None)
+        attempts = max(1, attempts)
+        for attempt in range(attempts):
+            try:
+                self.api.update_pod_annotations(
+                    name, kube_pod["metadata"].get("annotations") or {})
+                if binder is None:
+                    self.api.bind_pod(name, host)
+                else:
+                    try:
+                        binder.bind(name, host)
+                    except Exception:
+                        if not binder.ignorable:
+                            raise
+                        self.api.bind_pod(name, host)
+                return
+            except (Conflict, NotFound):
+                raise
+            except Exception:
+                if attempt + 1 >= attempts:
+                    raise
+                self._stop.wait(0.02 * (attempt + 1))
 
     def run_until_idle(self, max_passes: int = 10000) -> int:
         """Drain the queue synchronously (tests, benchmarks). Returns the
-        number of pods processed."""
+        number of pods processed. With the pipelined binder, "idle" also
+        means the bind pool drained — a failed in-flight bind requeues
+        its pod, so the queue is re-checked after every flush."""
         n = 0
-        while n < max_passes and self.schedule_one(timeout=0.0):
-            n += 1
+        while n < max_passes:
+            if self.schedule_one(timeout=0.0):
+                n += 1
+                continue
+            if self._binder is not None and self._binder.flush():
+                continue
+            break
         return n
 
     def run_forever(self, poll_s: float = 0.2) -> None:
@@ -1640,4 +2136,6 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._binder is not None:
+            self._binder.stop()
         self.generic._pool.shutdown(wait=False)

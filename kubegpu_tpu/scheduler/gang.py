@@ -57,7 +57,9 @@ def coordinator_ports_in_use(api, coordinator_node: str) -> set:
     """Ports already promised to live gangs coordinated on ``node`` —
     read from existing pods' process-contract annotations, so the claim
     survives a scheduler restart exactly like every other decision (the
-    API server is the checkpoint, SURVEY.md §6)."""
+    API server is the checkpoint, SURVEY.md §6). Contracts only persist
+    at commit time, so callers with gangs still in flight (the pipelined
+    binder) pass those promises in via ``extra_used`` below."""
     import json
 
     used = set()
@@ -80,17 +82,23 @@ def coordinator_ports_in_use(api, coordinator_node: str) -> set:
 
 
 def annotate_gang_processes(members: list, assignment: dict,
-                            gang: int, api=None) -> None:
+                            gang: int, api=None,
+                            extra_used=()) -> tuple:
     """Write each member's process contract into its annotations.
 
     Rank order is the sorted member-name order (the same determinism
-    rule as everything else); the coordinator is rank 0's node."""
+    rule as everything else); the coordinator is rank 0's node.
+    ``extra_used`` holds ``(node, port)`` promises not yet visible on the
+    API (gang commits in flight on the pipelined binder). Returns the
+    ``(coordinator_node, port)`` claim so the caller can track it until
+    the contract annotations persist."""
     import json
 
     names = sorted(m["metadata"]["name"] for m in members)
     ranks = {name: i for i, name in enumerate(names)}
     coordinator_node = assignment[names[0]][0]
     used = coordinator_ports_in_use(api, coordinator_node) if api else set()
+    used |= {p for node, p in extra_used if node == coordinator_node}
     port = gang_coordinator_port(gang, used)
     for member in members:
         name = member["metadata"]["name"]
@@ -102,6 +110,7 @@ def annotate_gang_processes(members: list, assignment: dict,
             "coordinator_node": coordinator_node,
             "coordinator_port": port,
         }, sort_keys=True)
+    return coordinator_node, port
 
 
 def gang_key(kube_pod: dict):

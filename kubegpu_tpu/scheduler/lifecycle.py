@@ -37,6 +37,7 @@ import time
 from kubegpu_tpu import metrics
 from kubegpu_tpu.cluster.apiserver import Conflict
 from kubegpu_tpu.core import codec
+from kubegpu_tpu.utils import list_bound_pods
 
 log = logging.getLogger(__name__)
 
@@ -258,7 +259,9 @@ class NodeLifecycle:
         subresource does not re-check node existence, same as upstream),
         and nothing else would ever reclaim such a pod."""
         try:
-            pods = self.api.list_pods()
+            # only BOUND pods can be orphans — the apiserver's node index
+            # serves this slice without sweeping every pending pod
+            pods = list_bound_pods(self.api)
             # Re-list nodes NOW: eviction retries above can burn hundreds
             # of ms, and a node registered (plus a pod bound to it) since
             # the tick's snapshot must not read as an orphan.
@@ -292,15 +295,16 @@ class NodeLifecycle:
                 gang_ids.add(key[0])
         if gang_ids:
             try:
-                everything = self.api.list_pods()
+                # gang widening only ever adds BOUND siblings (pending
+                # members just stay queued), so the node-index slice is
+                # the whole search space
+                everything = list_bound_pods(self.api)
             except Exception:
                 log.warning("eviction: cluster pod list failed "
                             "(gang widening for %s)", lost_node,
                             exc_info=True)
                 return [], False
             for pod in everything:
-                if not (pod.get("spec") or {}).get("nodeName"):
-                    continue  # pending members just stay queued
                 key = gang_key(pod)
                 if key is not None and key[0] in gang_ids:
                     victims.setdefault(pod["metadata"]["name"], pod)

@@ -31,6 +31,19 @@ def assign_nested(d: dict, keys: Iterable[str], value: Any) -> None:
     d[keys[-1]] = value
 
 
+def list_bound_pods(api: Any) -> list:
+    """Every pod with ``spec.nodeName`` set, via the API server's
+    pods-by-node index when the client exposes it (``bound=True``),
+    falling back to a full list + filter for older/duck-typed clients —
+    the shared read behind eviction sweeps, gang lookups, and
+    preemption's victim scan."""
+    try:
+        return api.list_pods(bound=True)
+    except TypeError:
+        return [p for p in api.list_pods()
+                if (p.get("spec") or {}).get("nodeName")]
+
+
 def get_nested(d: Mapping, keys: Iterable[str], default: Any = None) -> Any:
     """Fetch the value at nested path ``keys`` or ``default`` if absent.
 

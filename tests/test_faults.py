@@ -6,7 +6,6 @@ SURVEY.md §6; this suite is the TPU build's addition).
 
 import time
 import urllib.error
-import urllib.request
 
 import pytest
 
@@ -151,23 +150,25 @@ def test_backend_discovery_failure_zeroes_then_recovers():
 def test_http_client_retries_idempotent_verbs_only(monkeypatch):
     """Transient transport failures (resets, refused connections) retry
     on idempotent verbs with backoff; POSTs stay single-shot so a
-    bind/create can never double-apply from a blind resend."""
+    bind/create can never double-apply from a blind resend. Faults are
+    injected at ``_roundtrip`` — the keep-alive connection seam every
+    request goes through."""
     api = InMemoryAPIServer()
     server, url = serve_api(api)
     client = HTTPAPIClient(url)
     try:
         api.create_node({"metadata": {"name": "n1"}})
-        real = urllib.request.urlopen
+        real = HTTPAPIClient._roundtrip
         calls = {"n": 0, "fail_next": 2}
 
-        def flaky(req, timeout=None):
+        def flaky(self, method, path, data, timeout):
             calls["n"] += 1
             if calls["fail_next"] > 0:
                 calls["fail_next"] -= 1
                 raise ConnectionResetError("injected reset")
-            return real(req, timeout=timeout)
+            return real(self, method, path, data, timeout)
 
-        monkeypatch.setattr(urllib.request, "urlopen", flaky)
+        monkeypatch.setattr(HTTPAPIClient, "_roundtrip", flaky)
         # GET survives two resets without the caller seeing anything
         assert client.get_node("n1")["metadata"]["name"] == "n1"
         assert client.retry_count == 2
@@ -176,6 +177,29 @@ def test_http_client_retries_idempotent_verbs_only(monkeypatch):
         with pytest.raises(OSError):
             client.create_pod({"metadata": {"name": "px"}})
         assert calls["n"] == 1
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_http_client_reuses_keepalive_connection():
+    """The per-thread connection persists across requests: N calls from
+    one thread ride one TCP connect (HTTP/1.1 keep-alive), which is the
+    transport bench's dominant per-request saving."""
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    try:
+        api.create_node({"metadata": {"name": "n1"}})
+        client.get_node("n1")
+        conn = client._local.conn
+        assert conn is not None
+        sock = conn.sock
+        assert sock is not None
+        for _ in range(5):
+            client.get_node("n1")
+        assert client._local.conn is conn
+        assert conn.sock is sock  # same socket: no reconnects happened
     finally:
         client.close()
         server.shutdown()
@@ -206,16 +230,16 @@ def test_watch_survives_transient_transport_failure(monkeypatch):
         assert wait_for(("node", "added", "n1"), 5.0)
         # break the transport: enough consecutive failures to exhaust
         # _req's in-call retries AND fail whole polls (watch-loop layer)
-        real = urllib.request.urlopen
+        real = HTTPAPIClient._roundtrip
         state = {"fail_next": 8}
 
-        def flaky(req, timeout=None):
+        def flaky(self, method, path, data, timeout):
             if state["fail_next"] > 0:
                 state["fail_next"] -= 1
                 raise urllib.error.URLError("injected transport failure")
-            return real(req, timeout=timeout)
+            return real(self, method, path, data, timeout)
 
-        monkeypatch.setattr(urllib.request, "urlopen", flaky)
+        monkeypatch.setattr(HTTPAPIClient, "_roundtrip", flaky)
         # flush the long-poll already in flight (it predates the fault
         # window and would deliver the next event over the REAL socket)
         api.create_node({"metadata": {"name": "flush"}})
@@ -310,17 +334,17 @@ def test_retried_delete_with_lost_reply_reads_as_success(monkeypatch):
     client = HTTPAPIClient(url)
     try:
         api.create_pod({"metadata": {"name": "p1"}, "spec": {}})
-        real = urllib.request.urlopen
+        real = HTTPAPIClient._roundtrip
         state = {"armed": True}
 
-        def lose_first_delete_reply(req, timeout=None):
-            if req.get_method() == "DELETE" and state["armed"]:
+        def lose_first_delete_reply(self, method, path, data, timeout):
+            if method == "DELETE" and state["armed"]:
                 state["armed"] = False
-                real(req, timeout=timeout).read()  # the delete LANDS
+                real(self, method, path, data, timeout)  # the delete LANDS
                 raise ConnectionResetError("reply lost")  # ...reply lost
-            return real(req, timeout=timeout)
+            return real(self, method, path, data, timeout)
 
-        monkeypatch.setattr(urllib.request, "urlopen",
+        monkeypatch.setattr(HTTPAPIClient, "_roundtrip",
                             lose_first_delete_reply)
         client.delete_pod("p1")  # must NOT raise: our delete landed
         with pytest.raises(NotFound):

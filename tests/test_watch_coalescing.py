@@ -1,0 +1,294 @@
+"""Watch coalescing + delta batching semantics (ISSUE 5 tentpole 2):
+per-object latest-wins, cross-object order preserved, the seq-resume
+contract across dropped batches, batched client delivery, and a chaos
+run (duplicate + delay + drop on the watch verb) converging the client's
+mirror to apiserver state. Plus the apiserver's secondary pod indexes
+and the batched multi-pod annotation write the coalesced data plane
+rides on.
+"""
+
+import random
+import time
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer, NotFound
+from kubegpu_tpu.cluster.httpapi import (HTTPAPIClient, coalesce_events,
+                                         serve_api)
+
+
+def _ev(seq, etype, name, version, kind="node"):
+    return (seq, kind, etype, {"metadata": {"name": name, "v": version}})
+
+
+# ---- coalescing table -------------------------------------------------------
+
+
+def test_per_object_latest_wins():
+    out, folded = coalesce_events([
+        _ev(1, "modified", "a", 1),
+        _ev(2, "modified", "a", 2),
+        _ev(3, "modified", "a", 3)])
+    assert folded == 2
+    assert len(out) == 1
+    seq, _, etype, obj = out[0]
+    # latest content, LAST sequence number — the resume cursor lands
+    # exactly where a full replay would have put it
+    assert (seq, etype, obj["metadata"]["v"]) == (3, "modified", 3)
+
+
+def test_added_then_modified_stays_added_with_latest_content():
+    out, folded = coalesce_events([
+        _ev(1, "added", "a", 1), _ev(2, "modified", "a", 2)])
+    assert folded == 1
+    assert [(e[2], e[3]["metadata"]["v"]) for e in out] == [("added", 2)]
+
+
+def test_added_then_deleted_folds_to_nothing():
+    out, folded = coalesce_events([
+        _ev(1, "added", "a", 1), _ev(2, "deleted", "a", 1)])
+    assert out == [] and folded == 2
+
+
+def test_modified_then_deleted_folds_to_deleted():
+    out, folded = coalesce_events([
+        _ev(1, "modified", "a", 1), _ev(2, "deleted", "a", 1)])
+    assert folded == 1
+    assert [e[2] for e in out] == ["deleted"]
+
+
+def test_no_merge_across_delete():
+    """A re-create after a delete is a NEW object history: collapsing
+    delete+add into a modify would skip the consumer's teardown path."""
+    out, folded = coalesce_events([
+        _ev(1, "modified", "a", 1),
+        _ev(2, "deleted", "a", 1),
+        _ev(3, "added", "a", 2)])
+    assert folded == 1  # only modified+deleted merged
+    assert [e[2] for e in out] == ["deleted", "added"]
+
+
+def test_cross_object_order_preserved():
+    out, folded = coalesce_events([
+        _ev(1, "modified", "a", 1),
+        _ev(2, "added", "b", 1),
+        _ev(3, "modified", "a", 2),
+        _ev(4, "added", "p", 1, kind="pod")])
+    assert folded == 1
+    # chain order follows each object's FIRST event; a's chain carries
+    # its latest content
+    assert [e[3]["metadata"]["name"] for e in out] == ["a", "b", "p"]
+    assert out[0][3]["metadata"]["v"] == 2
+
+
+# ---- seq-resume over the wire ----------------------------------------------
+
+
+def test_watch_burst_coalesces_and_resume_replays_nothing():
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    try:
+        api.create_node({"metadata": {"name": "n1"}})
+        for i in range(5):
+            api.patch_node_metadata("n1", {"labels": {"i": str(i)}})
+        out = client._req("GET", "/watch?since=0&timeout=1")
+        events = out["events"]
+        # added + 5 modifieds collapse into ONE added carrying the final
+        # labels; the cursor advanced past everything folded away
+        assert [(e[1], e[2]) for e in events] == [("node", "added")]
+        assert events[0][3]["metadata"]["labels"]["i"] == "4"
+        assert out["coalesced"] == 5
+        assert out["seq"] == 6
+        out2 = client._req("GET", f"/watch?since={out['seq']}&timeout=0.1")
+        assert out2["events"] == []  # nothing replays after resume
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_seq_resume_across_dropped_batch():
+    """A batch whose reply was lost is simply re-requested from the old
+    cursor: the window replays (possibly further coalesced) with no gap
+    and no skip."""
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    try:
+        api.create_node({"metadata": {"name": "n1"}})
+        api.create_node({"metadata": {"name": "n2"}})
+        first = client._req("GET", "/watch?since=0&timeout=1")
+        assert [e[3]["metadata"]["name"] for e in first["events"]] == \
+            ["n1", "n2"]
+        # the reply above is "lost": re-poll from the same cursor
+        replay = client._req("GET", "/watch?since=0&timeout=1")
+        assert replay["events"] == first["events"]
+        api.patch_node_metadata("n2", {"labels": {"x": "1"}})
+        after = client._req("GET",
+                            f"/watch?since={first['seq']}&timeout=1")
+        # only the new event — nothing before the cursor leaks through
+        assert [(e[2], e[3]["metadata"]["name"])
+                for e in after["events"]] == [("modified", "n2")]
+        assert after["events"][0][0] > first["seq"]
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_batch_watcher_gets_whole_batches_in_order():
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    batches = []
+    try:
+        client.add_batch_watcher(lambda evs: batches.append(list(evs)))
+        for i in range(6):
+            api.create_node({"metadata": {"name": f"n{i}"}})
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if sum(len(b) for b in batches) >= 6:
+                break
+            time.sleep(0.01)
+        flat = [obj["metadata"]["name"] for b in batches
+                for _, _, obj in b]
+        assert flat == [f"n{i}" for i in range(6)]  # in order, exactly once
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_chaos_watch_duplicate_delay_converges(monkeypatch):
+    """Duplicate + delay + drop faults on the watch verb: the mirror a
+    watcher builds from delivered events converges to apiserver state —
+    coalescing must not reorder any object's history."""
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url, watch_batch_s=0.005)
+    rng = random.Random(0)
+    real = HTTPAPIClient._roundtrip
+
+    def chaotic(self, method, path, data, timeout):
+        if path.startswith("/watch"):
+            roll = rng.random()
+            if roll < 0.2:
+                raise ConnectionError("chaos: dropped watch poll")
+            if roll < 0.4:
+                time.sleep(0.005)  # delayed delivery
+            elif roll < 0.6:
+                real(self, method, path, data, timeout)  # duplicate poll
+        return real(self, method, path, data, timeout)
+
+    monkeypatch.setattr(HTTPAPIClient, "_roundtrip", chaotic)
+    mirror = {}
+
+    def apply(kind, event, obj):
+        name = obj["metadata"]["name"]
+        if event == "deleted":
+            mirror.pop((kind, name), None)
+        else:
+            mirror[(kind, name)] = obj
+
+    try:
+        client.add_watcher(apply)
+        for i in range(10):
+            api.create_node({"metadata": {"name": f"n{i}"}})
+        for i in range(10):
+            api.patch_node_metadata(f"n{i}", {"labels": {"x": str(i)}})
+        for i in range(0, 10, 2):
+            api.delete_node(f"n{i}")
+        survivors = {("node", f"n{i}") for i in (1, 3, 5, 7, 9)}
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if set(mirror) == survivors and all(
+                    mirror[("node", f"n{i}")]["metadata"]["labels"]["x"]
+                    == str(i) for i in (1, 3, 5, 7, 9)):
+                break
+            time.sleep(0.02)
+        assert set(mirror) == survivors
+        for i in (1, 3, 5, 7, 9):
+            assert mirror[("node", f"n{i}")]["metadata"]["labels"]["x"] \
+                == str(i)
+    finally:
+        client.close()
+        server.shutdown()
+
+
+# ---- secondary pod indexes --------------------------------------------------
+
+
+def _names(pods):
+    return [p["metadata"]["name"] for p in pods]
+
+
+def test_pod_indexes_track_bind_and_delete():
+    api = InMemoryAPIServer()
+    api.create_node({"metadata": {"name": "n1"}})
+    api.create_pod({"metadata": {"name": "a"}, "spec": {}})
+    api.create_pod({"metadata": {"name": "b"}, "spec": {}})
+    assert _names(api.list_pods(phase="Pending")) == ["a", "b"]
+    assert api.list_pods(bound=True) == []
+    api.bind_pod("a", "n1")
+    assert _names(api.list_pods(node_name="n1")) == ["a"]
+    assert _names(api.list_pods(bound=True)) == ["a"]
+    assert _names(api.list_pods(phase="Scheduled")) == ["a"]
+    assert _names(api.list_pods(phase="Pending")) == ["b"]
+    assert _names(api.list_pods()) == ["a", "b"]
+    api.delete_pod("a")
+    assert api.list_pods(node_name="n1") == []
+    assert api.list_pods(bound=True) == []
+    assert api.list_pods(phase="Scheduled") == []
+
+
+def test_externally_bound_pod_indexed_at_create():
+    api = InMemoryAPIServer()
+    api.create_pod({"metadata": {"name": "static"},
+                    "spec": {"nodeName": "n9"}})
+    assert _names(api.list_pods(node_name="n9")) == ["static"]
+    assert _names(api.list_pods(bound=True)) == ["static"]
+
+
+def test_bind_many_moves_index_buckets():
+    api = InMemoryAPIServer()
+    api.create_node({"metadata": {"name": "n1"}})
+    api.create_node({"metadata": {"name": "n2"}})
+    for n in ("g0", "g1"):
+        api.create_pod({"metadata": {"name": n}, "spec": {}})
+    api.bind_many({"g0": "n1", "g1": "n2"}, {})
+    assert _names(api.list_pods(node_name="n1")) == ["g0"]
+    assert _names(api.list_pods(node_name="n2")) == ["g1"]
+    assert _names(api.list_pods(bound=True)) == ["g0", "g1"]
+    assert api.list_pods(phase="Pending") == []
+
+
+def test_update_pod_annotations_many_is_validated_up_front():
+    api = InMemoryAPIServer()
+    api.create_pod({"metadata": {"name": "a"}, "spec": {}})
+    with pytest.raises(NotFound):
+        api.update_pod_annotations_many({"a": {"k": "v"}, "ghost": {}})
+    # all-or-nothing: the missing pod failed the batch BEFORE any write
+    assert api.get_pod("a")["metadata"].get("annotations") is None
+    api.update_pod_annotations_many({"a": {"k": "v"}})
+    assert api.get_pod("a")["metadata"]["annotations"] == {"k": "v"}
+
+
+def test_http_routes_for_indexes_and_batch_annotations():
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    try:
+        client.create_node({"metadata": {"name": "n1"}})
+        client.create_pod({"metadata": {"name": "a"}, "spec": {}})
+        client.create_pod({"metadata": {"name": "b"}, "spec": {}})
+        client.bind_pod("a", "n1")
+        assert _names(client.list_pods(bound=True)) == ["a"]
+        assert _names(client.list_pods(phase="Pending")) == ["b"]
+        assert _names(client.list_pods(node_name="n1")) == ["a"]
+        client.update_pod_annotations_many(
+            {"a": {"x": "1"}, "b": {"y": "2"}})
+        assert client.get_pod("a")["metadata"]["annotations"] == {"x": "1"}
+        assert client.get_pod("b")["metadata"]["annotations"] == {"y": "2"}
+        with pytest.raises(NotFound):
+            client.update_pod_annotations_many({"ghost": {}})
+    finally:
+        client.close()
+        server.shutdown()
