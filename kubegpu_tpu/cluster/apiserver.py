@@ -10,15 +10,78 @@ get/patch node metadata, get/update pod annotations, bind.
 from __future__ import annotations
 
 import copy
+import json
 import threading
+
+from kubegpu_tpu.cluster.lease import LeaseTable
+from kubegpu_tpu.core import codec, grammar
+
+# The gang process contract's annotation key (scheduler/gang.py writes
+# it). Spelled out here rather than imported: the cluster layer must not
+# depend on the scheduler package — the arbiter only reads the wire shape.
+_GANG_PROCESS_ANNOTATION = "pod.alpha/GangProcess"
 
 
 class NotFound(KeyError):
-    pass
+    """Object missing. Batched verbs (``bind_many``,
+    ``update_pod_annotations_many``) attach ``per_pod`` — {pod name ->
+    reason} — so a client can tell WHICH pods failed instead of
+    degrading the whole batch."""
+
+    def __init__(self, message: str = "", per_pod: dict | None = None):
+        super().__init__(message)
+        self.per_pod = dict(per_pod or {})
 
 
 class Conflict(RuntimeError):
-    pass
+    """Optimistic-concurrency refusal: the write would contradict
+    committed state (pod bound elsewhere, chip already allocated to
+    another bound pod, coordinator port promised to another gang).
+    ``per_pod`` carries the per-pod reasons for batched verbs — the
+    binder uses it to forget+requeue exactly the losers and commit the
+    rest, and to distinguish this definitive server answer from a
+    transient transport failure (which retries in place)."""
+
+    def __init__(self, message: str = "", per_pod: dict | None = None):
+        super().__init__(message)
+        self.per_pod = dict(per_pod or {})
+
+
+def _pod_claims(annotations: dict | None) -> tuple:
+    """What a pod's annotations pin on its node: ``(chip prefixes,
+    coordinator claim | None)``. Chip prefixes come from the device
+    allocation's ``allocatefrom`` paths ((node, prefix) identifies a
+    physical chip — same keying as the gang preemption planner); the
+    coordinator claim is ``(node, port, gang id)`` from the gang process
+    contract. Unparseable annotations claim nothing — the arbiter must
+    never turn a malformed pod into a refused bind."""
+    chips: set = set()
+    coord = None
+    ann = annotations or {}
+    raw = ann.get(codec.POD_ANNOTATION_KEY)
+    if raw:
+        try:
+            dev = json.loads(raw)
+        except (TypeError, ValueError):
+            dev = None
+        if isinstance(dev, dict):
+            for section in ("initcontainer", "runningcontainer"):
+                for cont in (dev.get(section) or {}).values():
+                    if not isinstance(cont, dict):
+                        continue
+                    for path in (cont.get("allocatefrom") or {}).values():
+                        prefix = grammar.chip_prefix_from_path(str(path))
+                        if prefix is not None:
+                            chips.add(prefix)
+    raw = ann.get(_GANG_PROCESS_ANNOTATION)
+    if raw:
+        try:
+            gp = json.loads(raw)
+            coord = (str(gp["coordinator_node"]),
+                     int(gp["coordinator_port"]), int(gp["gang"]))
+        except (TypeError, ValueError, KeyError):
+            coord = None
+    return chips, coord
 
 
 def _merge(dst: dict, patch: dict) -> None:
@@ -54,6 +117,29 @@ class InMemoryAPIServer:
         # every pod in the cluster.
         self._pods_by_node: dict = {}   # node name -> {pod names}
         self._pods_by_phase: dict = {}  # status.phase -> {pod names}
+        # Optimistic-concurrency claim indexes (multi-scheduler HA): what
+        # each BOUND pod's annotations pin, maintained by the same
+        # index/deindex discipline as the pod indexes above. bind_pod /
+        # bind_many arbitrate against these — a bind that would
+        # oversubscribe a chip or re-bind a taken coordinator port
+        # returns Conflict with per-pod detail, which is what lets N
+        # scheduler replicas commit through one shared store safely.
+        self._chip_claims: dict = {}   # (node, chip prefix) -> pod name
+        self._coord_claims: dict = {}  # (node, port) -> [gang id, {pods}]
+        # Leader-election / shard-ownership leases, served uniformly by
+        # every client surface (in-process here, HTTP via httpapi).
+        self._leases = LeaseTable()
+
+    # ---- leases ------------------------------------------------------------
+
+    def acquire_lease(self, name: str, holder: str, ttl_s: float) -> bool:
+        return self._leases.acquire(name, holder, ttl_s)
+
+    def lease_holder(self, name: str):
+        return self._leases.holder(name)
+
+    def release_lease(self, name: str, holder: str) -> bool:
+        return self._leases.release(name, holder)
 
     MAX_EVENTS = 5000
 
@@ -113,6 +199,15 @@ class InMemoryAPIServer:
         phase = (pod.get("status") or {}).get("phase")
         if node:
             self._pods_by_node.setdefault(node, set()).add(name)
+            chips, coord = _pod_claims(
+                (pod.get("metadata") or {}).get("annotations"))
+            for prefix in chips:
+                self._chip_claims[(node, prefix)] = name
+            if coord is not None:
+                cnode, port, gang = coord
+                entry = self._coord_claims.setdefault((cnode, port),
+                                                      [gang, set()])
+                entry[1].add(name)
         if phase:
             self._pods_by_phase.setdefault(phase, set()).add(name)
 
@@ -128,12 +223,94 @@ class InMemoryAPIServer:
                 bucket.discard(name)
                 if not bucket:
                     del self._pods_by_node[node]
+            chips, coord = _pod_claims(
+                (pod.get("metadata") or {}).get("annotations"))
+            for prefix in chips:
+                if self._chip_claims.get((node, prefix)) == name:
+                    del self._chip_claims[(node, prefix)]
+            if coord is not None:
+                cnode, port, _gang = coord
+                entry = self._coord_claims.get((cnode, port))
+                if entry is not None:
+                    entry[1].discard(name)
+                    if not entry[1]:
+                        del self._coord_claims[(cnode, port)]
         if phase:
             bucket = self._pods_by_phase.get(phase)
             if bucket is not None:
                 bucket.discard(name)
                 if not bucket:
                     del self._pods_by_phase[phase]
+
+    def _bind_conflicts_locked(self, bindings: dict,
+                               annotations: dict) -> dict:
+        # Always called with self._lock held. The optimistic-concurrency
+        # arbiter: per-pod reasons a proposed bind set must be refused —
+        # pod already bound elsewhere, a chip already allocated to
+        # another BOUND pod (or claimed twice within this batch), or a
+        # coordinator port promised to a different gang. A pod re-bound
+        # to its own node is a no-op (retries converge) and is never a
+        # conflict with itself.
+        per_pod: dict = {}
+        batch_chips: dict = {}   # (node, prefix) -> pod name in this batch
+        batch_coords: dict = {}  # (node, port) -> gang id in this batch
+        for name in sorted(bindings):
+            node_name = bindings[name]
+            pod = self._pods.get(name)
+            if pod is None:
+                continue  # caller raises NotFound with its own detail
+            bound = (pod.get("spec") or {}).get("nodeName")
+            if bound and bound != node_name:
+                per_pod[name] = f"already bound to {bound}"
+                continue
+            ann = annotations.get(name)
+            if bound:
+                # Re-binding a bound pod converges ONLY when it carries
+                # the identical allocation (a lost-reply resend). A
+                # competing replica's DIFFERENT allocation for the same
+                # pod is a conflicting commit — accepting it would
+                # silently swap the pod's chips under every other
+                # replica's accounting.
+                cur = (pod.get("metadata") or {}).get("annotations") or {}
+                if ann is not None and any(
+                        (ann or {}).get(key) != cur.get(key)
+                        for key in (codec.POD_ANNOTATION_KEY,
+                                    _GANG_PROCESS_ANNOTATION)):
+                    per_pod[name] = ("already bound with a different "
+                                     "allocation")
+                continue  # identical resend: no-op, claims stand
+            if ann is None:
+                ann = (pod.get("metadata") or {}).get("annotations") or {}
+            chips, coord = _pod_claims(ann)
+            reasons = []
+            for prefix in sorted(chips):
+                owner = self._chip_claims.get((node_name, prefix))
+                if owner is not None and owner != name:
+                    reasons.append(f"chip {prefix} on {node_name} "
+                                   f"taken by {owner}")
+                    continue
+                rival = batch_chips.get((node_name, prefix))
+                if rival is not None and rival != name:
+                    reasons.append(f"chip {prefix} on {node_name} "
+                                   f"claimed twice in batch (by {rival})")
+                    continue
+                batch_chips[(node_name, prefix)] = name
+            if coord is not None:
+                cnode, port, gang = coord
+                entry = self._coord_claims.get((cnode, port))
+                if entry is not None and entry[0] != gang:
+                    reasons.append(f"coordinator port {port} on {cnode} "
+                                   f"taken by gang {entry[0]}")
+                else:
+                    rival_gang = batch_coords.get((cnode, port))
+                    if rival_gang is not None and rival_gang != gang:
+                        reasons.append(f"coordinator port {port} on "
+                                       f"{cnode} claimed twice in batch")
+                    else:
+                        batch_coords[(cnode, port)] = gang
+            if reasons:
+                per_pod[name] = "; ".join(reasons)
+        return per_pod
 
     def create_pod(self, pod: dict) -> dict:
         with self._lock:
@@ -178,37 +355,87 @@ class InMemoryAPIServer:
                         if (p.get("status") or {}).get("phase") == phase]
             return [copy.deepcopy(p) for p in pods]
 
+    def _allocation_guard_locked(self, name: str,
+                                 new_ann: dict) -> str | None:
+        # Always called with self._lock held. A BOUND pod's allocation
+        # annotations (device allocation + gang process contract) are
+        # immutable: they are the committed placement every scheduler
+        # replica's accounting derives from, so rewriting them (a losing
+        # replica's stale stamp) would silently swap the pod's chips
+        # under the whole control plane. Same-value rewrites (lost-reply
+        # resends) stay allowed; everything else on the pod too.
+        pod = self._pods[name]
+        if not (pod.get("spec") or {}).get("nodeName"):
+            return None
+        cur = (pod.get("metadata") or {}).get("annotations") or {}
+        for key in (codec.POD_ANNOTATION_KEY, _GANG_PROCESS_ANNOTATION):
+            if cur.get(key) != (new_ann or {}).get(key):
+                return (f"pod {name} is bound; its allocation "
+                        f"annotations are immutable")
+        return None
+
     def update_pod_annotations(self, name: str, annotations: dict) -> dict:
         """Replace a pod's annotations, nothing else — the guarantee
-        `UpdatePodMetadata` provides (`kubeinterface.go:175-193`)."""
+        `UpdatePodMetadata` provides (`kubeinterface.go:175-193`). A
+        bound pod's claim indexes follow its annotations (deindex old,
+        index new) so the arbiter always sees committed state, and its
+        ALLOCATION annotations are immutable (see
+        `_allocation_guard_locked`)."""
         with self._lock:
             if name not in self._pods:
                 raise NotFound(f"pod {name}")
-            meta = self._pods[name].setdefault("metadata", {})
+            reason = self._allocation_guard_locked(name, annotations)
+            if reason:
+                raise Conflict(reason, per_pod={name: reason})
+            pod = self._pods[name]
+            self._deindex_pod_locked(pod)
+            meta = pod.setdefault("metadata", {})
             meta["annotations"] = copy.deepcopy(annotations)
-            self._notify_locked("pod", "modified", self._pods[name])
-            return copy.deepcopy(self._pods[name])
+            self._index_pod_locked(pod)
+            self._notify_locked("pod", "modified", pod)
+            return copy.deepcopy(pod)
 
     def update_pod_annotations_many(self, annotations: dict) -> None:
         """Batched `update_pod_annotations`: {pod name -> annotation dict}
         applied in one request / one lock acquisition, validated up front
-        so a missing pod fails the batch before anything is written. This
-        is the multi-key write the gang paths use so N members' stamps
-        ride one transport round trip instead of N."""
+        so a missing pod (NotFound) or an immutable-allocation violation
+        (Conflict) fails the batch before anything is written — with
+        per-pod detail, so the caller can drop exactly the bad pods and
+        re-send the rest instead of abandoning the whole batch. This is
+        the multi-key write the gang paths use so N members' stamps ride
+        one transport round trip instead of N."""
         with self._lock:
-            for name in annotations:
-                if name not in self._pods:
-                    raise NotFound(f"pod {name}")
+            missing = {name: "not found" for name in annotations
+                       if name not in self._pods}
+            if missing:
+                raise NotFound(f"pods not found: {sorted(missing)}",
+                               per_pod=missing)
+            refused = {}
+            for name, ann in annotations.items():
+                reason = self._allocation_guard_locked(name, ann)
+                if reason:
+                    refused[name] = reason
+            if refused:
+                raise Conflict(
+                    f"allocation annotations immutable for "
+                    f"{sorted(refused)}", per_pod=refused)
             changed = []
             for name, ann in annotations.items():
-                meta = self._pods[name].setdefault("metadata", {})
+                pod = self._pods[name]
+                self._deindex_pod_locked(pod)
+                meta = pod.setdefault("metadata", {})
                 meta["annotations"] = copy.deepcopy(ann)
-                changed.append(self._pods[name])
+                self._index_pod_locked(pod)
+                changed.append(pod)
             for pod in changed:
                 self._notify_locked("pod", "modified", pod)
 
     def bind_pod(self, name: str, node_name: str) -> None:
-        """The bind subresource: sets spec.nodeName exactly once."""
+        """The bind subresource: sets spec.nodeName exactly once. The
+        conflict arbiter also refuses a bind whose annotation claims a
+        chip another bound pod holds or a coordinator port promised to a
+        different gang — re-applying the same bind for the same node
+        stays a converging no-op."""
         with self._lock:
             if name not in self._pods:
                 raise NotFound(f"pod {name}")
@@ -216,6 +443,11 @@ class InMemoryAPIServer:
             bound = pod.get("spec", {}).get("nodeName")
             if bound and bound != node_name:
                 raise Conflict(f"pod {name} already bound to {bound}")
+            if not bound:
+                conflicts = self._bind_conflicts_locked({name: node_name}, {})
+                if conflicts:
+                    raise Conflict(f"pod {name}: {conflicts[name]}",
+                                   per_pod=conflicts)
             self._deindex_pod_locked(pod)
             pod.setdefault("spec", {})["nodeName"] = node_name
             pod.setdefault("status", {})["phase"] = "Scheduled"
@@ -223,22 +455,40 @@ class InMemoryAPIServer:
             self._notify_locked("pod", "modified", pod)
 
     def bind_many(self, bindings: dict, annotations: dict) -> None:
-        """Atomically annotate and bind a pod-set (gang commit): either every
-        pod binds or none does. ``bindings``: pod name -> node name;
-        ``annotations``: pod name -> annotation dict."""
+        """Atomically annotate and bind a pod-set (gang commit): either
+        every pod binds or none does. ``bindings``: pod name -> node
+        name; ``annotations``: pod name -> annotation dict.
+
+        This is the conflict-commit arbiter for N optimistic scheduler
+        replicas over shared state (Omega-style): a bind that would
+        re-bind a pod, oversubscribe a chip, or take another gang's
+        coordinator port refuses the WHOLE batch — gangs stay
+        all-or-nothing across competing replicas — and the Conflict /
+        NotFound carries per-pod reasons so the losing replica's binder
+        forgets + requeues exactly the refused pods, never retries them
+        blind."""
         with self._lock:
-            for name, node_name in bindings.items():
-                if name not in self._pods:
-                    raise NotFound(f"pod {name}")
-                bound = self._pods[name].get("spec", {}).get("nodeName")
-                if bound and bound != node_name:
-                    raise Conflict(f"pod {name} already bound to {bound}")
+            missing = {name: "not found" for name in bindings
+                       if name not in self._pods}
+            if missing:
+                raise NotFound(f"pods not found: {sorted(missing)}",
+                               per_pod=missing)
+            conflicts = self._bind_conflicts_locked(bindings, annotations)
+            if conflicts:
+                first = next(iter(sorted(conflicts)))
+                raise Conflict(
+                    f"bind refused for {len(conflicts)} pod(s), e.g. "
+                    f"{first}: {conflicts[first]}", per_pod=conflicts)
             changed = []
             for name, node_name in bindings.items():
                 pod = self._pods[name]
-                meta = pod.setdefault("metadata", {})
-                meta["annotations"] = copy.deepcopy(annotations.get(name, {}))
                 self._deindex_pod_locked(pod)
+                meta = pod.setdefault("metadata", {})
+                if name in annotations:
+                    meta["annotations"] = copy.deepcopy(annotations[name])
+                # a bindings-only entry (no annotations key) keeps the
+                # pod's existing annotations: a resend must never wipe a
+                # bound pod's allocation record and release its claims
                 pod.setdefault("spec", {})["nodeName"] = node_name
                 pod.setdefault("status", {})["phase"] = "Scheduled"
                 self._index_pod_locked(pod)
@@ -508,6 +758,102 @@ class InMemoryAPIServer:
                 out = [e for e in out
                        if e["involvedObject"]["name"] == involved_name]
             return [copy.deepcopy(e) for e in out]
+
+    # ---- durability (cluster/wal.py) ---------------------------------------
+
+    _STORES = ("nodes", "pods", "pdbs", "pvcs", "pvs")
+
+    def dump_state(self) -> dict:
+        """JSON-serializable full object state for WAL snapshots.
+        Reentrant under the server lock: the event log calls this from
+        inside a watch notification (the mutator's RLock is held), which
+        is exactly what makes the snapshot consistent with its sequence
+        number."""
+        with self._lock:
+            out: dict = {store: copy.deepcopy(getattr(self, f"_{store}"))
+                         for store in self._STORES}
+            out["owners"] = copy.deepcopy(self._owners)
+            out["events"] = [copy.deepcopy(ev)
+                             for ev in self._events.values()]
+            return out
+
+    def snapshot_with(self, seq_fn):
+        """``(dump_state(), seq_fn())`` atomically: under the mutation
+        lock nothing can notify, so the event-log cursor ``seq_fn``
+        reads cannot move between the two — the WAL snapshot's state and
+        sequence number always agree."""
+        with self._lock:
+            return self.dump_state(), seq_fn()
+
+    def restore_state(self, state: dict) -> None:
+        """Load a snapshot (WAL recovery): replaces all object state and
+        rebuilds every secondary index and claim table. Notifies nobody —
+        watchers resume through the event log's sequence numbers, not a
+        replayed storm of synthetic events."""
+        with self._lock:
+            for store in self._STORES:
+                setattr(self, f"_{store}",
+                        copy.deepcopy(state.get(store) or {}))
+            owners = state.get("owners") or {}
+            self._owners = {k: copy.deepcopy(owners.get(k) or {})
+                            for k in ("service", "rc", "rs", "statefulset")}
+            self._events = {}
+            for ev in state.get("events") or []:
+                inv = ev.get("involvedObject") or {}
+                key = (inv.get("kind"), inv.get("name"),
+                       ev.get("reason"), ev.get("message"))
+                self._events[key] = copy.deepcopy(ev)
+            self._rebuild_indexes_locked()
+
+    def restore_object(self, kind: str, event: str, obj: dict) -> None:
+        """Apply ONE replayed watch record to state, without notifying —
+        the WAL recovery state machine. Watch events carry whole
+        objects, so added/modified store and deleted removes."""
+        with self._lock:
+            if kind == "event":
+                inv = obj.get("involvedObject") or {}
+                key = (inv.get("kind"), inv.get("name"),
+                       obj.get("reason"), obj.get("message"))
+                if event == "deleted":
+                    self._events.pop(key, None)
+                else:
+                    self._events[key] = copy.deepcopy(obj)
+                return
+            name = (obj.get("metadata") or {}).get("name")
+            if not name:
+                return
+            if kind == "pod":
+                existing = self._pods.get(name)
+                if existing is not None:
+                    self._deindex_pod_locked(existing)
+                if event == "deleted":
+                    self._pods.pop(name, None)
+                else:
+                    stored = copy.deepcopy(obj)
+                    self._pods[name] = stored
+                    self._index_pod_locked(stored)
+                return
+            store = {"node": self._nodes, "pdb": self._pdbs,
+                     "pvc": self._pvcs, "pv": self._pvs}.get(kind)
+            if store is None:
+                store = self._owners.get(kind)
+            if store is None:
+                return  # unknown kind in the log: skip, never fatal
+            if event == "deleted":
+                store.pop(name, None)
+            else:
+                store[name] = copy.deepcopy(obj)
+
+    def _rebuild_indexes_locked(self) -> None:
+        # Always called with self._lock held, after a wholesale state
+        # replacement: the secondary indexes and claim tables are pure
+        # derivations of the pod store.
+        self._pods_by_node = {}
+        self._pods_by_phase = {}
+        self._chip_claims = {}
+        self._coord_claims = {}
+        for pod in self._pods.values():
+            self._index_pod_locked(pod)
 
     # ---- watch -------------------------------------------------------------
 

@@ -40,30 +40,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubegpu_tpu import metrics
 from kubegpu_tpu.cluster.apiserver import Conflict, InMemoryAPIServer, NotFound
-
-
-class LeaseTable:
-    """TTL leases for leader election."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._leases: dict = {}  # name -> (holder, expires_at)
-
-    def acquire(self, name: str, holder: str, ttl_s: float) -> bool:
-        with self._lock:
-            now = time.monotonic()
-            current = self._leases.get(name)
-            if current is not None and current[1] > now and current[0] != holder:
-                return False
-            self._leases[name] = (holder, now + ttl_s)
-            return True
-
-    def holder(self, name: str):
-        with self._lock:
-            current = self._leases.get(name)
-            if current is None or current[1] <= time.monotonic():
-                return None
-            return current[0]
+from kubegpu_tpu.cluster.lease import LeaseTable  # noqa: F401  (re-export:
+# the lease primitive moved to cluster/lease.py; the API server owns its
+# own table now and the routes below delegate to it)
 
 
 def coalesce_events(events: list) -> tuple:
@@ -108,22 +87,89 @@ def coalesce_events(events: list) -> tuple:
 
 
 class _EventLog:
-    """Bounded sequence-numbered event log backing /watch long-polls."""
+    """Bounded sequence-numbered event log backing /watch long-polls.
 
-    def __init__(self, api: InMemoryAPIServer, limit: int = 10000):
+    With a ``wal`` (cluster/wal.py), the log is durable: every record is
+    appended to the WAL *before* any watcher can see it, the apiserver's
+    object state is rebuilt from snapshot+replay on construction, and
+    the sequence space continues across a process restart — so a client
+    resuming with ``since=seq`` gets exactly the events it missed.
+    ``floor`` is the highest sequence number no longer replayable
+    (snapshot compaction or the in-memory trim); a client presenting an
+    older ``since`` is answered with a full-relist signal instead of a
+    silent gap."""
+
+    def __init__(self, api: InMemoryAPIServer, limit: int = 10000,
+                 wal=None):
+        import os as _os
+
         self._lock = threading.Condition()
         self._events: list = []
         self._seq = 0
+        self._floor = 0
         self.limit = limit
+        self._wal = wal
+        self._api = api
+        # stream identity: WAL-backed logs keep theirs across restarts
+        # (sequence continuity is real); a volatile log mints a fresh
+        # one per life, so clients can detect a restart even when the
+        # new sequence space overlaps their old cursor
+        self.epoch = wal.stream_epoch() if wal is not None \
+            else _os.urandom(8).hex()
+        if wal is not None:
+            # recovery BEFORE the watcher registers: replay must not
+            # re-log itself, and clients must never see partial state
+            last_seq, floor, tail = wal.recover(api)
+            self._seq = last_seq
+            self._floor = floor
+            self._events = list(tail)[-limit:]
+            if len(tail) > limit:
+                self._floor = self._events[0][0] - 1
         api.add_watcher(self._record)
 
+    # Recent events carried INSIDE each snapshot: they are already
+    # reflected in the snapshotted state (never re-applied on recovery)
+    # but extend the watch-resume window below the compaction point, so
+    # a client up to this many events behind the final pre-crash
+    # snapshot still resumes seq-exact instead of relisting.
+    SNAPSHOT_TAIL = 256
+
+    def seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def floor(self) -> int:
+        with self._lock:
+            return self._floor
+
+    def tail(self, k: int) -> list:
+        with self._lock:
+            return list(self._events[-k:]) if k > 0 else []
+
     def _record(self, kind, event, obj):
+        # self._wal is set once in __init__ and never reassigned — it is
+        # configuration, not guarded state; the WAL has its own lock
+        wal = self._wal  # analysis: disable=lock-discipline -- immutable after __init__
         with self._lock:
             self._seq += 1
-            self._events.append((self._seq, kind, event, obj))
+            seq = self._seq
+            if wal is not None:
+                # write-ahead: durable before any watcher is woken
+                wal.append(seq, kind, event, obj)
+            self._events.append((seq, kind, event, obj))
             if len(self._events) > self.limit:
-                self._events = self._events[-self.limit:]
+                drop = len(self._events) - self.limit
+                self._floor = self._events[drop - 1][0]
+                self._events = self._events[drop:]
             self._lock.notify_all()
+        if wal is not None and wal.due_for_snapshot():
+            # Outside the event-log lock (state dump -> event-log seq is
+            # the apiserver-first order every mutator already takes; the
+            # reverse here would be an inversion). The caller is the
+            # mutator's notify, so its reentrant apiserver lock is still
+            # held and (state, seq) is exactly this record's cut.
+            state, snap_seq = self._api.snapshot_with(self.seq)
+            wal.snapshot(state, snap_seq, tail=self.tail(self.SNAPSHOT_TAIL))
 
     def since(self, seq: int, timeout: float = 10.0, batch_s: float = 0.0,
               kinds: frozenset | None = None):
@@ -132,13 +178,23 @@ class _EventLog:
         progress rides THIS response instead of costing another poll;
         ``kinds`` narrows the stream server-side (a scheduler that never
         consumes Event records must not pay their encode/decode).
-        Returns ``(events, latest_seq, folded_count)`` — the resume
-        contract is unchanged: every returned event keeps a sequence
-        number > ``seq``, and ``latest_seq`` advances the cursor past
-        anything folded away or filtered out."""
+        Returns ``(events, latest_seq, folded_count, relist)`` — the
+        resume contract is unchanged: every returned event keeps a
+        sequence number > ``seq``, and ``latest_seq`` advances the
+        cursor past anything folded away or filtered out. ``relist``
+        is True when ``seq`` falls outside the replayable window and
+        the caller must fall back to a full list."""
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:
+                if seq < self._floor or seq > self._seq:
+                    # outside the replayable window — below the floor
+                    # (compaction/trim, possibly having moved WHILE this
+                    # poll waited: the check lives under the serving
+                    # lock so a concurrent trim cannot open a silent
+                    # gap) or beyond the current sequence (a cursor from
+                    # another server life): the caller must relist
+                    return [], self._seq, 0, True
                 out = [e for e in self._events
                        if e[0] > seq and (kinds is None or e[1] in kinds)]
                 if out:
@@ -150,17 +206,22 @@ class _EventLog:
                                if e[0] > seq
                                and (kinds is None or e[1] in kinds)]
                     out, folded = coalesce_events(out)
-                    return out, self._seq, folded
+                    return out, self._seq, folded, False
                 if time.monotonic() >= deadline:
-                    return [], self._seq, 0
+                    return [], self._seq, 0, False
                 self._lock.wait(min(0.5, deadline - time.monotonic()))
 
 
-def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
+def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
+              wal=None):
     """Start serving; returns (ThreadingHTTPServer, base_url). The server
-    runs on a daemon thread; call ``server.shutdown()`` to stop."""
-    log = _EventLog(api)
-    leases = LeaseTable()
+    runs on a daemon thread; call ``server.shutdown()`` (and
+    ``server.server_close()`` to release the port) to stop. With ``wal``
+    (a ``cluster.wal.WriteAheadLog``), the apiserver's state and watch
+    log are recovered from disk before the first request is served, and
+    every subsequent event is logged write-ahead — watch resume
+    (``since=seq``) survives a crash."""
+    log = _EventLog(api, wal=wal)
 
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 so keep-alive works: every _send sets Content-Length,
@@ -170,6 +231,14 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
         # not wait out a delayed-ACK window.
         protocol_version = "HTTP/1.1"
         disable_nagle_algorithm = True
+
+        def setup(self):
+            super().setup()
+            self.server._track_connection(self.connection)
+
+        def finish(self):
+            self.server._untrack_connection(self.connection)
+            super().finish()
 
         def log_message(self, *args):  # quiet
             pass
@@ -197,9 +266,15 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
             try:
                 return self._dispatch(method, parts, query)
             except NotFound as e:
-                self._send(404, {"error": str(e)})
+                body = {"error": str(e)}
+                if getattr(e, "per_pod", None):
+                    body["per_pod"] = e.per_pod
+                self._send(404, body)
             except Conflict as e:
-                self._send(409, {"error": str(e)})
+                body = {"error": str(e)}
+                if getattr(e, "per_pod", None):
+                    body["per_pod"] = e.per_pod
+                self._send(409, body)
             except (BrokenPipeError, ConnectionResetError):
                 # client hung up mid-reply (e.g. a watcher killed during
                 # its long-poll); there is nobody left to answer
@@ -216,18 +291,32 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
             if parts == ["watch"]:
                 kinds = frozenset(query["kinds"].split(",")) \
                     if query.get("kinds") else None
-                events, seq, folded = log.since(
+                events, seq, folded, relist = log.since(
                     int(query.get("since", 0)),
                     float(query.get("timeout", 10.0)),
                     float(query.get("batch", 0.0)), kinds)
-                return self._send(200, {"events": events, "seq": seq,
-                                        "coalesced": folded})
-            if parts and parts[0] == "leases" and method == "POST":
-                body = self._body()
-                ok = leases.acquire(parts[1], body["holder"],
-                                    float(body.get("ttl", 15.0)))
-                return self._send(200 if ok else 409,
-                                  {"holder": leases.holder(parts[1])})
+                body = {"events": events, "seq": seq,
+                        "coalesced": folded, "epoch": log.epoch}
+                if relist:
+                    # the cursor falls outside the replayable window
+                    # (pre-snapshot/trimmed, or from another server
+                    # life): the delta stream has a gap, so tell the
+                    # client to relist instead of resuming silently wrong
+                    body["relist"] = True
+                return self._send(200, body)
+            if parts and parts[0] == "leases" and len(parts) == 2:
+                if method == "POST":
+                    body = self._body()
+                    ok = api.acquire_lease(parts[1], body["holder"],
+                                           float(body.get("ttl", 15.0)))
+                    return self._send(200 if ok else 409,
+                                      {"holder": api.lease_holder(parts[1])})
+                if method == "GET":
+                    return self._send(200,
+                                      {"holder": api.lease_holder(parts[1])})
+                if method == "DELETE":
+                    api.release_lease(parts[1], query.get("holder", ""))
+                    return self._send(200)
             if parts and parts[0] == "nodes":
                 if method == "GET" and len(parts) == 1:
                     return self._send(200, {"items": api.list_nodes()})
@@ -339,7 +428,50 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0):
         def do_DELETE(self):
             self._route("DELETE")
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    class Server(ThreadingHTTPServer):
+        # handler threads must die with the process, and shutdown() must
+        # sever live keep-alive connections: without that, a "restarted"
+        # apiserver leaves ghost handler threads still serving the OLD
+        # state to clients whose sockets never broke — the exact failure
+        # a real process death cannot produce. Killing the sockets is
+        # what makes restart observable (clients reconnect, and the
+        # watch-resume / relist contract actually engages).
+        daemon_threads = True
+
+        def __init__(self, *args, **kwargs):
+            self._client_conns: set = set()
+            self._conn_lock = threading.Lock()
+            super().__init__(*args, **kwargs)
+
+        def _track_connection(self, conn) -> None:
+            with self._conn_lock:
+                self._client_conns.add(conn)
+
+        def _untrack_connection(self, conn) -> None:
+            with self._conn_lock:
+                self._client_conns.discard(conn)
+
+        def handle_error(self, request, client_address):
+            pass  # severed-socket tracebacks are expected on shutdown
+
+        def shutdown(self):
+            super().shutdown()
+            with self._conn_lock:
+                conns = list(self._client_conns)
+                self._client_conns.clear()
+            for conn in conns:
+                try:
+                    # SHUT_RDWR first: close() alone does not wake a
+                    # handler thread blocked in recv() on this socket
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    server = Server((host, port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="apiserver-http").start()
     return server, f"http://{host}:{server.server_address[1]}"
@@ -381,6 +513,7 @@ class HTTPAPIClient:
         self.watch_kinds = tuple(watch_kinds) if watch_kinds else None
         self._watchers: list = []
         self._batch_watchers: list = []
+        self._relist_listeners: list = []
         self._watch_thread = None
         self._stop = threading.Event()
         self._local = threading.local()  # per-thread keep-alive connection
@@ -388,6 +521,7 @@ class HTTPAPIClient:
         self._conns: set = set()  # every live connection, for close()
         self.retry_count = 0   # transport-level retries performed
         self.watch_errors = 0  # failed watch polls survived
+        self.relist_count = 0  # watch resume gaps that forced a relist
 
     def _roundtrip(self, method: str, path: str, data, timeout: float):
         """One request over this thread's keep-alive connection; returns
@@ -462,10 +596,25 @@ class HTTPAPIClient:
                     # into reading a clean not-found — the transport
                     # retry must not hide the ambiguity it created.
                     return {}
-                raise NotFound(text)
+                raise self._server_error(NotFound, text)
             if status == 409:
-                raise Conflict(text)
+                raise self._server_error(Conflict, text)
             raise RuntimeError(f"HTTP {status}: {text}")
+
+    @staticmethod
+    def _server_error(cls, text: str):
+        """Reconstruct a NotFound/Conflict from the error body,
+        per-pod detail included — the binder's conflict handling needs
+        the same ``per_pod`` the in-memory server raises with."""
+        per_pod = None
+        try:
+            doc = json.loads(text)
+            if isinstance(doc, dict):
+                per_pod = doc.get("per_pod")
+                text = doc.get("error", text)
+        except ValueError:
+            pass
+        return cls(text, per_pod=per_pod)
 
     # -- node/pod surface ---------------------------------------------------
 
@@ -614,11 +763,29 @@ class HTTPAPIClient:
         except Conflict:
             return False
 
+    def lease_holder(self, name):
+        """Current holder of a lease, or None when vacant/expired — the
+        shard coordinator's work-stealing probe."""
+        return self._req("GET", f"/leases/{name}").get("holder")
+
+    def release_lease(self, name, holder):
+        """Drop a lease this holder owns (clean handoff on shutdown)."""
+        self._req("DELETE", f"/leases/{name}?holder={holder}")
+        return True
+
     # -- watch --------------------------------------------------------------
 
     def add_watcher(self, fn):
         self._watchers.append(fn)
         self._ensure_watch_thread()
+
+    def add_relist_listener(self, fn):
+        """Register ``fn()`` called when the watch stream's resume
+        window is lost (apiserver restarted without durable state, or
+        our cursor predates its snapshot): the consumer must re-list
+        and reconcile — resuming deltas alone would silently skip
+        whatever the gap held."""
+        self._relist_listeners.append(fn)
 
     def add_batch_watcher(self, fn):
         """Register ``fn(events)`` called once per poll with the whole
@@ -644,6 +811,7 @@ class HTTPAPIClient:
         per object, but never reorders or rewinds an object's history)."""
         log = logging.getLogger(__name__)
         seq = 0
+        epoch = None
         failures = 0
         while not self._stop.is_set():
             path = f"/watch?since={seq}&timeout=5"
@@ -665,6 +833,41 @@ class HTTPAPIClient:
                 log.info("watch recovered after %d failed polls; "
                          "resuming from seq %d", failures, seq)
                 failures = 0
+            srv_seq = int(out.get("seq", seq) or 0)
+            srv_epoch = out.get("epoch")
+            stream_moved = (epoch is not None and srv_epoch is not None
+                            and srv_epoch != epoch)
+            if srv_epoch is not None:
+                epoch = srv_epoch
+            if out.get("relist") or srv_seq < seq or stream_moved:
+                # The server told us our cursor is unreplayable (relist
+                # flag), its sequence space moved BACKWARD, or its
+                # stream EPOCH changed — a restart without durable
+                # state, including the case where the new life's
+                # sequence numbers already overlap our old cursor (a
+                # bare seq comparison cannot see that gap). Either way
+                # the delta stream has a hole: adopt the server's cursor
+                # and make the consumers re-list, never resume silently
+                # stale. A FRESH client (cursor 0) has seen nothing and
+                # so missed nothing — its consumers' own initial sync
+                # covers the history a compacted WAL can no longer
+                # replay; firing a relist there would just double the
+                # startup LIST.
+                if seq > 0:
+                    self.relist_count += 1
+                    log.warning("watch resume window lost (client seq "
+                                "%d, server seq %d); relisting", seq,
+                                srv_seq)
+                    seq = srv_seq
+                    for fn in list(self._relist_listeners):
+                        try:
+                            fn()
+                        except Exception:
+                            log.warning("relist listener %r failed", fn,
+                                        exc_info=True)
+                else:
+                    seq = srv_seq
+                continue
             events = out.get("events", [])
             if events:
                 metrics.WATCH_BATCH_SIZE.set(len(events))

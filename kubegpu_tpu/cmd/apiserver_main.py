@@ -1,4 +1,10 @@
-"""``kgtpu-apiserver``: serve the cluster state over HTTP."""
+"""``kgtpu-apiserver``: serve the cluster state over HTTP.
+
+With ``--wal-dir`` the server is durable: every watch event is appended
+to a checksummed write-ahead log before delivery, the object state is
+snapshotted + the log compacted every ``--wal-snapshot-every`` events,
+and a restart replays snapshot + log — watch clients resume seq-exact
+instead of being stranded (see cluster/wal.py)."""
 
 from __future__ import annotations
 
@@ -20,16 +26,35 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8070)
+    parser.add_argument("--wal-dir", default=None,
+                        help="directory for the write-ahead log + "
+                             "snapshot; restart recovers state and the "
+                             "watch sequence space from it")
+    parser.add_argument("--wal-no-fsync", action="store_true",
+                        help="skip fsync per append (durable across "
+                             "process crashes, not power loss)")
+    parser.add_argument("--wal-snapshot-every", type=int, default=4096,
+                        help="events between snapshot+compaction passes")
     args = parser.parse_args(argv)
 
     api = InMemoryAPIServer()
-    server, url = serve_api(api, args.host, args.port)
-    print(f"apiserver listening at {url}", flush=True)
+    wal = None
+    if args.wal_dir:
+        from kubegpu_tpu.cluster.wal import WriteAheadLog
+
+        wal = WriteAheadLog(args.wal_dir, fsync=not args.wal_no_fsync,
+                            snapshot_every=args.wal_snapshot_every)
+    server, url = serve_api(api, args.host, args.port, wal=wal)
+    print(f"apiserver listening at {url}"
+          + (f" (WAL at {args.wal_dir})" if wal else ""), flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     server.shutdown()
+    server.server_close()
+    if wal is not None:
+        wal.close()
     return 0
 
 

@@ -47,7 +47,8 @@ def prometheus_text() -> str:
     """Render the process's metrics in Prometheus exposition format."""
     lines = []
     for h in (metrics.E2E_SCHEDULING_LATENCY, metrics.ALGORITHM_LATENCY,
-              metrics.BINDING_LATENCY, metrics.BIND_LATENCY_MS):
+              metrics.BINDING_LATENCY, metrics.BIND_LATENCY_MS,
+              metrics.WAL_FSYNC_MS):
         lines.append(f"# TYPE {h.name} histogram")
         cumulative = 0
         for bound, count in zip(h.buckets, h.counts):
@@ -58,11 +59,12 @@ def prometheus_text() -> str:
         lines.append(f"{h.name}_count {h.n}")
     for c in (metrics.SCHEDULE_ATTEMPTS, metrics.SCHEDULE_FAILURES,
               metrics.PREEMPTION_VICTIMS, metrics.NODE_LOST,
-              metrics.EVICTIONS, metrics.WATCH_COALESCED):
+              metrics.EVICTIONS, metrics.WATCH_COALESCED,
+              metrics.SCHED_CONFLICTS, metrics.LEASE_TRANSITIONS):
         lines.append(f"# TYPE {c.name} counter")
         lines.append(f"{c.name} {c.value}")
     for g in (metrics.NODE_READY, metrics.BIND_INFLIGHT,
-              metrics.WATCH_BATCH_SIZE):
+              metrics.WATCH_BATCH_SIZE, metrics.WAL_SNAPSHOT_BYTES):
         lines.append(f"# TYPE {g.name} gauge")
         lines.append(f"{g.name} {g.value}")
     return "\n".join(lines) + "\n"

@@ -2,9 +2,24 @@
 
 Reference: `kube-scheduler/cmd/scheduler.go` + `cmd/app/server.go` —
 componentconfig-style ``--config``, healthz/metrics servers, and
-lease-based leader election for HA (`server.go:396-403,437-461`): replicas
-contend for one lease; only the holder schedules, and a lost lease demotes
-the replica back to standby.
+lease-based HA (`server.go:396-403,437-461`) in two shapes:
+
+``--leader-elect``
+    Active/standby: replicas contend for ONE lease; only the holder
+    schedules, and a lost lease demotes the replica back to standby.
+
+``--replicas N --shard I``
+    Active/active (Omega-style): every replica schedules, each owning
+    one shard of the queue by pod-name hash and holding that shard's
+    lease. A replica also steals the work of any shard whose lease is
+    vacant (its owner died), and stands down when the owner's renewals
+    resume. Commit safety does NOT depend on the leases — the API
+    server's optimistic-concurrency arbiter refuses conflicting binds —
+    so a brief double-ownership during handoff only costs a requeue.
+
+The NodeLifecycle controller is singleton-ELECTED (its own lease):
+exactly one replica runs evictions at a time, regardless of which
+scheduling mode is active.
 """
 
 from __future__ import annotations
@@ -13,9 +28,10 @@ import argparse
 import os
 import signal
 import threading
-import time
 
 from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
+from kubegpu_tpu.cluster.lease import (LIFECYCLE_LEASE, Elector,
+                                       ShardCoordinator)
 from kubegpu_tpu.cmd import common
 from kubegpu_tpu.scheduler.core import Scheduler
 from kubegpu_tpu.scheduler.registry import DevicesScheduler
@@ -24,7 +40,8 @@ from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
 LEASE_NAME = "kgtpu-scheduler"
 
 
-def build_scheduler(client, args, config: dict | None = None) -> Scheduler:
+def build_scheduler(client, args, config: dict | None = None,
+                    shard_owned=None) -> Scheduler:
     from kubegpu_tpu.scheduler.extender import load_extenders
     from kubegpu_tpu.scheduler.factory import algorithm_from_policy
 
@@ -58,9 +75,31 @@ def build_scheduler(client, args, config: dict | None = None) -> Scheduler:
                       extenders=extenders,
                       priority_weights=config.get("priorityWeights"),
                       algorithm=algorithm,
-                      bind_workers=getattr(args, "bind_workers", 4))
+                      bind_workers=getattr(args, "bind_workers", 4),
+                      shard_owned=shard_owned)
     sched.preemption_enabled = not args.disable_preemption
     return sched
+
+
+def start_lifecycle_elector(client, args, holder: str) -> Elector | None:
+    """Node liveness controller, gated on --node-grace-s and singleton-
+    elected on its own lease: exactly one replica runs evictions (two
+    controllers double-evicting would race the requeues), failover is
+    automatic when the holder dies, and election is independent of which
+    scheduling mode (leader-elect / sharded / solo) is active."""
+    if not args.node_grace_s or args.node_grace_s <= 0:
+        return None
+    from kubegpu_tpu.scheduler.lifecycle import NodeLifecycle
+
+    stale = args.node_stale_s if args.node_stale_s > 0 \
+        else args.node_grace_s / 3.0
+    controller = NodeLifecycle(client, stale_after_s=stale,
+                               lost_after_s=args.node_grace_s)
+    elector = Elector(client.acquire_lease, LIFECYCLE_LEASE, holder,
+                      args.lease_ttl, on_acquire=controller.start,
+                      on_lose=controller.stop)
+    elector.start()
+    return elector
 
 
 def main(argv=None) -> int:
@@ -84,13 +123,22 @@ def main(argv=None) -> int:
                              "first-event latency for fuller, coalesced "
                              "event batches")
     parser.add_argument("--disable-preemption", action="store_true")
-    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="active/standby HA: contend for one lease; "
+                             "only the holder schedules")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="active/active HA: total scheduler replicas "
+                             "sharding the queue by pod-name hash "
+                             "(optimistic commits, apiserver-arbitrated)")
+    parser.add_argument("--shard", type=int, default=0,
+                        help="this replica's shard index in [0, replicas)")
     parser.add_argument("--lease-ttl", type=float, default=15.0)
     parser.add_argument("--node-grace-s", type=float, default=0.0,
                         help="heartbeat grace period before a node is "
                              "Lost and its pods (whole gangs) are "
                              "evicted; 0 disables the node lifecycle "
-                             "controller")
+                             "controller. The controller is singleton-"
+                             "elected across replicas on its own lease.")
     parser.add_argument("--node-stale-s", type=float, default=0.0,
                         help="heartbeat age marking a node Stale "
                              "(default: node-grace-s / 3)")
@@ -104,7 +152,8 @@ def main(argv=None) -> int:
     config = common.load_config(args.config)
     common.merge_flags(args, config, ["api", "parallelism", "lease_ttl",
                                       "node_grace_s", "node_stale_s",
-                                      "bind_workers", "watch_batch_ms"])
+                                      "bind_workers", "watch_batch_ms",
+                                      "replicas", "shard"])
 
     # kind-filtered watch: the scheduler consumes node/pod/pv/pvc events
     # only, so Event records never pay encode/decode on this stream
@@ -116,79 +165,68 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
 
-    sched: Scheduler | None = None
-    common.serve_health(args.healthz_port,
-                        extra_status=lambda: True)
+    common.serve_health(args.healthz_port, extra_status=lambda: True)
+    lifecycle_elector = start_lifecycle_elector(client, args, holder)
 
-    def start_lifecycle():
-        """Node liveness controller, gated on --node-grace-s. Runs only
-        while this replica schedules (the leader owns evictions — two
-        controllers double-evicting would race the requeues)."""
-        if not args.node_grace_s or args.node_grace_s <= 0:
-            return None
-        from kubegpu_tpu.scheduler.lifecycle import NodeLifecycle
-
-        stale = args.node_stale_s if args.node_stale_s > 0 \
-            else args.node_grace_s / 3.0
-        controller = NodeLifecycle(client, stale_after_s=stale,
-                                   lost_after_s=args.node_grace_s)
-        controller.start()
-        return controller
-
-    lifecycle = None
-    if not args.leader_elect:
-        sched = build_scheduler(client, args, config)
+    if args.replicas > 1:
+        # Active/active sharded replicas: build the coordinator first
+        # (the scheduler's pop filter reads its ownership), then wire
+        # ownership changes to a queue wake-up so stolen pods are
+        # retried immediately instead of waiting out their park delay.
+        shard = args.shard % args.replicas
+        coord = ShardCoordinator(client, shard, args.replicas,
+                                 holder, ttl_s=args.lease_ttl)
+        sched = build_scheduler(client, args, config,
+                                shard_owned=coord.owns)
+        coord.on_change = sched.queue.move_all_to_active
+        coord.start()
         sched.start()
-        lifecycle = start_lifecycle()
-        print(f"scheduler running against {args.api}", flush=True)
+        print(f"scheduler replica {shard}/{args.replicas} ({holder}) "
+              f"running against {args.api}", flush=True)
         stop.wait()
-        if lifecycle is not None:
-            lifecycle.stop()
+        coord.stop()
+        if lifecycle_elector is not None:
+            lifecycle_elector.stop()
         sched.stop()
         return 0
 
-    # Leader election: acquire -> run; renew at ttl/3; demote on loss.
-    print(f"scheduler candidate {holder} (leader election on)", flush=True)
-    leading = False
-    lease_valid_until = 0.0
-    while not stop.is_set():
-        # A transient transport error at renewal must neither crash the
-        # replica (the retry layer skips POSTs, and acquire_lease is one)
-        # nor demote a leader that still holds the lease: nobody else can
-        # acquire until the TTL truly lapses, so tearing down early just
-        # leaves the cluster leaderless. Keep leading while the last
-        # successful renewal is still within TTL; demote only on a real
-        # denial or once the lease could have expired.
-        try:
-            # stamp validity from BEFORE the round trip: the server's TTL
-            # starts when it grants, so counting from the reply would keep
-            # us leading ~one RTT past a lapse a standby can already take
-            asked_at = time.monotonic()
-            acquired = client.acquire_lease(LEASE_NAME, holder,
-                                            args.lease_ttl)
-            if acquired:
-                lease_valid_until = asked_at + args.lease_ttl
-        except Exception:
-            acquired = leading and time.monotonic() < lease_valid_until
-        if acquired and not leading:
-            sched = build_scheduler(client, args, config)
-            sched.start()
-            lifecycle = start_lifecycle()
-            leading = True
-            print(f"{holder} became leader", flush=True)
-        elif not acquired and leading:
-            if lifecycle is not None:
-                lifecycle.stop()
-                lifecycle = None
-            sched.stop()
-            sched = None
-            leading = False
-            print(f"{holder} lost the lease, standing by", flush=True)
-        stop.wait(args.lease_ttl / 3.0)
-    if lifecycle is not None:
-        lifecycle.stop()
-    if sched is not None:
+    if not args.leader_elect:
+        sched = build_scheduler(client, args, config)
+        sched.start()
+        print(f"scheduler running against {args.api}", flush=True)
+        stop.wait()
+        if lifecycle_elector is not None:
+            lifecycle_elector.stop()
         sched.stop()
+        return 0
+
+    # Leader election (active/standby) through the shared Elector:
+    # acquire -> promote; renew at ttl/3; demote on a real denial or
+    # once the lease could have expired (transport-error grace inside
+    # Elector.tick — see cluster/lease.py).
+    print(f"scheduler candidate {holder} (leader election on)", flush=True)
+    state: dict = {"sched": None}
+
+    def promote():
+        state["sched"] = build_scheduler(client, args, config)
+        state["sched"].start()
+        print(f"{holder} became leader", flush=True)
+
+    def demote():
+        sched = state.pop("sched", None)
+        if sched is not None:
+            sched.stop()
+        state["sched"] = None
+        print(f"{holder} lost the lease, standing by", flush=True)
+
+    elector = Elector(client.acquire_lease, LEASE_NAME, holder,
+                      args.lease_ttl, on_acquire=promote, on_lose=demote)
+    while not stop.is_set():
+        elector.tick()
+        stop.wait(args.lease_ttl / 3.0)
+    if lifecycle_elector is not None:
+        lifecycle_elector.stop()
+    elector.stop()  # demotes (stops the scheduler) if still leading
     return 0
 
 

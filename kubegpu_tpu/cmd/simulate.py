@@ -3,13 +3,23 @@
 Spins up the API server, N fake v5p hosts with advertisers, and the
 scheduler; submits a workload mix (plain, HBM-floored, contiguous, and a
 gang) and prints the placements plus what each container would receive
-from the runtime hook.
+from the runtime hook. ``--schedulers N`` runs N optimistic scheduler
+replicas over the same API server, each owning a pod-name-hash shard
+under a lease (the HA control plane in one process).
 
 ``--chaos`` runs the node-loss recovery scenario instead: a 4-host
 cluster under a seeded chaos transport, a 2-node gang placed, one node
 agent killed mid-gang — measuring how long the NodeLifecycle controller
 takes to detect the loss, evict the gang, and rebind it entirely on
 surviving nodes with zero leaked chips.
+
+``--chaos-ha`` runs the HA control-plane chaos scenario: two scheduler
+replicas over a WAL-backed HTTP apiserver; replica 0 is killed
+mid-stream (its shard's work is stolen via lease vacancy), then the
+apiserver process state is torn down and recovered from the WAL on the
+same port — every pod must place exactly once (zero leaked chips, zero
+double-binds) and the surviving replica's watch must resume seq-exact
+(no relist) across the restart.
 """
 
 from __future__ import annotations
@@ -63,6 +73,17 @@ def _data_plane_summary() -> dict:
             "bind_inflight": metrics.BIND_INFLIGHT.value,
             "watch_batch_size": metrics.WATCH_BATCH_SIZE.value,
             "watch_coalesced_total": metrics.WATCH_COALESCED.value}
+
+
+def _ha_summary() -> dict:
+    """HA control-plane health (metrics.py): commits the apiserver's
+    conflict arbiter refused, lease leadership transitions, and the
+    WAL's per-append fsync cost + last snapshot size."""
+    return {"sched_conflicts_total": metrics.SCHED_CONFLICTS.value,
+            "lease_transitions_total": metrics.LEASE_TRANSITIONS.value,
+            "wal_fsync_p50_ms": round(metrics.WAL_FSYNC_MS.percentile(0.5), 4),
+            "wal_appends": metrics.WAL_FSYNC_MS.n,
+            "wal_snapshot_bytes": metrics.WAL_SNAPSHOT_BYTES.value}
 
 
 def _gang_chips(api, name):
@@ -184,13 +205,204 @@ def run_chaos_scenario(seed: int = 0, lost_after_s: float = 0.9,
         sched.stop()
 
 
+def _bound_chips(api, names):
+    """{pod name: chip ids} for every bound pod in ``names`` — the
+    read-back both chaos scenarios use to prove zero leaked chips and
+    zero double-binds (global chip-id uniqueness)."""
+    chips = {}
+    for name in names:
+        pod = api.get_pod(name)
+        node = (pod.get("spec") or {}).get("nodeName")
+        if not node:
+            continue
+        chips[name] = [(node, c) for c in _gang_chips(api, name)]
+    return chips
+
+
+def run_ha_chaos_scenario(pods_before: int = 6, pods_mid: int = 3,
+                          pods_after: int = 3, wal_dir: str | None = None,
+                          lease_ttl_s: float = 0.6,
+                          deadline_s: float = 30.0):
+    """The HA control-plane chaos scenario: 2 optimistic scheduler
+    replicas (shard leases + work stealing) over a WAL-backed HTTP
+    apiserver. Mid-stream, replica 0 is killed — replica 1 must steal
+    its shard via lease vacancy — and then the apiserver is torn down
+    and recovered from its WAL on the same port — the surviving
+    replica's watch must resume seq-exact (zero relists) and every pod
+    (a 2-pod gang included) must place exactly once with zero leaked
+    chips and zero double-binds. Raises on any violation; returns the
+    scenario's accounting."""
+    import shutil
+    import tempfile
+
+    from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+    from kubegpu_tpu.cluster.lease import ShardCoordinator
+    from kubegpu_tpu.cluster.wal import WriteAheadLog
+
+    tmp = wal_dir or tempfile.mkdtemp(prefix="kgtpu-wal-")
+    owns_tmp = wal_dir is None
+    api = InMemoryAPIServer()
+    wal = WriteAheadLog(tmp, fsync=False, snapshot_every=40)
+    server, url = serve_api(api, wal=wal)
+    port = int(url.rsplit(":", 1)[1])
+    admin = HTTPAPIClient(url)
+    replicas = []
+    submitted: list = []
+    try:
+        origins = [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)]
+        for i, origin in enumerate(origins):
+            name = f"host{i}"
+            admin.create_node({"metadata": {"name": name},
+                               "status": {"allocatable": {"cpu": "64",
+                                                          "pods": 100}}})
+            mgr = DevicesManager()
+            mgr.add_device(TPUDeviceManager(FakeTPUBackend(
+                v5p_host_inventory(host_origin=origin,
+                                   mesh_dims=(4, 4, 1)))))
+            mgr.start()
+            DeviceAdvertiser(admin, mgr, name).advertise_once()
+
+        def start_replica(shard):
+            client = HTTPAPIClient(url, watch_batch_s=0.002,
+                                   watch_kinds=("node", "pod", "pv", "pvc"))
+            coord = ShardCoordinator(client, shard, 2, f"replica-{shard}",
+                                     ttl_s=lease_ttl_s)
+            ds = DevicesScheduler()
+            ds.add_device(TPUScheduler())
+            sched = Scheduler(client, ds, bind_async=True,
+                              shard_owned=coord.owns)
+            coord.on_change = sched.queue.move_all_to_active
+            coord.start(interval_s=lease_ttl_s / 4.0)
+            sched.start()
+            return client, coord, sched
+
+        replicas.append(start_replica(0))
+        replicas.append(start_replica(1))
+
+        def submit(prefix, count, chips=1):
+            from kubegpu_tpu.cluster.apiserver import Conflict
+
+            for i in range(count):
+                name = f"{prefix}-{i}"
+                pod = make_pod(name, chips)
+                # creates are single-shot on the transport (POST), so a
+                # submission racing the apiserver restart retries HERE —
+                # a Conflict means an earlier attempt landed
+                for attempt in range(50):
+                    try:
+                        admin.create_pod(pod)
+                        break
+                    except Conflict:
+                        break
+                    except Exception:
+                        if attempt == 49:
+                            raise
+                        time.sleep(0.1)
+                submitted.append(name)
+
+        def wait_bound(deadline=deadline_s):
+            end = time.monotonic() + deadline
+            pending = list(submitted)
+            while time.monotonic() < end:
+                try:
+                    pending = [n for n in submitted
+                               if not (admin.get_pod(n).get("spec") or {})
+                               .get("nodeName")]
+                except Exception:
+                    time.sleep(0.1)  # apiserver restarting under us
+                    continue
+                if not pending:
+                    return
+                time.sleep(0.05)
+            raise RuntimeError(f"pods failed to place: {pending}")
+
+        # phase 1: both replicas place a stream (plus a gang, which must
+        # route whole to one shard by gang id)
+        submit("ha-a", pods_before)
+        for i in range(2):
+            name = f"ha-gang-{i}"
+            admin.create_pod(make_pod(name, 2,
+                                      pod_requests={RESOURCE_GANG: 55,
+                                                    RESOURCE_GANG_SIZE: 2}))
+            submitted.append(name)
+        wait_bound()
+
+        # phase 2: kill replica 0 mid-stream — its shard lease lapses
+        # and replica 1 steals the work
+        client0, coord0, sched0 = replicas[0]
+        sched0.stop()
+        coord0.stop()
+        client0.close()
+        replicas[0] = None
+        submit("ha-b", pods_mid)
+        wait_bound()
+
+        # phase 3: apiserver crash + WAL recovery on the same port; the
+        # surviving replica's watch must resume seq-exact (no relist)
+        server.shutdown()
+        server.server_close()
+        wal.close()
+        api2 = InMemoryAPIServer()
+        wal2 = WriteAheadLog(tmp, fsync=False, snapshot_every=40)
+        server, _ = serve_api(api2, port=port, wal=wal2)
+        api = api2
+        submit("ha-c", pods_after)
+        wait_bound()
+
+        client1 = replicas[1][0]
+        chips = _bound_chips(admin, submitted)
+        placed = {n for n in chips}
+        if placed != set(submitted):
+            raise RuntimeError(f"unplaced pods: {set(submitted) - placed}")
+        all_claims = [c for cs in chips.values() for c in cs]
+        if len(all_claims) != len(set(all_claims)):
+            dups = sorted(c for c in set(all_claims)
+                          if all_claims.count(c) > 1)
+            raise RuntimeError(f"double-booked chips: {dups}")
+        if any(not cs for cs in chips.values()):
+            raise RuntimeError("a bound pod carries no chip allocation")
+        if client1.relist_count != 0:
+            raise RuntimeError(
+                f"watch resume was not seq-exact across the apiserver "
+                f"restart ({client1.relist_count} relist(s))")
+        return {"placed": len(placed),
+                "watch_relists": client1.relist_count,
+                "wal_recovered_records": wal2.recovered_records,
+                "stolen_shards": sorted(replicas[1][1].owned_shards()),
+                "ha": _ha_summary()}
+    finally:
+        for rep in replicas:
+            if rep is None:
+                continue  # replica 0, already torn down in phase 2
+            client, coord, sched = rep
+            sched.stop()
+            coord.stop()
+            client.close()
+        admin.close()
+        server.shutdown()
+        server.server_close()
+        try:
+            wal2.close()
+        except NameError:
+            wal.close()
+        if owns_tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--hosts", type=int, default=4)
+    parser.add_argument("--schedulers", type=int, default=1,
+                        help="optimistic scheduler replicas over one API "
+                             "server (shard leases + conflict commits)")
     parser.add_argument("--json", action="store_true", help="machine output")
     parser.add_argument("--chaos", action="store_true",
                         help="run the node-loss recovery scenario under "
                              "the seeded chaos transport")
+    parser.add_argument("--chaos-ha", action="store_true",
+                        help="run the HA scenario: scheduler-kill + "
+                             "WAL-backed apiserver restart under 2 "
+                             "replicas")
     parser.add_argument("--seed", type=int, default=0,
                         help="chaos transport seed")
     args = parser.parse_args(argv)
@@ -204,6 +416,19 @@ def main(argv=None) -> int:
                   f"{result['recovery_ms']:.0f} ms "
                   f"({result['first_placement']} -> "
                   f"{result['final_placement']})")
+        return 0
+
+    if args.chaos_ha:
+        result = run_ha_chaos_scenario()
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            print(f"HA chaos: {result['placed']} pods placed exactly once "
+                  f"across a scheduler kill + apiserver restart "
+                  f"({result['ha']['sched_conflicts_total']} conflicts "
+                  f"arbitrated, {result['watch_relists']} relists, "
+                  f"{result['wal_recovered_records']} WAL records "
+                  f"replayed)")
         return 0
 
     api = InMemoryAPIServer()
@@ -223,11 +448,32 @@ def main(argv=None) -> int:
         DeviceAdvertiser(api, mgr, name).advertise_once()
         hooks[name] = TPURuntimeHook(api, mgr)
 
-    ds = DevicesScheduler()
-    ds.add_device(TPUScheduler())
-    # pipelined binder, like the real binary: the data-plane summary
-    # below then reports live bind pipeline numbers
-    sched = Scheduler(api, ds, bind_async=True)
+    # Pipelined binder, like the real binary: the data-plane summary
+    # below then reports live bind pipeline numbers. With
+    # --schedulers N, N optimistic replicas share the API server: each
+    # owns a pod-name-hash shard under a lease (InMemoryAPIServer serves
+    # the same lease surface as the HTTP transport), gangs route whole
+    # by gang id, and conflicting commits are arbitrated server-side.
+    from kubegpu_tpu.cluster.lease import ShardCoordinator
+
+    n_sched = max(1, args.schedulers)
+    scheds = []
+    coords = []
+    for shard in range(n_sched):
+        ds = DevicesScheduler()
+        ds.add_device(TPUScheduler())
+        owns = None
+        if n_sched > 1:
+            coord = ShardCoordinator(api, shard, n_sched, f"sim-{shard}",
+                                     ttl_s=5.0)
+            coords.append(coord)
+            owns = coord.owns
+        s = Scheduler(api, ds, bind_async=True, shard_owned=owns)
+        if n_sched > 1:
+            coords[shard].on_change = s.queue.move_all_to_active
+            coords[shard].tick()
+        scheds.append(s)
+    sched = scheds[0]
 
     api.create_pod(make_pod("plain-2chip", 2))
     api.create_pod(make_pod("hbm-floored", 1, hbm=90 * 2**30))
@@ -258,7 +504,22 @@ def main(argv=None) -> int:
         api.create_pod(make_pod(f"gang-{i}", 4,
                                 pod_requests={RESOURCE_GANG: 1,
                                               RESOURCE_GANG_SIZE: gang_n}))
-    sched.run_until_idle()
+    if n_sched == 1:
+        sched.run_until_idle()
+    else:
+        # round-robin the replicas' cycles until the cluster settles —
+        # each drains only its owned shard; a replica observing another's
+        # mid-flight assume simply loses that conflict and requeues
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            for c in coords:
+                c.tick()
+            for s in scheds:
+                s.run_until_idle()
+            if all((p.get("spec") or {}).get("nodeName")
+                   for p in api.list_pods()):
+                break
+            time.sleep(0.02)
 
     rows = []
     for pod in api.list_pods():
@@ -278,9 +539,12 @@ def main(argv=None) -> int:
 
     fit_cache = _fit_cache_summary()
     data_plane = _data_plane_summary()
+    doc = {"placements": rows, "fit_cache": fit_cache,
+           "data_plane": data_plane}
+    if n_sched > 1:
+        doc["ha"] = {"schedulers": n_sched, **_ha_summary()}
     if args.json:
-        print(json.dumps({"placements": rows, "fit_cache": fit_cache,
-                          "data_plane": data_plane}, indent=2))
+        print(json.dumps(doc, indent=2))
     else:
         width = max(len(r["pod"]) for r in rows) + 2
         print(f"{'POD':<{width}}{'NODE':<10}{'CHIPS':<28}{'BOUNDS':<8}VOLUME")
@@ -295,7 +559,15 @@ def main(argv=None) -> int:
               f"{data_plane['bind_inflight']} in flight); last watch "
               f"batch {data_plane['watch_batch_size']}, "
               f"{data_plane['watch_coalesced_total']} events coalesced")
-    sched.stop()
+        if n_sched > 1:
+            ha = doc["ha"]
+            print(f"ha: {ha['schedulers']} replicas, "
+                  f"{ha['sched_conflicts_total']} conflicts arbitrated, "
+                  f"{ha['lease_transitions_total']} lease transitions")
+    for s in scheds:
+        s.stop()
+    for coord in coords:
+        coord.stop()
     return 0
 
 

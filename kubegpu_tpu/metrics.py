@@ -120,19 +120,31 @@ BIND_LATENCY_MS = Histogram("bind_latency_ms", start_us=0.25)
 BIND_INFLIGHT = Gauge("bind_inflight")
 WATCH_BATCH_SIZE = Gauge("watch_batch_size")
 WATCH_COALESCED = Counter("watch_coalesced_total")
+# HA control plane (cluster/lease.py + cluster/wal.py + the apiserver's
+# optimistic-concurrency arbiter): sched_conflicts_total counts commits
+# the API server refused (chip/port/binding taken by a competing
+# scheduler replica — each one is a forget+requeue, never a retry);
+# lease_transitions_total counts leader/shard acquire+lose transitions;
+# wal_fsync_ms is the per-append durability cost and wal_snapshot_bytes
+# the last compaction snapshot's size.
+SCHED_CONFLICTS = Counter("sched_conflicts_total")
+LEASE_TRANSITIONS = Counter("lease_transitions_total")
+WAL_FSYNC_MS = Histogram("wal_fsync_ms", start_us=0.01)
+WAL_SNAPSHOT_BYTES = Gauge("wal_snapshot_bytes")
 
 
 def reset_all() -> None:
     """Fresh metric state (tests and bench runs)."""
     for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY,
-              BIND_LATENCY_MS):
+              BIND_LATENCY_MS, WAL_FSYNC_MS):
         h.__init__(h.name, start_us=h.buckets[0])
     for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS,
               INTERNAL_ERRORS, NATIVE_FALLBACKS, NODE_LOST, EVICTIONS,
               FIT_CACHE_HITS, FIT_CACHE_MISSES, FIT_CACHE_INVALIDATIONS,
-              WATCH_COALESCED):
+              WATCH_COALESCED, SCHED_CONFLICTS, LEASE_TRANSITIONS):
         c.__init__(c.name)
-    for g in (NODE_READY, BIND_INFLIGHT, WATCH_BATCH_SIZE):
+    for g in (NODE_READY, BIND_INFLIGHT, WATCH_BATCH_SIZE,
+              WAL_SNAPSHOT_BYTES):
         g.__init__(g.name)
 
 

@@ -263,12 +263,17 @@ class WorkloadSupervisor:
         with self._report_lock:
             try:
                 pod = self.api.get_pod(cont.pod)
-                ann = ((pod.get("metadata") or {}).get("annotations") or {})
+                # the update REPLACES the pod's annotations, so carry the
+                # full dict forward: dropping the device allocation from a
+                # bound running pod would destroy the placement record
+                # (and the API server now refuses such writes outright)
+                ann = dict((pod.get("metadata") or {})
+                           .get("annotations") or {})
                 statuses = json.loads(ann.get(STATUS_ANNOTATION_KEY) or "{}")
                 statuses[cont.container] = cont.status()
-                self.api.update_pod_annotations(
-                    cont.pod, {STATUS_ANNOTATION_KEY: json.dumps(
-                        statuses, sort_keys=True)})
+                ann[STATUS_ANNOTATION_KEY] = json.dumps(statuses,
+                                                        sort_keys=True)
+                self.api.update_pod_annotations(cont.pod, ann)
             except Exception:
                 # the API server being briefly away must not take down a
                 # running workload; the advertiser loop has the same stance

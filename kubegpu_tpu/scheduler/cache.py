@@ -342,9 +342,17 @@ class SchedulerCache:
                    now: float | None = None) -> None:
         """Optimistically place a pod before bind confirms
         (`scheduler.go:370-392`). Tolerates the node vanishing between
-        allocate and assume — the charge no-ops and bind will fail cleanly."""
+        allocate and assume — the charge no-ops and bind will fail
+        cleanly. A pod ALREADY charged as bound (a competing replica's
+        commit observed mid-cycle, between this cycle's pop and now) is
+        not assumed on top: the accounting already reflects the server's
+        truth, and registering an assume here would make the eventual
+        Conflict's forget release a charge this assume never made —
+        subtracting our planned chips from under the winner's."""
         with self._lock:
             name = kube_pod["metadata"]["name"]
+            if name in self._charged and name not in self._assumed:
+                return
             self._charge_locked(kube_pod, node_name, take=True)
             node = self.nodes.get(node_name)
             if node is not None:
@@ -439,13 +447,32 @@ class SchedulerCache:
                 node.pod_names.discard(name)
 
     def add_pod(self, kube_pod: dict, node_name: str) -> None:
-        """A bound pod observed from the API server. If it was assumed by
-        us, the charge already happened."""
+        """A bound pod observed from the API server. If it was assumed
+        by us WITH THE SAME placement, the charge already happened. An
+        assumed pod observed bound DIFFERENTLY (node or allocation) is a
+        competing scheduler replica's bind that won the commit race and
+        arrived before our own bind's Conflict reply: release our
+        optimistic charge and account the server's truth — otherwise
+        this cache both leaks our phantom chips and treats the winner's
+        chips as free forever."""
         with self._lock:
             name = kube_pod["metadata"]["name"]
-            if name in self._assumed:
+            entry = self._assumed.get(name)
+            if entry is not None:
+                assumed_node, _, assumed_pod = entry
+                observed_ann = ((kube_pod.get("metadata") or {})
+                                .get("annotations") or {}) \
+                    .get(codec.POD_ANNOTATION_KEY)
+                assumed_ann = ((assumed_pod.get("metadata") or {})
+                               .get("annotations") or {}) \
+                    .get(codec.POD_ANNOTATION_KEY)
                 self._assumed.pop(name)
-                return
+                if assumed_node == node_name and observed_ann == assumed_ann:
+                    return  # our own bind confirmed; the charge stands
+                self._charge_locked(assumed_pod, assumed_node, take=False)
+                lost = self.nodes.get(assumed_node)
+                if lost is not None:
+                    lost.pod_names.discard(name)
             self._charge_locked(kube_pod, node_name, take=True)
             if node_name in self.nodes:
                 self.nodes[node_name].pod_names.add(name)

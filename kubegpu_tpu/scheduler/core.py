@@ -718,7 +718,15 @@ class GenericScheduler:
             raise FitError(kube_pod["metadata"]["name"], {node_name: ["node gone"]})
         node_ex = snap.node_ex
         pod_info = self.cache.pod_info_for_node(kube_pod, node_name)
-        self.device_scheduler.pod_allocate(pod_info, node_ex)
+        try:
+            self.device_scheduler.pod_allocate(pod_info, node_ex)
+        except RuntimeError as err:
+            # the node's free set moved between the fit pass and this
+            # allocation (a watch delta landed — under multi-scheduler
+            # HA, typically a competing replica's bind): an ordinary
+            # lost race, so requeue-and-replan, not an internal error
+            raise FitError(kube_pod["metadata"]["name"],
+                           {node_name: [str(err)]})
         pod_info.node_name = node_name
         codec.pod_info_to_annotation(kube_pod.setdefault("metadata", {}), pod_info)
         return kube_pod
@@ -1137,13 +1145,24 @@ class Scheduler:
     # no-op), so resending after a lost reply converges — cheaper than a
     # forget + full replan for every transient blip.
     BIND_ATTEMPTS = 3
+    # How long a pod outside this replica's shard parks before its
+    # ownership is re-checked (a vacancy-driven steal is also pushed via
+    # the coordinator's move_all_to_active, so this is only the backstop).
+    SHARD_PARK_S = 0.5
+    # Retry delay after LOSING a bind conflict to a competing replica:
+    # the pod is not unschedulable — capacity exists elsewhere and the
+    # replan runs against a cache that has (or is about to have) the
+    # winner's bind charged — so it parks briefly instead of paying the
+    # exponential unschedulable backoff. Progress is guaranteed: every
+    # retry sees strictly more committed state.
+    CONFLICT_RETRY_S = 0.05
 
     def __init__(self, api, device_scheduler, bind_async: bool = False,
                  parallelism: int = DEFAULT_PARALLELISM,
                  extenders: list | None = None,
                  priority_weights: dict | None = None,
                  algorithm: factory.AlgorithmConfig | None = None,
-                 bind_workers: int = 4):
+                 bind_workers: int = 4, shard_owned=None):
         from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
 
         self.api = api
@@ -1187,6 +1206,19 @@ class Scheduler:
         self._view_lock = threading.Lock()
         self._pod_view: dict = {}  # pod name -> latest watched object
         self.preemption_enabled = True
+        # Multi-scheduler sharding: ``shard_owned(pod_name) -> bool`` is
+        # the replica's ownership filter (a ShardCoordinator's ``owns``).
+        # It is an EFFICIENCY filter, not a correctness gate — two
+        # replicas briefly processing the same pod during a lease
+        # handoff is resolved by the apiserver's conflict arbiter.
+        self._shard_owned = shard_owned
+        # Consecutive lost-commit count per pod: the first few conflicts
+        # retry promptly, a streak degrades to unschedulable backoff
+        # (a replica repeatedly re-deriving a refused plan is working
+        # from a stale view and must stop hammering the arbiter).
+        self._conflict_lock = threading.Lock()
+        self._conflict_streak: dict = {}
+        self.resync_count = 0  # full relists performed (apiserver restart)
         self._stop = threading.Event()
         # A transport exposing batched watch delivery (HTTPAPIClient)
         # gets the whole batch applied under one cache lock; the
@@ -1196,6 +1228,11 @@ class Scheduler:
             add_batch(self._on_event_batch)
         else:
             api.add_watcher(self._on_event)
+        # A transport that can lose its watch-resume window (apiserver
+        # restart) tells us to relist instead of resuming stale.
+        add_relist = getattr(api, "add_relist_listener", None)
+        if add_relist is not None:
+            add_relist(self._on_relist)
         self._sync_existing()
 
     # ---- informer plumbing -------------------------------------------------
@@ -1243,6 +1280,59 @@ class Scheduler:
                     self.generic.nominate(pod, nominated)
                 self.queue.push(pod)
 
+    def _on_relist(self) -> None:
+        """The watch transport lost its resume window (the apiserver
+        restarted past our cursor, or our cursor predates its WAL
+        snapshot): the delta stream has a gap, so re-list everything and
+        reconcile the cache. All mutations here are idempotent — the
+        charge gate, set_node's fingerprint, queue.push's replace — so
+        overlapping with the freshly-resumed delta stream converges."""
+        try:
+            nodes = self.api.list_nodes()
+            pods = self.api.list_pods()
+        except Exception:
+            # the next relist signal (or plain deltas against whatever
+            # state survives) will retry; never kill the watch thread
+            log.warning("relist failed; cache may lag until the next "
+                        "watch delivery", exc_info=True)
+            return
+        self.resync_count += 1
+        listed = {n["metadata"]["name"] for n in nodes}
+        ops: list = [(self.cache.set_node, (n,)) for n in nodes]
+        for name in set(self.cache.node_names()) - listed:
+            ops.append((self.cache.remove_node, (name,)))
+        listed_pods = {p["metadata"]["name"] for p in pods}
+        for pod in pods:
+            self._view_store(pod)
+            node_name = (pod.get("spec") or {}).get("nodeName")
+            if node_name:
+                ops.append((self.cache.add_pod, (pod, node_name)))
+        # pods deleted during the gap: absent from the fresh list but
+        # still mirrored here — without this their charges (and queue /
+        # gang-buffer entries) would leak until the node itself vanished.
+        # A pod created after the list was taken re-arrives through the
+        # resumed delta stream (its seq postdates the adopted cursor).
+        with self._view_lock:
+            known = {name: obj for name, obj in self._pod_view.items()
+                     if name not in listed_pods}
+        for name, obj in known.items():
+            self._view_drop(name)
+            self.queue.forget(name)
+            self.generic.clear_nomination(name)
+            self.gang_buffer.discard_pod(name)
+            self._conflict_cleared(name)
+            node_name = (obj.get("spec") or {}).get("nodeName")
+            if node_name:
+                ops.append((self.cache.remove_pod, (obj, node_name)))
+        self.cache.apply_batch(ops)
+        for pod in pods:
+            if not (pod.get("spec") or {}).get("nodeName"):
+                self.queue.push(pod)
+        self.queue.move_all_to_active()
+        log.info("watch relist: resynced %d node(s), %d pod(s), dropped "
+                 "%d deleted during the gap", len(nodes), len(pods),
+                 len(known))
+
     def _on_event(self, kind: str, event: str, obj: dict) -> None:
         if kind == "node":
             name = obj["metadata"]["name"]
@@ -1257,12 +1347,23 @@ class Scheduler:
                 self._view_store(obj)
             if event == "added" and not node_name:
                 self.queue.push(obj)
-            elif event == "added" and node_name:
-                # externally-bound pod (static pod / other binder): charge it
+            elif event in ("added", "modified") and node_name:
+                # bound pod observed: charge it. "added" covers static
+                # pods / restart replays; "modified" is how a COMPETING
+                # scheduler replica's bind arrives — without charging it,
+                # this replica's cache would re-offer the same chips
+                # forever. add_pod is idempotent (charge gate) and a
+                # no-op for pods this replica assumed itself. A bound
+                # pod also has no business queued here (another
+                # replica's win would otherwise cycle through the
+                # park/backoff sets until popped).
                 self.cache.add_pod(obj, node_name)
+                self.queue.forget(obj["metadata"]["name"])
+                self._conflict_cleared(obj["metadata"]["name"])
             elif event == "deleted":
                 self._view_drop(obj["metadata"]["name"])
                 self.queue.forget(obj["metadata"]["name"])
+                self._conflict_cleared(obj["metadata"]["name"])
                 self.generic.clear_nomination(obj["metadata"]["name"])
                 self.gang_buffer.discard_pod(obj["metadata"]["name"])
                 if node_name:
@@ -1299,11 +1400,17 @@ class Scheduler:
                     self._view_store(obj)
                 if event == "added" and not node_name:
                     post.append((self.queue.push, (obj,)))
-                elif event == "added" and node_name:
+                elif event in ("added", "modified") and node_name:
+                    # a bound pod (possibly a competing replica's bind
+                    # arriving as "modified"): charge idempotently and
+                    # drop any queue entry — see _on_event
                     ops.append((self.cache.add_pod, (obj, node_name)))
+                    post.append((self.queue.forget, (name,)))
+                    post.append((self._conflict_cleared, (name,)))
                 elif event == "deleted":
                     self._view_drop(name)
                     post.append((self.queue.forget, (name,)))
+                    post.append((self._conflict_cleared, (name,)))
                     post.append((self.generic.clear_nomination, (name,)))
                     post.append((self.gang_buffer.discard_pod, (name,)))
                     if node_name:
@@ -1326,6 +1433,14 @@ class Scheduler:
         if kube_pod is None:
             return False
         name = kube_pod["metadata"]["name"]
+        if self._shard_owned is not None and \
+                not self._shard_owned(self._shard_key(kube_pod)):
+            # another replica's shard (and its lease has a live holder):
+            # park cheaply and re-check — ownership moves when that
+            # holder dies (work stealing), and the coordinator fires
+            # move_all_to_active so stolen pods skip the park delay
+            self.queue.park(kube_pod, self.SHARD_PARK_S)
+            return True
         # Freshness check against the informer mirror (no GET round trip
         # per pod — the upstream scheduler trusts its informer the same
         # way); the API is consulted only when the mirror misses. A copy
@@ -1404,6 +1519,18 @@ class Scheduler:
             self._bind(kube_pod, host, t0)
         return True
 
+    @staticmethod
+    def _shard_key(kube_pod: dict) -> str:
+        """What a pod hashes into a shard BY: gang members route by
+        their gang id, not their own names — a gang split across
+        replicas would park in two buffers and never assemble."""
+        from kubegpu_tpu.scheduler.gang import gang_key
+
+        gk = gang_key(kube_pod)
+        if gk is not None:
+            return f"gang:{gk[0]}"
+        return kube_pod["metadata"]["name"]
+
     def _submit_bind(self, kube_pod: dict, host: str, t0: float) -> None:
         binder_ext = next((e for e in self.generic.extenders
                            if getattr(e, "bind_verb", None)), None)
@@ -1443,6 +1570,28 @@ class Scheduler:
         self.volume_binder.forget(kube_pod["metadata"]["name"])
         self.cache.forget_pod(kube_pod)
         self.queue.add_unschedulable(kube_pod)
+
+    def _conflict_requeue(self, kube_pod: dict) -> None:
+        """A competing scheduler replica won this pod's commit: release
+        the assume and retry PROMPTLY (short park, not unschedulable
+        backoff) — the replan runs against the winner's committed
+        state. A conflict STREAK means the replans keep losing (stale
+        view, pathological contention): degrade to the exponential
+        backoff so the pod cannot hot-loop against the arbiter."""
+        name = kube_pod["metadata"]["name"]
+        self.volume_binder.forget(name)
+        self.cache.forget_pod(kube_pod)
+        with self._conflict_lock:
+            streak = self._conflict_streak.get(name, 0) + 1
+            self._conflict_streak[name] = streak
+        if streak <= 3:
+            self.queue.park(kube_pod, self.CONFLICT_RETRY_S)
+        else:
+            self.queue.add_unschedulable(kube_pod)
+
+    def _conflict_cleared(self, name: str) -> None:
+        with self._conflict_lock:
+            self._conflict_streak.pop(name, None)
 
     # A spool drain caps its batch so one worker cannot hoard the whole
     # backlog while its siblings idle.
@@ -1507,27 +1656,48 @@ class Scheduler:
             ready.append((kube_pod, host, t0, ts))
         if not ready:
             return
+        from kubegpu_tpu.cluster.apiserver import Conflict
+
         tb = time.perf_counter()
-        try:
-            self._gang_bind_write(
-                [(p["metadata"]["name"], host, p)
-                 for p, host, _, _ in ready],
-                attempts=self.BIND_ATTEMPTS)
-        except Exception:
-            # degrade to per-pod binds with the same in-place retry
-            # budget (volume binds above are already committed and
-            # bind() re-entry no-ops on them) — one bad pod fails alone
-            for kube_pod, host, t0, ts in ready:
-                if self._bind(kube_pod, host, t0,
-                              attempts=self.BIND_ATTEMPTS):
-                    metrics.BIND_LATENCY_MS.observe(
-                        (time.perf_counter() - ts) * 1e3)
-            return
+        while ready:
+            try:
+                self._gang_bind_write(
+                    [(p["metadata"]["name"], host, p)
+                     for p, host, _, _ in ready],
+                    attempts=self.BIND_ATTEMPTS)
+                break
+            except Conflict as err:
+                # The arbiter named the losers (per-pod detail): forget +
+                # requeue exactly those — a Conflict is a definitive
+                # server answer, NEVER retried — and re-send the rest as
+                # one batch. Without detail (older server), degrade to
+                # the pessimistic per-pod path below.
+                losers = {n for n in getattr(err, "per_pod", None) or ()}
+                if not losers:
+                    ready = self._bind_batch_pessimistic(ready)
+                    return
+                survivors = []
+                for item in ready:
+                    name = item[0]["metadata"]["name"]
+                    if name in losers:
+                        self._event(name, "Warning", "FailedScheduling",
+                                    f"bind conflict: "
+                                    f"{err.per_pod.get(name)}; rescheduling")
+                        self._conflict_requeue(item[0])
+                    else:
+                        survivors.append(item)
+                ready = survivors
+                if not ready:
+                    return
+            except Exception:
+                self._bind_batch_pessimistic(ready)
+                return
         now = time.perf_counter()
         events = []
         for kube_pod, host, t0, ts in ready:
             name = kube_pod["metadata"]["name"]
             self.cache.confirm_pod(name)
+            self._conflict_cleared(name)
             self.generic.clear_nomination(name)
             self.queue.forget(name)
             events.append({"kind": "Pod", "name": name, "type": "Normal",
@@ -1538,6 +1708,16 @@ class Scheduler:
             metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
             metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
         self._events_batch(events)
+
+    def _bind_batch_pessimistic(self, items: list) -> list:
+        """Degrade a failed coalesced batch to per-pod binds with the
+        same in-place retry budget (volume binds are already committed
+        and bind() re-entry no-ops on them) — one bad pod fails alone."""
+        for kube_pod, host, t0, ts in items:
+            if self._bind(kube_pod, host, t0, attempts=self.BIND_ATTEMPTS):
+                metrics.BIND_LATENCY_MS.observe(
+                    (time.perf_counter() - ts) * 1e3)
+        return []
 
     def _events_batch(self, events: list) -> None:
         """Batched Event recording — observability only (an API hiccup
@@ -1703,7 +1883,13 @@ class Scheduler:
             try:
                 self.api.bind_many(bindings, annotations)
                 return
-            except (Conflict, NotFound):
+            except Conflict as err:
+                # a competing replica committed first: count each refused
+                # pod — the callers forget + requeue, never retry
+                metrics.SCHED_CONFLICTS.inc(
+                    max(1, len(getattr(err, "per_pod", None) or ())))
+                raise
+            except NotFound:
                 raise
             except Exception:
                 if attempt + 1 >= attempts:
@@ -1740,6 +1926,7 @@ class Scheduler:
                     committed.append(name)
             for name, _, _ in pinned_members:
                 self.cache.confirm_pod(name)
+                self._conflict_cleared(name)
                 self.queue.forget(name)
                 metrics.E2E_SCHEDULING_LATENCY.observe(
                     (time.perf_counter() - t0) * 1e6)
@@ -2049,11 +2236,17 @@ class Scheduler:
             return False
         try:
             self._bind_write(name, kube_pod, host, attempts)
-        except Exception:
-            self.cache.forget_pod(kube_pod)
-            self.queue.add_unschedulable(kube_pod)
+        except Exception as err:
+            from kubegpu_tpu.cluster.apiserver import Conflict
+
+            if isinstance(err, Conflict):
+                self._conflict_requeue(kube_pod)
+            else:
+                self.cache.forget_pod(kube_pod)
+                self.queue.add_unschedulable(kube_pod)
             return False
         self.cache.confirm_pod(name)
+        self._conflict_cleared(name)
         self.generic.clear_nomination(name)  # reservation served its purpose
         self.queue.forget(name)  # clears any leftover backoff state
         self._event(name, "Normal", "Scheduled",
@@ -2095,7 +2288,12 @@ class Scheduler:
                             raise
                         self.api.bind_pod(name, host)
                 return
-            except (Conflict, NotFound):
+            except Conflict:
+                # taken chip / taken port / bound elsewhere: a competing
+                # replica won this commit — forget + requeue, never retry
+                metrics.SCHED_CONFLICTS.inc()
+                raise
+            except NotFound:
                 raise
             except Exception:
                 if attempt + 1 >= attempts:

@@ -458,6 +458,11 @@ class NodeLifecycle:
     def start(self, interval_s: float | None = None) -> None:
         interval = interval_s if interval_s is not None \
             else max(0.05, self.stale_after_s / 2.0)
+        # Re-armable: the controller is singleton-ELECTED now (a lease
+        # Elector cycles start/stop as leadership moves between scheduler
+        # replicas), so a fresh stop event per start lets a demoted
+        # replica promote again later.
+        self._stop = threading.Event()
 
         def loop():
             while not self._stop.is_set():

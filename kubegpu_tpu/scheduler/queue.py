@@ -71,6 +71,17 @@ class SchedulingQueue:
             self._backoff[name] = backoff
             self._unschedulable[name] = (kube_pod, time.monotonic() + backoff)
 
+    def park(self, kube_pod: dict, delay_s: float) -> None:
+        """Park a pod for a fixed delay WITHOUT growing its
+        unschedulable backoff — used for pods outside this replica's
+        shard: what's pending is ownership, not schedulability, and
+        ``move_all_to_active`` (fired on shard-ownership change)
+        re-admits immediately."""
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            self._unschedulable[name] = (kube_pod,
+                                         time.monotonic() + delay_s)
+
     def _admit_backed_off_locked(self) -> None:
         now = time.monotonic()
         ready = [n for n, (_, at) in self._unschedulable.items() if at <= now]

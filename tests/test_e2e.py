@@ -215,14 +215,19 @@ def test_runtime_hook_strips_stale_devices_and_validates():
     assert paths.count("/dev/accel3") <= 1  # stale TPU entry stripped
     assert any(e["key"] == "KEEP" for e in cfg["envs"])
 
-    # tamper: annotation claims fewer chips than requested -> refuse
+    # tamper: annotation claims fewer chips than requested -> refuse.
+    # A bound pod's allocation annotation is immutable through the API
+    # now (the HA arbiter refuses the write), so the corruption is
+    # injected through the recovery-only state path — the hook must
+    # still validate what it reads, whatever wrote it.
     pod = api.get_pod("p")
     pi = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
     pi.running_containers["main"].allocate_from = {}
     pi.running_containers["main"].requests[grammar.RESOURCE_NUM_CHIPS] = 1
     meta = dict(pod["metadata"])
     codec.pod_info_to_annotation(meta, pi)
-    api.update_pod_annotations("p", meta["annotations"])
+    pod["metadata"] = meta
+    api.restore_object("pod", "modified", pod)
     with pytest.raises(AllocationMismatch):
         hosts["host0"].hook.create_container("p", "main", {})
 

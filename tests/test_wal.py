@@ -1,0 +1,367 @@
+"""WAL durability: crash recovery, torn tails, snapshot+compaction, and
+watch-resume exactness across an apiserver restart (cluster/wal.py +
+the WAL-backed _EventLog in cluster/httpapi.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, _EventLog, serve_api
+from kubegpu_tpu.cluster.wal import WriteAheadLog
+
+
+def _mutate(api: InMemoryAPIServer, n_pods: int = 4) -> None:
+    api.create_node({"metadata": {"name": "n1", "annotations": {"a": "1"}},
+                     "status": {"allocatable": {"cpu": "8"}}})
+    api.create_node({"metadata": {"name": "n2"}})
+    for i in range(n_pods):
+        api.create_pod({"metadata": {"name": f"p{i}"}})
+    api.bind_pod("p0", "n1")
+    api.update_pod_annotations("p1", {"k": "v"})
+    api.delete_pod("p2")
+    api.delete_node("n2")
+    api.record_event("Pod", "p0", "Normal", "Scheduled", "assigned")
+
+
+def _state(api: InMemoryAPIServer) -> tuple:
+    return (api.list_nodes(), api.list_pods(), api.list_events())
+
+
+def test_recovery_equals_pre_crash_state(tmp_path):
+    api1 = InMemoryAPIServer()
+    wal1 = WriteAheadLog(str(tmp_path), fsync=False)
+    log1 = _EventLog(api1, wal=wal1)
+    _mutate(api1)
+    seq1 = log1.seq()
+    wal1.close()  # process "crashes" — no snapshot ever taken
+
+    api2 = InMemoryAPIServer()
+    wal2 = WriteAheadLog(str(tmp_path), fsync=False)
+    log2 = _EventLog(api2, wal=wal2)
+    assert _state(api2) == _state(api1)
+    assert log2.seq() == seq1  # the sequence space continues
+    # the replayed log serves resume from any point with the same
+    # coalescing contract as the original log
+    events, latest, _, _ = log2.since(0, timeout=0.1)
+    original, _, _, _ = log1.since(0, timeout=0.1)
+    assert latest == seq1
+    assert events == original
+
+
+def test_recovered_log_resumes_seq_exact(tmp_path):
+    """A watcher that saw seq=s before the crash receives EXACTLY the
+    post-s events after recovery — none skipped, none replayed."""
+    api1 = InMemoryAPIServer()
+    wal1 = WriteAheadLog(str(tmp_path), fsync=False)
+    log1 = _EventLog(api1, wal=wal1)
+    api1.create_node({"metadata": {"name": "n1"}})
+    cursor = log1.seq()
+    for i in range(3):
+        api1.create_pod({"metadata": {"name": f"late{i}"}})
+    expected, _, _, _ = log1.since(cursor, timeout=0.1)
+    wal1.close()
+
+    api2 = InMemoryAPIServer()
+    log2 = _EventLog(api2, wal=WriteAheadLog(str(tmp_path), fsync=False))
+    replayed, _, _, _ = log2.since(cursor, timeout=0.1)
+    assert [(s, k, e, (o.get("metadata") or {}).get("name"))
+            for s, k, e, o in replayed] == \
+        [(s, k, e, (o.get("metadata") or {}).get("name"))
+         for s, k, e, o in expected]
+
+
+def test_torn_tail_is_dropped_not_fatal(tmp_path):
+    api1 = InMemoryAPIServer()
+    wal1 = WriteAheadLog(str(tmp_path), fsync=False)
+    _EventLog(api1, wal=wal1)
+    _mutate(api1)
+    wal1.close()
+    # simulate a crash mid-append: garbage partial record at the tail
+    with open(wal1.wal_path, "ab") as fh:
+        fh.write(b"\x40\x00\x00\x00\x12\x34\x56\x78partial")
+    api2 = InMemoryAPIServer()
+    wal2 = WriteAheadLog(str(tmp_path), fsync=False)
+    _EventLog(api2, wal=wal2)
+    assert wal2.dropped_tail_bytes > 0
+    assert _state(api2) == _state(api1)
+    # and the truncation leaves a clean log: a third recovery is exact
+    api3 = InMemoryAPIServer()
+    _EventLog(api3, wal=WriteAheadLog(str(tmp_path), fsync=False))
+    assert _state(api3) == _state(api1)
+
+
+def test_kill_at_every_record_boundary(tmp_path):
+    """Property-style: truncating the WAL at ANY byte offset recovers
+    exactly the records wholly before the cut — the acknowledged prefix
+    is never lost and the torn suffix never resurrects."""
+    api1 = InMemoryAPIServer()
+    wal1 = WriteAheadLog(str(tmp_path / "full"), fsync=False)
+    _EventLog(api1, wal=wal1)
+    for i in range(6):
+        api1.create_pod({"metadata": {"name": f"p{i}"}})
+    wal1.close()
+    blob = open(wal1.wal_path, "rb").read()
+    full_records = WriteAheadLog(str(tmp_path / "full"),
+                                 fsync=False).read_records()
+    assert len(full_records) == 6
+    for cut in range(0, len(blob), 7):
+        cut_dir = tmp_path / f"cut{cut}"
+        wal_cut = WriteAheadLog(str(cut_dir), fsync=False)
+        with open(wal_cut.wal_path, "wb") as fh:
+            fh.write(blob[:cut])
+        got = wal_cut.read_records()
+        want = [r for r in full_records
+                if _record_end(full_records, r) <= cut]
+        assert got == want, f"cut at byte {cut}"
+
+
+def _record_end(records, record) -> int:
+    """Byte offset where ``record`` ends in a log of ``records``."""
+    end = 0
+    for r in records:
+        end += 8 + len(json.dumps(list(r), separators=(",", ":"),
+                                  default=str).encode())
+        if r == record:
+            return end
+    raise AssertionError("record not in log")
+
+
+def test_snapshot_compaction_preserves_resume_window(tmp_path):
+    """After snapshot+compaction, recovery = snapshot + replayed suffix;
+    a client at a post-snapshot cursor resumes exactly, and the floor
+    marks pre-snapshot cursors as unreplayable (relist signal)."""
+    api1 = InMemoryAPIServer()
+    wal1 = WriteAheadLog(str(tmp_path), fsync=False, snapshot_every=5)
+    log1 = _EventLog(api1, wal=wal1)
+    for i in range(7):  # snapshot fires at the 5th event
+        api1.create_pod({"metadata": {"name": f"p{i}"}})
+    assert os.path.exists(wal1.snapshot_path)
+    snap_seq, _, _ = wal1.load_snapshot()
+    assert snap_seq == 5
+    post = log1.seq()
+    wal1.close()
+
+    api2 = InMemoryAPIServer()
+    wal2 = WriteAheadLog(str(tmp_path), fsync=False, snapshot_every=5)
+    log2 = _EventLog(api2, wal=wal2)
+    assert _state(api2) == _state(api1)
+    assert log2.seq() == post
+    # the snapshot's retained tail extends the resume window BELOW the
+    # compaction point: every pre-crash cursor resumes seq-exact here
+    assert log2.floor() == 0
+    events, _, _, _ = log2.since(snap_seq, timeout=0.1)
+    assert [(o.get("metadata") or {}).get("name")
+            for _, _, _, o in events] == ["p5", "p6"]
+    events, _, _, _ = log2.since(2, timeout=0.1)  # pre-snapshot cursor
+    assert [(o.get("metadata") or {}).get("name")
+            for _, _, _, o in events] == ["p2", "p3", "p4", "p5", "p6"]
+    assert wal2.recovered_records == 2  # tail is resume-only, not replay
+
+
+def test_crash_between_snapshot_and_truncate_is_safe(tmp_path):
+    """Replay skips records at or below the snapshot seq, so a WAL that
+    still holds pre-snapshot records (crash before truncation) applies
+    nothing twice."""
+    api1 = InMemoryAPIServer()
+    wal1 = WriteAheadLog(str(tmp_path), fsync=False)
+    log1 = _EventLog(api1, wal=wal1)
+    for i in range(4):
+        api1.create_pod({"metadata": {"name": f"p{i}"}})
+    # snapshot WITHOUT compaction: write the snapshot file directly,
+    # leaving every record in the log (the crash window)
+    doc = json.dumps({"seq": log1.seq(), "state": api1.dump_state()},
+                     default=str)
+    with open(wal1.snapshot_path, "w") as fh:
+        fh.write(doc)
+    wal1.close()
+    api2 = InMemoryAPIServer()
+    wal2 = WriteAheadLog(str(tmp_path), fsync=False)
+    _EventLog(api2, wal=wal2)
+    assert wal2.recovered_records == 0  # all records pre-snapshot
+    assert _state(api2) == _state(api1)
+
+
+def test_http_watch_relist_signals(tmp_path, monkeypatch):
+    """The serving layer's relist contract AFTER a restart: a
+    pre-snapshot ``since`` (unreplayable — the snapshot compacted it
+    away; tail retention disabled here to expose the boundary) and a
+    cursor from a future life (sequence regression) both answer with
+    ``relist`` instead of a silent gap; an in-window cursor resumes
+    exactly. A LIVE server that merely snapshotted keeps serving old
+    cursors from memory — no false relists."""
+    monkeypatch.setattr(_EventLog, "SNAPSHOT_TAIL", 0)
+    api = InMemoryAPIServer()
+    wal = WriteAheadLog(str(tmp_path), fsync=False, snapshot_every=5)
+    server, url = serve_api(api, wal=wal)
+    port = int(url.rsplit(":", 1)[1])
+    client = HTTPAPIClient(url)
+    try:
+        for i in range(7):
+            api.create_pod({"metadata": {"name": f"p{i}"}})
+        out = client._req("GET", "/watch?since=2&timeout=0.2")
+        assert "relist" not in out and out["events"]  # live: from memory
+        # restart from the WAL: replay covers only post-snapshot seqs
+        server.shutdown()
+        server.server_close()
+        wal.close()
+        wal = WriteAheadLog(str(tmp_path), fsync=False, snapshot_every=5)
+        server, url = serve_api(InMemoryAPIServer(), port=port, wal=wal)
+        out = client._req("GET", "/watch?since=2&timeout=0.2")
+        assert out.get("relist") is True  # pre-snapshot cursor
+        out = client._req("GET", "/watch?since=5&timeout=0.2")
+        assert "relist" not in out  # in-window: seq-exact resume
+        assert [(o.get("metadata") or {}).get("name")
+                for _, _, _, o in out["events"]] == ["p5", "p6"]
+        out = client._req("GET", "/watch?since=999&timeout=0.2")
+        assert out.get("relist") is True  # cursor from a future life
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        wal.close()
+
+
+def test_client_relists_and_scheduler_resyncs_on_restart():
+    """Satellite: a restarted apiserver WITHOUT a WAL must not strand
+    watchers — the client detects the sequence regression, fires its
+    relist listeners, and the scheduler re-lists + reconciles."""
+    from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+    from kubegpu_tpu.node.fake import FakeTPUBackend
+    from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+    from tests.test_scheduler_core import tpu_pod
+
+    def setup_state(api):
+        api.create_node({"metadata": {"name": "host0"},
+                         "status": {"allocatable": {"cpu": "8"}}})
+        mgr = DevicesManager()
+        mgr.add_device(TPUDeviceManager(FakeTPUBackend()))
+        mgr.start()
+        DeviceAdvertiser(api, mgr, "host0").advertise_once()
+
+    api1 = InMemoryAPIServer()
+    setup_state(api1)
+    server, url = serve_api(api1)
+    port = int(url.rsplit(":", 1)[1])
+    client = HTTPAPIClient(url, watch_kinds=("node", "pod", "pv", "pvc"))
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched = Scheduler(client, ds)
+    sched.start()
+    try:
+        client.create_pod(tpu_pod("before", 1))
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not client.get_pod("before")["spec"].get("nodeName"):
+            time.sleep(0.05)
+        assert client.get_pod("before")["spec"].get("nodeName") == "host0"
+
+        # restart WITHOUT durability: fresh server, fresh (empty) seq
+        # space, state re-seeded out-of-band — the delta stream is gone.
+        # The replacement state is built BEFORE the cut to keep the
+        # unreachable window short.
+        api2 = InMemoryAPIServer()
+        setup_state(api2)
+        api2.create_pod(client.get_pod("before"))  # survives "etcd"
+        server.shutdown()
+        server.server_close()
+        server, _ = serve_api(api2, port=port)
+
+        deadline = time.time() + 20
+        created = False
+        while time.time() < deadline:
+            try:
+                if not created:
+                    client.create_pod(tpu_pod("after", 1))
+                    created = True
+                if client.get_pod("after")["spec"].get("nodeName"):
+                    break
+            except KeyError:
+                pass
+            except Exception:
+                pass  # reconnecting across the restart
+            time.sleep(0.05)
+        assert client.get_pod("after")["spec"].get("nodeName") == "host0"
+        assert client.relist_count >= 1
+        assert sched.resync_count >= 1
+    finally:
+        sched.stop()
+        client.close()
+        server.shutdown()
+        server.server_close()
+
+
+def test_fresh_watch_client_does_not_relist_after_compaction(tmp_path):
+    """A client with NO cursor (since=0) has missed nothing — against a
+    compacted WAL (floor > 0) it must adopt the server's cursor quietly
+    instead of firing a relist that would double its startup LIST."""
+    api = InMemoryAPIServer()
+    wal = WriteAheadLog(str(tmp_path), fsync=False, snapshot_every=3)
+    server, url = serve_api(api, wal=wal)
+    client = HTTPAPIClient(url)
+    try:
+        for i in range(5):  # snapshot fires: the floor moves past 0
+            api.create_pod({"metadata": {"name": f"p{i}"}})
+        fired: list = []
+        got: list = []
+        client.add_relist_listener(lambda: fired.append(1))
+        client.add_watcher(
+            lambda k, e, o: got.append((o.get("metadata") or {})
+                                       .get("name")))
+        time.sleep(0.3)  # first poll: since=0 adopts the cursor quietly
+        api.create_pod({"metadata": {"name": "late"}})
+        deadline = time.time() + 5
+        while time.time() < deadline and "late" not in got:
+            time.sleep(0.05)
+        assert "late" in got  # the stream works from the adopted cursor
+        assert not fired and client.relist_count == 0
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        wal.close()
+
+
+def test_stream_epoch_identity(tmp_path):
+    """The watch stream's epoch: stable across WAL-backed restarts
+    (sequence continuity is real), fresh for every volatile life (so a
+    client can detect a restart whose new sequence space overlaps its
+    old cursor), and carried on every watch reply."""
+    wal1 = WriteAheadLog(str(tmp_path), fsync=False)
+    e1 = wal1.stream_epoch()
+    wal1.close()
+    assert WriteAheadLog(str(tmp_path), fsync=False).stream_epoch() == e1
+    durable = _EventLog(InMemoryAPIServer(),
+                        wal=WriteAheadLog(str(tmp_path), fsync=False))
+    assert durable.epoch == e1
+    volatile1 = _EventLog(InMemoryAPIServer())
+    volatile2 = _EventLog(InMemoryAPIServer())
+    assert volatile1.epoch != volatile2.epoch
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url)
+    try:
+        out = client._req("GET", "/watch?since=0&timeout=0.1")
+        assert out.get("epoch")
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+
+
+@pytest.mark.parametrize("fsync", [False, True])
+def test_fsync_modes_round_trip(tmp_path, fsync):
+    wal = WriteAheadLog(str(tmp_path), fsync=fsync)
+    wal.append(1, "pod", "added", {"metadata": {"name": "p"}})
+    wal.append(2, "pod", "deleted", {"metadata": {"name": "p"}})
+    wal.close()
+    records = WriteAheadLog(str(tmp_path), fsync=fsync).read_records()
+    assert [(s, k, e) for s, k, e, _ in records] == \
+        [(1, "pod", "added"), (2, "pod", "deleted")]
