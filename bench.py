@@ -22,7 +22,7 @@ import statistics
 import sys
 import time
 
-from kubegpu_tpu import metrics
+from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
 from kubegpu_tpu.core import codec, grammar
 from kubegpu_tpu.core.types import ContainerInfo, PodInfo
@@ -1545,8 +1545,40 @@ def smoke():
     assert hits > 0, "fit memo never hit during the smoke stream"
     assert metrics.BIND_LATENCY_MS.n > 0, \
         "binder pool never bound during the pipeline smoke"
+    # Tracing gates: (1) the always-on span ring produced a well-formed
+    # Perfetto trace (KGTPU_TRACE_OUT names the file; CI validates it
+    # again standalone); (2) span overhead is noise — ~10 spans ride a
+    # pod through the pipeline, so 10x the measured per-span cost must
+    # sit far inside the 10% p95 budget the acceptance sets. The probe
+    # uses a private recorder so its spans never pollute the real ring.
+    trace_out = os.environ.get("KGTPU_TRACE_OUT")
+    trace_spans = 0
+    if trace_out:
+        from kubegpu_tpu.obs.validate import validate_chrome_trace
+
+        trace_spans = obs.write_trace(trace_out)
+        with open(trace_out) as f:
+            problems = validate_chrome_trace(json.load(f))
+        assert not problems, f"emitted trace invalid: {problems[:5]}"
+        assert trace_spans > 0, "smoke run recorded no spans"
+    probe_rec = obs.SpanRecorder(capacity=64, proc="probe")
+    n_probe = 2000
+    t_probe = time.perf_counter()
+    for _ in range(n_probe):
+        with obs.span("overhead_probe", pod="probe-pod",
+                      recorder=probe_rec):
+            pass
+    per_span_us = (time.perf_counter() - t_probe) / n_probe * 1e6
+    p95_us = _p95_ms(lat) * 1e3
+    assert 10 * per_span_us <= 0.10 * p95_us, \
+        f"span overhead {per_span_us:.1f}us/span x ~10 spans/pod " \
+        f"exceeds 10% of the scale p95 ({p95_us:.0f}us) — tracing no " \
+        f"longer fits the latency budget"
     print(json.dumps({
         "metric": "bench_smoke",
+        "trace_span_overhead_us": round(per_span_us, 2),
+        "trace_overhead_vs_p95": round(10 * per_span_us / p95_us, 4),
+        "trace_spans": trace_spans,
         "scale_8node_p50_ms": round(statistics.median(lat) * 1e3, 3),
         "scale_8node_p95_ms": _p95_ms(lat),
         "sched_throughput_pods_per_s": throughput,

@@ -7,6 +7,13 @@ the ``_total`` suffix and histograms a unit suffix, Prometheus-style.
 Modules emit through the declared module-level objects
 (``metrics.EVICTIONS.inc()``); referencing an undeclared ``metrics.X``
 is a typo that would otherwise surface as an AttributeError mid-flight.
+
+Completeness is checked too: a metric declared in the registry must be
+covered by ``reset_all()`` (or its value leaks across test/bench runs)
+and by the Prometheus exposition (``prometheus_text``, wherever it
+lives) — a function that iterates ``all_metrics()`` is exhaustive by
+construction; one that hand-enumerates must name every declared metric.
+This closes the drift class where a new metric silently never exports.
 """
 
 from __future__ import annotations
@@ -15,9 +22,11 @@ import ast
 import re
 from typing import Iterator
 
-from kubegpu_tpu.analysis.engine import Context, Finding, SourceFile
+from kubegpu_tpu.analysis.engine import (Context, Finding, SourceFile,
+                                         dotted_name)
 
-_METRIC_TYPES = frozenset({"Counter", "Gauge", "Histogram"})
+_METRIC_TYPES = frozenset({"Counter", "Gauge", "Histogram",
+                           "LabeledHistogram"})
 _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _HISTOGRAM_UNITS = ("_microseconds", "_milliseconds", "_seconds", "_us",
                     "_ms", "_bytes", "_total")
@@ -55,8 +64,61 @@ class MetricRegistration:
                 if isinstance(t, ast.Name)
             }
             yield from self._check_registry(registry_src)
+            instances = self._metric_instances(registry_src)
+            yield from self._check_coverage(
+                registry_src, "reset_all", instances,
+                "not reset by reset_all() — its value would leak across "
+                "test/bench runs")
+            for src in sources:
+                yield from self._check_coverage(
+                    src, "prometheus_text", instances,
+                    "absent from the Prometheus exposition — it would "
+                    "never export")
         for src in sources:
             yield from self._check_module(src, registry_src, declared)
+
+    @staticmethod
+    def _metric_instances(registry_src: SourceFile) -> dict:
+        """{instance variable name: line} for every module-level metric
+        declaration in the registry."""
+        out: dict = {}
+        for node in registry_src.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    _metric_ctor(node.value) is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = node.lineno
+        return out
+
+    def _check_coverage(self, src: SourceFile, fn_name: str,
+                        instances: dict, why: str) -> Iterator[Finding]:
+        """Every declared metric must be referenced inside ``fn_name``
+        (by bare name or as ``metrics.X``) — unless the function calls
+        ``all_metrics()``, which makes it registry-driven and exhaustive
+        by construction."""
+        fn = next((node for node in src.tree.body
+                   if isinstance(node, ast.FunctionDef)
+                   and node.name == fn_name), None)
+        if fn is None:
+            return
+        referenced: set = set()
+        registry_driven = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                referenced.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                referenced.add(node.attr)
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func) or ""
+                if dotted.split(".")[-1] == "all_metrics":
+                    registry_driven = True
+        if registry_driven:
+            return
+        for name in sorted(set(instances) - referenced):
+            yield Finding(
+                self.name, src.path, fn.lineno,
+                f"metric `{name}` is declared in metrics.py but {why}; "
+                f"enumerate it in {fn_name}() or iterate all_metrics()")
 
     def _check_registry(self, src: SourceFile) -> Iterator[Finding]:
         seen: dict = {}

@@ -12,9 +12,16 @@ from __future__ import annotations
 import copy
 import json
 import threading
+import time
 
+from kubegpu_tpu import obs
 from kubegpu_tpu.cluster.lease import LeaseTable
 from kubegpu_tpu.core import codec, grammar
+
+# Span identity for the arbiter's trace rows: whichever process hosts
+# this store (a dedicated apiserver binary or an in-process simulate),
+# its commit/refusal spans must be tellable apart from scheduler spans.
+_OBS_PROC = "apiserver"
 
 # The gang process contract's annotation key (scheduler/gang.py writes
 # it). Spelled out here rather than imported: the cluster layer must not
@@ -323,7 +330,11 @@ class InMemoryAPIServer:
             self._pods[name] = stored
             self._index_pod_locked(stored)
             self._notify_locked("pod", "added", stored)
-            return copy.deepcopy(stored)
+            out = copy.deepcopy(stored)
+        # admission mints the pod's trace: the deterministic per-pod
+        # trace id starts its timeline here, before any scheduler sees it
+        obs.event("admitted", pod=name, proc=_OBS_PROC)
+        return out
 
     def get_pod(self, name: str) -> dict:
         with self._lock:
@@ -435,24 +446,43 @@ class InMemoryAPIServer:
         conflict arbiter also refuses a bind whose annotation claims a
         chip another bound pod holds or a coordinator port promised to a
         different gang — re-applying the same bind for the same node
-        stays a converging no-op."""
-        with self._lock:
-            if name not in self._pods:
-                raise NotFound(f"pod {name}")
-            pod = self._pods[name]
-            bound = pod.get("spec", {}).get("nodeName")
-            if bound and bound != node_name:
-                raise Conflict(f"pod {name} already bound to {bound}")
-            if not bound:
-                conflicts = self._bind_conflicts_locked({name: node_name}, {})
-                if conflicts:
-                    raise Conflict(f"pod {name}: {conflicts[name]}",
-                                   per_pod=conflicts)
-            self._deindex_pod_locked(pod)
-            pod.setdefault("spec", {})["nodeName"] = node_name
-            pod.setdefault("status", {})["phase"] = "Scheduled"
-            self._index_pod_locked(pod)
-            self._notify_locked("pod", "modified", pod)
+        stays a converging no-op. The decision is traced as an
+        ``arbiter_commit`` span continuing the caller's bind span (wire
+        header or in-process context)."""
+        wall, t0 = obs.wall_now(), time.perf_counter()
+        try:
+            with self._lock:
+                if name not in self._pods:
+                    raise NotFound(f"pod {name}")
+                pod = self._pods[name]
+                bound = pod.get("spec", {}).get("nodeName")
+                if bound and bound != node_name:
+                    raise Conflict(f"pod {name} already bound to {bound}")
+                if not bound:
+                    conflicts = self._bind_conflicts_locked(
+                        {name: node_name}, {})
+                    if conflicts:
+                        raise Conflict(f"pod {name}: {conflicts[name]}",
+                                       per_pod=conflicts)
+                self._deindex_pod_locked(pod)
+                pod.setdefault("spec", {})["nodeName"] = node_name
+                pod.setdefault("status", {})["phase"] = "Scheduled"
+                self._index_pod_locked(pod)
+                self._notify_locked("pod", "modified", pod)
+        except Conflict as err:
+            obs.record_span("arbiter_commit", wall,
+                            time.perf_counter() - t0, pod=name,
+                            proc=_OBS_PROC, outcome="conflict",
+                            reason=str(err))
+            raise
+        except NotFound:
+            obs.record_span("arbiter_commit", wall,
+                            time.perf_counter() - t0, pod=name,
+                            proc=_OBS_PROC, outcome="not_found")
+            raise
+        obs.record_span("arbiter_commit", wall, time.perf_counter() - t0,
+                        pod=name, proc=_OBS_PROC, node=node_name,
+                        outcome="committed")
 
     def bind_many(self, bindings: dict, annotations: dict) -> None:
         """Atomically annotate and bind a pod-set (gang commit): either
@@ -466,35 +496,56 @@ class InMemoryAPIServer:
         all-or-nothing across competing replicas — and the Conflict /
         NotFound carries per-pod reasons so the losing replica's binder
         forgets + requeues exactly the refused pods, never retries them
-        blind."""
-        with self._lock:
-            missing = {name: "not found" for name in bindings
-                       if name not in self._pods}
-            if missing:
-                raise NotFound(f"pods not found: {sorted(missing)}",
-                               per_pod=missing)
-            conflicts = self._bind_conflicts_locked(bindings, annotations)
-            if conflicts:
-                first = next(iter(sorted(conflicts)))
-                raise Conflict(
-                    f"bind refused for {len(conflicts)} pod(s), e.g. "
-                    f"{first}: {conflicts[first]}", per_pod=conflicts)
-            changed = []
-            for name, node_name in bindings.items():
-                pod = self._pods[name]
-                self._deindex_pod_locked(pod)
-                meta = pod.setdefault("metadata", {})
-                if name in annotations:
-                    meta["annotations"] = copy.deepcopy(annotations[name])
-                # a bindings-only entry (no annotations key) keeps the
-                # pod's existing annotations: a resend must never wipe a
-                # bound pod's allocation record and release its claims
-                pod.setdefault("spec", {})["nodeName"] = node_name
-                pod.setdefault("status", {})["phase"] = "Scheduled"
-                self._index_pod_locked(pod)
-                changed.append(pod)
-            for pod in changed:
-                self._notify_locked("pod", "modified", pod)
+        blind. Every pod's verdict is traced as an ``arbiter_commit``
+        span continuing that pod's bind span (per-pod contexts carried
+        by the batch header / in-process batch context)."""
+        wall, t0 = obs.wall_now(), time.perf_counter()
+        try:
+            with self._lock:
+                missing = {name: "not found" for name in bindings
+                           if name not in self._pods}
+                if missing:
+                    raise NotFound(f"pods not found: {sorted(missing)}",
+                                   per_pod=missing)
+                conflicts = self._bind_conflicts_locked(bindings, annotations)
+                if conflicts:
+                    first = next(iter(sorted(conflicts)))
+                    raise Conflict(
+                        f"bind refused for {len(conflicts)} pod(s), e.g. "
+                        f"{first}: {conflicts[first]}", per_pod=conflicts)
+                changed = []
+                for name, node_name in bindings.items():
+                    pod = self._pods[name]
+                    self._deindex_pod_locked(pod)
+                    meta = pod.setdefault("metadata", {})
+                    if name in annotations:
+                        meta["annotations"] = copy.deepcopy(annotations[name])
+                    # a bindings-only entry (no annotations key) keeps the
+                    # pod's existing annotations: a resend must never wipe a
+                    # bound pod's allocation record and release its claims
+                    pod.setdefault("spec", {})["nodeName"] = node_name
+                    pod.setdefault("status", {})["phase"] = "Scheduled"
+                    self._index_pod_locked(pod)
+                    changed.append(pod)
+                for pod in changed:
+                    self._notify_locked("pod", "modified", pod)
+        except (Conflict, NotFound) as err:
+            dur = time.perf_counter() - t0
+            outcome = "conflict" if isinstance(err, Conflict) \
+                else "not_found"
+            for name in sorted(bindings):
+                # the WHOLE batch is refused (gang atomicity): innocents
+                # record the batch-mate's reason so the timeline says why
+                obs.record_span("arbiter_commit", wall, dur, pod=name,
+                                proc=_OBS_PROC, outcome=outcome,
+                                reason=err.per_pod.get(name)
+                                or "batch refused")
+            raise
+        dur = time.perf_counter() - t0
+        for name, node_name in bindings.items():
+            obs.record_span("arbiter_commit", wall, dur, pod=name,
+                            proc=_OBS_PROC, node=node_name,
+                            outcome="committed")
 
     def delete_pod(self, name: str) -> None:
         with self._lock:

@@ -38,7 +38,7 @@ import urllib.error
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from kubegpu_tpu import metrics
+from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.cluster.apiserver import Conflict, InMemoryAPIServer, NotFound
 from kubegpu_tpu.cluster.lease import LeaseTable  # noqa: F401  (re-export:
 # the lease primitive moved to cluster/lease.py; the API server owns its
@@ -162,6 +162,17 @@ class _EventLog:
                 self._floor = self._events[drop - 1][0]
                 self._events = self._events[drop:]
             self._lock.notify_all()
+        if wal is not None and kind == "pod":
+            # continue the mutation's trace through durability: pod
+            # records only, and only when a span context is active (a
+            # traced bind reaching the WAL) — the steady watch stream
+            # and a traced request's side-writes (Events, PVC flips)
+            # must not flood the bounded ring
+            name = (obj.get("metadata") or {}).get("name") \
+                if isinstance(obj, dict) else None
+            if name is not None and obs.parent_for(name) is not None:
+                obs.event("wal_append", pod=name, proc="apiserver",
+                          event=event, seq=seq)
         if wal is not None and wal.due_for_snapshot():
             # Outside the event-log lock (state dump -> event-log seq is
             # the apiserver-first order every mutator already takes; the
@@ -264,7 +275,11 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
                         k, v = kv.split("=", 1)
                         query[k] = v
             try:
-                return self._dispatch(method, parts, query)
+                # re-install the caller's span context (if any) so the
+                # arbiter's and WAL's spans continue the caller's trace
+                # across the process boundary
+                with obs.remote_context(self.headers.get(obs.TRACE_HEADER)):
+                    return self._dispatch(method, parts, query)
             except NotFound as e:
                 body = {"error": str(e)}
                 if getattr(e, "per_pod", None):
@@ -288,6 +303,13 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
         def _dispatch(self, method, parts, query):
             if parts == ["healthz"]:
                 return self._send(200, {"ok": True})
+            if parts == ["debug", "traces"] and method == "GET":
+                # this process's span ring, Perfetto-loadable
+                return self._send(200, obs.chrome_trace())
+            if parts[:2] == ["debug", "pod"] and len(parts) == 3 \
+                    and method == "GET":
+                return self._send(200, obs.explain_pod(
+                    urllib.parse.unquote(parts[2])))
             if parts == ["watch"]:
                 kinds = frozenset(query["kinds"].split(",")) \
                     if query.get("kinds") else None
@@ -547,8 +569,13 @@ class HTTPAPIClient:
                 conn.sock.setsockopt(socket.IPPROTO_TCP,
                                      socket.TCP_NODELAY, 1)
                 conn.sock.settimeout(timeout)
-            conn.request(method, path, body=data,
-                         headers={"Content-Type": "application/json"})
+            headers = {"Content-Type": "application/json"}
+            trace_ctx = obs.header_value()
+            if trace_ctx is not None:
+                # carry the caller's span context across the hop: the
+                # server parents its arbiter/WAL spans under it
+                headers[obs.TRACE_HEADER] = trace_ctx
+            conn.request(method, path, body=data, headers=headers)
             resp = conn.getresponse()
             return resp.status, resp.read()
         except Exception:

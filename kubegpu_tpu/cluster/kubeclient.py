@@ -37,6 +37,7 @@ import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 
+from kubegpu_tpu import obs
 from kubegpu_tpu.cluster.apiserver import Conflict, NotFound
 
 log = logging.getLogger(__name__)
@@ -154,6 +155,12 @@ class KubeAPIClient:
         h = {"Content-Type": content_type, "Accept": "application/json"}
         if self.config.token:
             h["Authorization"] = f"Bearer {self.config.token}"
+        trace_ctx = obs.header_value()
+        if trace_ctx is not None:
+            # the binder's span context rides every write it performs
+            # (annotate/bind), same contract as the HTTP control-plane
+            # client — a tracing sidecar/proxy can continue the trace
+            h[obs.TRACE_HEADER] = trace_ctx
         h.update(self.config.extra_headers)
         return h
 

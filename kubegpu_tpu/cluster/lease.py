@@ -45,7 +45,7 @@ import time
 import zlib
 from typing import Callable, FrozenSet, Optional
 
-from kubegpu_tpu import metrics
+from kubegpu_tpu import metrics, obs
 
 log = logging.getLogger(__name__)
 
@@ -173,6 +173,11 @@ class Elector:
             metrics.LEASE_TRANSITIONS.inc()
             self.transitions += 1
             log.info("lease %s: %s lost the lease", self.name, self.holder)
+            # losing a held lease mid-run is an anomaly worth evidence
+            # (who was scheduling what when leadership moved); the
+            # flight recorder is inert unless configured
+            obs.FLIGHT.trigger("lease_lost", key=self.name,
+                               holder=self.holder)
             self._fire(self._on_lose)
         return granted
 

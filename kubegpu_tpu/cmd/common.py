@@ -43,42 +43,68 @@ def merge_flags(args, config: dict, keys: list) -> None:
             setattr(args, key, config[key])
 
 
-def prometheus_text() -> str:
-    """Render the process's metrics in Prometheus exposition format."""
+def _histogram_lines(h, labels: str = "") -> list:
+    """One histogram's exposition lines; ``labels`` is a pre-rendered
+    ``key="value",`` prefix for labeled children."""
     lines = []
-    for h in (metrics.E2E_SCHEDULING_LATENCY, metrics.ALGORITHM_LATENCY,
-              metrics.BINDING_LATENCY, metrics.BIND_LATENCY_MS,
-              metrics.WAL_FSYNC_MS):
-        lines.append(f"# TYPE {h.name} histogram")
-        cumulative = 0
-        for bound, count in zip(h.buckets, h.counts):
-            cumulative += count
-            lines.append(f'{h.name}_bucket{{le="{bound:g}"}} {cumulative}')
-        lines.append(f'{h.name}_bucket{{le="+Inf"}} {h.n}')
-        lines.append(f"{h.name}_sum {h.total:.6g}")
-        lines.append(f"{h.name}_count {h.n}")
-    for c in (metrics.SCHEDULE_ATTEMPTS, metrics.SCHEDULE_FAILURES,
-              metrics.PREEMPTION_VICTIMS, metrics.NODE_LOST,
-              metrics.EVICTIONS, metrics.WATCH_COALESCED,
-              metrics.SCHED_CONFLICTS, metrics.LEASE_TRANSITIONS):
-        lines.append(f"# TYPE {c.name} counter")
-        lines.append(f"{c.name} {c.value}")
-    for g in (metrics.NODE_READY, metrics.BIND_INFLIGHT,
-              metrics.WATCH_BATCH_SIZE, metrics.WAL_SNAPSHOT_BYTES):
-        lines.append(f"# TYPE {g.name} gauge")
-        lines.append(f"{g.name} {g.value}")
+    cumulative = 0
+    for bound, count in zip(h.buckets, h.counts):
+        cumulative += count
+        lines.append(f'{h.name}_bucket{{{labels}le="{bound:g}"}} '
+                     f"{cumulative}")
+    lines.append(f'{h.name}_bucket{{{labels}le="+Inf"}} {h.n}')
+    suffix = f"{{{labels[:-1]}}}" if labels else ""
+    lines.append(f"{h.name}_sum{suffix} {h.total:.6g}")
+    lines.append(f"{h.name}_count{suffix} {h.n}")
+    return lines
+
+
+def prometheus_text() -> str:
+    """Render the process's metrics in Prometheus exposition format.
+    Registry-driven: iterates ``metrics.all_metrics()``, so every
+    declared metric is exported — registration and exposition cannot
+    drift (the omission class the metric-registration analysis rule now
+    closes statically)."""
+    lines = []
+    for m in metrics.all_metrics():
+        if isinstance(m, metrics.LabeledHistogram):
+            lines.append(f"# TYPE {m.name} histogram")
+            for value, child in m.children():
+                lines.extend(_histogram_lines(
+                    child, f'{m.label}="{value}",'))
+        elif isinstance(m, metrics.Histogram):
+            lines.append(f"# TYPE {m.name} histogram")
+            lines.extend(_histogram_lines(m))
+        elif isinstance(m, metrics.Counter):
+            lines.append(f"# TYPE {m.name} counter")
+            lines.append(f"{m.name} {m.value}")
+        elif isinstance(m, metrics.Gauge):
+            lines.append(f"# TYPE {m.name} gauge")
+            lines.append(f"{m.name} {m.value}")
     return "\n".join(lines) + "\n"
 
 
-def serve_health(port: int, extra_status=None):
-    """healthz + /metrics server; returns the server (daemon thread), or
-    None when port <= 0."""
+def serve_health(port: int, extra_status=None, recorder=None):
+    """healthz + /metrics + trace-debug server; returns the server
+    (daemon thread), or None when port <= 0. ``/debug/traces`` serves
+    the process's span ring as Perfetto-loadable Chrome trace JSON;
+    ``/debug/pod/<name>`` answers "why is this pod Pending/slow" from
+    the same ring (``recorder`` defaults to the process-global one)."""
     if port is None or port <= 0:
         return None
+    from kubegpu_tpu import obs
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
             pass
+
+        def _json(self, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -97,6 +123,13 @@ def serve_health(port: int, extra_status=None):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path == "/debug/traces":
+                self._json(obs.chrome_trace(recorder=recorder))
+            elif self.path.startswith("/debug/pod/"):
+                from urllib.parse import unquote
+
+                name = unquote(self.path[len("/debug/pod/"):])
+                self._json(obs.explain_pod(name, recorder=recorder))
             else:
                 self.send_response(404)
                 self.end_headers()

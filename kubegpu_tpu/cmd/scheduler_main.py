@@ -41,7 +41,7 @@ LEASE_NAME = "kgtpu-scheduler"
 
 
 def build_scheduler(client, args, config: dict | None = None,
-                    shard_owned=None) -> Scheduler:
+                    shard_owned=None, name: str | None = None) -> Scheduler:
     from kubegpu_tpu.scheduler.extender import load_extenders
     from kubegpu_tpu.scheduler.factory import algorithm_from_policy
 
@@ -76,7 +76,7 @@ def build_scheduler(client, args, config: dict | None = None,
                       priority_weights=config.get("priorityWeights"),
                       algorithm=algorithm,
                       bind_workers=getattr(args, "bind_workers", 4),
-                      shard_owned=shard_owned)
+                      shard_owned=shard_owned, name=name)
     sched.preemption_enabled = not args.disable_preemption
     return sched
 
@@ -142,7 +142,15 @@ def main(argv=None) -> int:
     parser.add_argument("--node-stale-s", type=float, default=0.0,
                         help="heartbeat age marking a node Stale "
                              "(default: node-grace-s / 3)")
-    parser.add_argument("--healthz-port", type=int, default=0)
+    parser.add_argument("--healthz-port", type=int, default=0,
+                        help="healthz + /metrics + /debug/traces + "
+                             "/debug/pod/<name> server; 0 disables")
+    parser.add_argument("--flight-dir", default=None,
+                        help="directory for anomaly flight-recorder "
+                             "dumps (internal error, conflict-streak "
+                             "escalation, lease loss, gang eviction); "
+                             "defaults to $KGTPU_FLIGHT_DIR, unset "
+                             "disables")
     parser.add_argument("--scheduler-plugins-dir", default=None,
                         help="load extra device-scheduler plugins (*.py "
                              "exporting create_device_scheduler_plugin)")
@@ -165,6 +173,12 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
 
+    from kubegpu_tpu import obs
+
+    obs.RECORDER.proc = f"sched-{args.shard}" if args.replicas > 1 \
+        else "scheduler"
+    if args.flight_dir:
+        obs.FLIGHT.configure(args.flight_dir)
     common.serve_health(args.healthz_port, extra_status=lambda: True)
     lifecycle_elector = start_lifecycle_elector(client, args, holder)
 
@@ -177,7 +191,8 @@ def main(argv=None) -> int:
         coord = ShardCoordinator(client, shard, args.replicas,
                                  holder, ttl_s=args.lease_ttl)
         sched = build_scheduler(client, args, config,
-                                shard_owned=coord.owns)
+                                shard_owned=coord.owns,
+                                name=f"sched-{shard}")
         coord.on_change = sched.queue.move_all_to_active
         coord.start()
         sched.start()

@@ -28,7 +28,7 @@ import argparse
 import json
 import time
 
-from kubegpu_tpu import metrics
+from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
 from kubegpu_tpu.core import codec, grammar
 from kubegpu_tpu.core.types import ContainerInfo, PodInfo
@@ -270,7 +270,8 @@ def run_ha_chaos_scenario(pods_before: int = 6, pods_mid: int = 3,
             ds = DevicesScheduler()
             ds.add_device(TPUScheduler())
             sched = Scheduler(client, ds, bind_async=True,
-                              shard_owned=coord.owns)
+                              shard_owned=coord.owns,
+                              name=f"sched-{shard}")
             coord.on_change = sched.queue.move_all_to_active
             coord.start(interval_s=lease_ttl_s / 4.0)
             sched.start()
@@ -405,10 +406,25 @@ def main(argv=None) -> int:
                              "replicas")
     parser.add_argument("--seed", type=int, default=0,
                         help="chaos transport seed")
+    parser.add_argument("--trace-out", default=None,
+                        help="write the run's span ring as Chrome "
+                             "trace-event JSON (open in Perfetto); "
+                             "covers every in-process component — "
+                             "scheduler replicas AND the apiserver")
     args = parser.parse_args(argv)
+
+    def dump_trace():
+        if args.trace_out:
+            import sys
+
+            n = obs.write_trace(args.trace_out)
+            # stderr: --json consumers parse stdout as one document
+            print(f"trace: {n} spans -> {args.trace_out}",
+                  file=sys.stderr, flush=True)
 
     if args.chaos:
         result = run_chaos_scenario(seed=args.seed)
+        dump_trace()
         if args.json:
             print(json.dumps(result, indent=2))
         else:
@@ -420,6 +436,7 @@ def main(argv=None) -> int:
 
     if args.chaos_ha:
         result = run_ha_chaos_scenario()
+        dump_trace()
         if args.json:
             print(json.dumps(result, indent=2))
         else:
@@ -468,7 +485,8 @@ def main(argv=None) -> int:
                                      ttl_s=5.0)
             coords.append(coord)
             owns = coord.owns
-        s = Scheduler(api, ds, bind_async=True, shard_owned=owns)
+        s = Scheduler(api, ds, bind_async=True, shard_owned=owns,
+                      name=f"sched-{shard}")
         if n_sched > 1:
             coords[shard].on_change = s.queue.move_all_to_active
             coords[shard].tick()
@@ -568,6 +586,7 @@ def main(argv=None) -> int:
         s.stop()
     for coord in coords:
         coord.stop()
+    dump_trace()
     return 0
 
 

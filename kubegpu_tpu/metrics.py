@@ -1,16 +1,22 @@
-"""Prometheus-style metrics and per-pod trace spans.
+"""Prometheus-style metrics: the process's one metric registry.
 
-Mirrors the reference's observability surface (SURVEY.md §6):
-latency histograms (`kube-scheduler/pkg/metrics/metrics.go:29-67`) and
-`utiltrace`-style per-pod spans logged only when they exceed a threshold
-(`core/generic_scheduler.go:131-132`).
+Mirrors the reference's observability surface (SURVEY.md §6): latency
+histograms (`kube-scheduler/pkg/metrics/metrics.go:29-67`) plus this
+project's own counters/gauges. Every metric is declared exactly once at
+module level here; ``all_metrics()`` discovers them by scan, and both
+``reset_all()`` and the Prometheus exposition (`cmd/common.py`) iterate
+that registry — a newly declared metric can never be silently absent
+from either (the drift the old hand-enumerated lists allowed, enforced
+statically by the ``metric-registration`` analysis rule).
+
+Per-pod tracing moved to ``kubegpu_tpu/obs`` (spans, propagation, flight
+recorder); the histograms here are the aggregate half of that story.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-import time
 
 log = logging.getLogger("kubegpu_tpu")
 
@@ -22,6 +28,8 @@ class Histogram:
     def __init__(self, name: str, start_us: float = 1000.0, factor: float = 2.0,
                  count: int = 15):
         self.name = name
+        self.start_us = start_us
+        self.factor = factor
         self.buckets = [start_us * factor**i for i in range(count)]
         self.counts = [0] * (count + 1)
         self.total = 0.0
@@ -39,21 +47,35 @@ class Histogram:
             self.counts[-1] += 1
 
     def percentile(self, q: float) -> float:
-        """Approximate percentile from bucket counts (upper-bound estimate)."""
+        """Approximate percentile from bucket counts, linearly
+        interpolated within the landing bucket (rank position over the
+        bucket's count, between its lower and upper bound) — so
+        /metrics-derived p50/p95 move smoothly instead of stepping
+        between bucket upper bounds. The overflow bucket has no upper
+        bound; its answer stays the last finite bound."""
         with self._lock:
             if self.n == 0:
                 return 0.0
             target = q * self.n
             seen = 0
+            lo = 0.0
             for i, c in enumerate(self.counts[:-1]):
+                if c and seen + c >= target:
+                    hi = self.buckets[i]
+                    return lo + (hi - lo) * (target - seen) / c
                 seen += c
-                if seen >= target:
-                    return self.buckets[i]
+                lo = self.buckets[i]
             return self.buckets[-1]
 
     def mean(self) -> float:
         with self._lock:
             return self.total / self.n if self.n else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts = [0] * len(self.counts)
+            self.total = 0.0
+            self.n = 0
 
 
 class Counter:
@@ -65,6 +87,10 @@ class Counter:
     def inc(self, by: int = 1) -> None:
         with self._lock:
             self.value += by
+
+    def reset(self) -> None:
+        with self._lock:
+            self.value = 0
 
 
 class Gauge:
@@ -78,6 +104,43 @@ class Gauge:
     def set(self, value) -> None:
         with self._lock:
             self.value = value
+
+    def reset(self) -> None:
+        with self._lock:
+            self.value = 0
+
+
+class LabeledHistogram:
+    """A histogram family keyed by one label (Prometheus
+    ``name{label="value"}``): children are created on first use and
+    rendered per label value by the exposition. Declared here like every
+    other metric so the registry scan finds the family."""
+
+    def __init__(self, name: str, label: str, start_us: float = 1000.0,
+                 factor: float = 2.0, count: int = 15):
+        self.name = name
+        self.label = label
+        self._ctor = (start_us, factor, count)
+        self._lock = threading.Lock()
+        self._children: dict = {}
+
+    def labels(self, value: str) -> Histogram:
+        with self._lock:
+            child = self._children.get(value)
+            if child is None:
+                start_us, factor, count = self._ctor
+                child = Histogram(self.name, start_us, factor, count)
+                self._children[value] = child
+            return child
+
+    def children(self) -> list:
+        """[(label value, child histogram)] sorted by label value."""
+        with self._lock:
+            return sorted(self._children.items())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._children = {}
 
 
 # The reference's three scheduler histograms (`metrics.go:29-54`).
@@ -131,41 +194,28 @@ SCHED_CONFLICTS = Counter("sched_conflicts_total")
 LEASE_TRANSITIONS = Counter("lease_transitions_total")
 WAL_FSYNC_MS = Histogram("wal_fsync_ms", start_us=0.01)
 WAL_SNAPSHOT_BYTES = Gauge("wal_snapshot_bytes")
+# Observability layer (kubegpu_tpu/obs): per-phase scheduling latency —
+# one ms-valued histogram family labeled by pipeline phase (queue_wait /
+# filter / score / allocate / bind_commit), the aggregate view of the
+# same boundaries the trace spans mark; flight_dumps_total counts
+# anomaly dumps the flight recorder wrote.
+SCHED_PHASE_MS = LabeledHistogram("sched_phase_ms", "phase", start_us=0.01)
+FLIGHT_DUMPS = Counter("flight_dumps_total")
+
+
+def all_metrics() -> list:
+    """Every metric instance declared at module level, discovered by
+    scan — registration, reset, and exposition iterate THIS, so a newly
+    declared metric can never drift out of any of them."""
+    out = []
+    for name in sorted(globals()):
+        obj = globals()[name]
+        if isinstance(obj, (Histogram, Counter, Gauge, LabeledHistogram)):
+            out.append(obj)
+    return out
 
 
 def reset_all() -> None:
     """Fresh metric state (tests and bench runs)."""
-    for h in (E2E_SCHEDULING_LATENCY, ALGORITHM_LATENCY, BINDING_LATENCY,
-              BIND_LATENCY_MS, WAL_FSYNC_MS):
-        h.__init__(h.name, start_us=h.buckets[0])
-    for c in (SCHEDULE_ATTEMPTS, SCHEDULE_FAILURES, PREEMPTION_VICTIMS,
-              INTERNAL_ERRORS, NATIVE_FALLBACKS, NODE_LOST, EVICTIONS,
-              FIT_CACHE_HITS, FIT_CACHE_MISSES, FIT_CACHE_INVALIDATIONS,
-              WATCH_COALESCED, SCHED_CONFLICTS, LEASE_TRANSITIONS):
-        c.__init__(c.name)
-    for g in (NODE_READY, BIND_INFLIGHT, WATCH_BATCH_SIZE,
-              WAL_SNAPSHOT_BYTES):
-        g.__init__(g.name)
-
-
-class Trace:
-    """Per-operation step trace, logged only if total exceeds threshold.
-
-    Reference: utiltrace usage at `core/generic_scheduler.go:131-176` with
-    a 100ms threshold.
-    """
-
-    def __init__(self, name: str, threshold_s: float = 0.1):
-        self.name = name
-        self.threshold_s = threshold_s
-        self.start = time.perf_counter()
-        self.steps: list = []
-
-    def step(self, msg: str) -> None:
-        self.steps.append((time.perf_counter() - self.start, msg))
-
-    def log_if_long(self) -> None:
-        total = time.perf_counter() - self.start
-        if total >= self.threshold_s:
-            lines = "; ".join(f"{t * 1e3:.1f}ms {m}" for t, m in self.steps)
-            log.warning("trace %s took %.1fms: %s", self.name, total * 1e3, lines)
+    for metric in all_metrics():
+        metric.reset()

@@ -1,0 +1,13 @@
+"""Observability: distributed scheduling traces + anomaly flight recorder.
+
+See ``obs/trace.py`` (spans, propagation, export), ``obs/flight.py``
+(dump-on-anomaly), and ``obs/validate.py`` (trace-file CI gate)."""
+
+from kubegpu_tpu.obs.trace import (RECORDER, TRACE_HEADER, Span,  # noqa: F401
+                                   SpanRecorder, batch_context,
+                                   chrome_trace, current, event,
+                                   explain_pod, header_value, parent_for,
+                                   record_span, remote_context, span,
+                                   start_span, trace_id_for_pod,
+                                   wall_now, write_trace)
+from kubegpu_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401

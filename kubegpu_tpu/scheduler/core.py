@@ -21,7 +21,7 @@ import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
-from kubegpu_tpu import metrics
+from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.core import codec, grammar
 from kubegpu_tpu.scheduler import factory, interpod, predicates, priorities
 from kubegpu_tpu.scheduler.cache import SchedulerCache
@@ -640,27 +640,39 @@ class GenericScheduler:
         return top[self._last_node_index % len(top)]
 
     def schedule(self, kube_pod: dict) -> str:
-        """Choose a host (`generic_scheduler.go:130-188`)."""
+        """Choose a host (`generic_scheduler.go:130-188`). The phases are
+        traced as spans (obs) AND observed into the per-phase histograms
+        — the same boundaries feed both the per-pod timeline and the
+        aggregate /metrics view; a slow pass still logs its steps (the
+        old utiltrace behavior, via ``slow_log_s``)."""
         pod_name = kube_pod["metadata"]["name"]
-        trace = metrics.Trace(f"schedule {pod_name}")
+        proc = getattr(self, "obs_name", "scheduler")
         t0 = time.perf_counter()
-        feasible, failures, snaps, meta = self.find_nodes_that_fit(kube_pod)
-        trace.step("computed predicates")
-        if not feasible:
-            trace.log_if_long()
-            raise FitError(pod_name, failures)
-        if len(feasible) == 1:
-            host = next(iter(feasible))
-        else:
-            scored = self.prioritize_nodes(kube_pod, feasible, snaps, meta)
-            trace.step("prioritized")
-            if not scored:  # every feasible node vanished mid-pass
-                trace.log_if_long()
-                raise FitError(pod_name, {n: ["node gone"] for n in feasible})
-            host = self.select_host(scored)
-        trace.step("selected host")
+        with obs.span("schedule", pod=pod_name, proc=proc,
+                      slow_log_s=0.1) as alg:
+            with obs.span("filter", pod=pod_name, proc=proc) as sp:
+                feasible, failures, snaps, meta = \
+                    self.find_nodes_that_fit(kube_pod)
+                sp.attrs["feasible"] = len(feasible)
+            metrics.SCHED_PHASE_MS.labels("filter").observe(sp.dur_s * 1e3)
+            if not feasible:
+                alg.attrs["outcome"] = "unschedulable"
+                raise FitError(pod_name, failures)
+            if len(feasible) == 1:
+                host = next(iter(feasible))
+            else:
+                with obs.span("score", pod=pod_name, proc=proc) as sp:
+                    scored = self.prioritize_nodes(kube_pod, feasible,
+                                                   snaps, meta)
+                metrics.SCHED_PHASE_MS.labels("score").observe(
+                    sp.dur_s * 1e3)
+                if not scored:  # every feasible node vanished mid-pass
+                    alg.attrs["outcome"] = "unschedulable"
+                    raise FitError(pod_name,
+                                   {n: ["node gone"] for n in feasible})
+                host = self.select_host(scored)
+            alg.attrs["host"] = host
         metrics.ALGORITHM_LATENCY.observe((time.perf_counter() - t0) * 1e6)
-        trace.log_if_long()
         return host
 
     OWNER_LIST_TTL_S = 2.0
@@ -1162,13 +1174,19 @@ class Scheduler:
                  extenders: list | None = None,
                  priority_weights: dict | None = None,
                  algorithm: factory.AlgorithmConfig | None = None,
-                 bind_workers: int = 4, shard_owned=None):
+                 bind_workers: int = 4, shard_owned=None,
+                 name: str | None = None):
         from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
 
         self.api = api
         self.device_scheduler = device_scheduler
         self.cache = SchedulerCache(device_scheduler)
         self.queue = SchedulingQueue()
+        # span identity: which scheduler replica a trace row belongs to
+        # (an HA run puts several engines over one apiserver — their
+        # spans must be tellable apart in a merged timeline)
+        self.obs_name = name or "scheduler"
+        self.queue.obs_name = self.obs_name
         from kubegpu_tpu.scheduler.volumebinder import VolumeBinder
 
         self.generic = GenericScheduler(self.cache, device_scheduler, parallelism,
@@ -1176,6 +1194,7 @@ class Scheduler:
                                         priority_weights=priority_weights,
                                         algorithm=algorithm)
         self.generic.api = api
+        self.generic.obs_name = self.obs_name
         self.volume_binder = VolumeBinder(api)
         self.generic.volume_binder = self.volume_binder
         self.gang_buffer = GangBuffer()
@@ -1360,6 +1379,8 @@ class Scheduler:
                 self.cache.add_pod(obj, node_name)
                 self.queue.forget(obj["metadata"]["name"])
                 self._conflict_cleared(obj["metadata"]["name"])
+                obs.event("watch_delivery", pod=obj["metadata"]["name"],
+                          proc=self.obs_name, node=node_name)
             elif event == "deleted":
                 self._view_drop(obj["metadata"]["name"])
                 self.queue.forget(obj["metadata"]["name"])
@@ -1407,6 +1428,11 @@ class Scheduler:
                     ops.append((self.cache.add_pod, (obj, node_name)))
                     post.append((self.queue.forget, (name,)))
                     post.append((self._conflict_cleared, (name,)))
+                    # the watch stream closing the loop: this replica's
+                    # informer observed the committed bind (its own or a
+                    # competitor's) — the last hop of the pod's timeline
+                    obs.event("watch_delivery", pod=name,
+                              proc=self.obs_name, node=node_name)
                 elif event == "deleted":
                     self._view_drop(name)
                     post.append((self.queue.forget, (name,)))
@@ -1472,51 +1498,71 @@ class Scheduler:
         metrics.SCHEDULE_ATTEMPTS.inc()
         t0 = time.perf_counter()
         self.cache.expire_assumed()
-        try:
-            host = self.generic.schedule(kube_pod)
-            if not self._assume_volumes(kube_pod, host):
-                # volume state moved between the fit pass and host
-                # selection (another pod grabbed the PV): requeue, the
-                # next pass recomputes against fresh PV state
+        with obs.span("schedule_cycle", pod=name, proc=self.obs_name) as cyc:
+            try:
+                host = self.generic.schedule(kube_pod)
+                if not self._assume_volumes(kube_pod, host):
+                    # volume state moved between the fit pass and host
+                    # selection (another pod grabbed the PV): requeue, the
+                    # next pass recomputes against fresh PV state
+                    metrics.SCHEDULE_FAILURES.inc()
+                    self._event(name, "Warning", "FailedScheduling",
+                                f"volume binding lost race on {host}")
+                    self.queue.add_unschedulable(kube_pod)
+                    return True
+                with obs.span("allocate", pod=name, proc=self.obs_name,
+                              node=host) as sp:
+                    self.generic.allocate_devices(kube_pod, host)
+                metrics.SCHED_PHASE_MS.labels("allocate").observe(
+                    sp.dur_s * 1e3)
+            except FitError as err:
+                self.volume_binder.forget(name)
                 metrics.SCHEDULE_FAILURES.inc()
-                self._event(name, "Warning", "FailedScheduling",
-                            f"volume binding lost race on {host}")
+                summary = self._summarize_failures(err.failures)
+                cyc.attrs["outcome"] = "unschedulable"
+                # the "why is this pod Pending" record /debug/pod serves:
+                # the aggregate summary plus per-node reasons (capped —
+                # a 4k-node FitError must not balloon the ring)
+                obs.event("unschedulable", pod=name, proc=self.obs_name,
+                          message=summary,
+                          failures={n: err.failures[n] for n in
+                                    sorted(err.failures)[:16]})
+                self._event(name, "Warning", "FailedScheduling", summary)
+                if self.preemption_enabled and \
+                        self._try_preempt(kube_pod, err.failures):
+                    self.queue.push(kube_pod)
+                else:
+                    self.queue.add_unschedulable(kube_pod)
+                return True
+            except Exception as err:
+                # NOT a FitError: an internal code fault (the round-2
+                # NameError masqueraded as "unschedulable" through this
+                # path for a whole round). Log loudly, count separately,
+                # dump the flight ring, and park the pod so the loop
+                # survives — but never silently (reference stance:
+                # `node_info.go:336-340` panics on corrupted internal
+                # state).
+                self.volume_binder.forget(name)
+                metrics.INTERNAL_ERRORS.inc()
+                cyc.attrs["outcome"] = "internal_error"
+                logging.getLogger(__name__).exception(
+                    "internal scheduler error while scheduling %s", name)
+                obs.FLIGHT.trigger("internal_error", key=name, pod=name,
+                                   error=f"{type(err).__name__}: {err}")
+                self._event(name, "Warning", "SchedulerInternalError",
+                            f"{type(err).__name__}: {err}")
                 self.queue.add_unschedulable(kube_pod)
                 return True
-            self.generic.allocate_devices(kube_pod, host)
-        except FitError as err:
-            self.volume_binder.forget(name)
-            metrics.SCHEDULE_FAILURES.inc()
-            self._event(name, "Warning", "FailedScheduling",
-                        self._summarize_failures(err.failures))
-            if self.preemption_enabled and \
-                    self._try_preempt(kube_pod, err.failures):
-                self.queue.push(kube_pod)
-            else:
-                self.queue.add_unschedulable(kube_pod)
-            return True
-        except Exception as err:
-            # NOT a FitError: an internal code fault (the round-2 NameError
-            # masqueraded as "unschedulable" through this path for a whole
-            # round). Log loudly, count separately, and park the pod so the
-            # loop survives — but never silently (reference stance:
-            # `node_info.go:336-340` panics on corrupted internal state).
-            self.volume_binder.forget(name)
-            metrics.INTERNAL_ERRORS.inc()
-            logging.getLogger(__name__).exception(
-                "internal scheduler error while scheduling %s", name)
-            self._event(name, "Warning", "SchedulerInternalError",
-                        f"{type(err).__name__}: {err}")
-            self.queue.add_unschedulable(kube_pod)
-            return True
 
-        self.cache.assume_pod(kube_pod, host)
-        if self._binder is not None:
-            # the cycle stops here: the transport half runs on a bind
-            # worker, overlapping with the next pod's scheduling pass
-            self._submit_bind(kube_pod, host, t0)
-        else:
-            self._bind(kube_pod, host, t0)
+            self.cache.assume_pod(kube_pod, host)
+            obs.event("assume", pod=name, proc=self.obs_name, node=host)
+            cyc.attrs["host"] = host
+            if self._binder is not None:
+                # the cycle stops here: the transport half runs on a bind
+                # worker, overlapping with the next pod's scheduling pass
+                self._submit_bind(kube_pod, host, t0, parent=cyc.context())
+            else:
+                self._bind(kube_pod, host, t0, parent=cyc.context())
         return True
 
     @staticmethod
@@ -1531,18 +1577,19 @@ class Scheduler:
             return f"gang:{gk[0]}"
         return kube_pod["metadata"]["name"]
 
-    def _submit_bind(self, kube_pod: dict, host: str, t0: float) -> None:
+    def _submit_bind(self, kube_pod: dict, host: str, t0: float,
+                     parent=None) -> None:
         binder_ext = next((e for e in self.generic.extenders
                            if getattr(e, "bind_verb", None)), None)
         if binder_ext is not None:
             # a bind-verb extender is not promised thread safety (the
             # gang path keeps extender binds on this thread for the same
             # reason), so its binds never ride the worker pool
-            self._bind(kube_pod, host, t0)
+            self._bind(kube_pod, host, t0, parent=parent)
             return
         with self._spool_lock:
             self._bind_spool.append((kube_pod, host, t0,
-                                     time.perf_counter()))
+                                     time.perf_counter(), parent))
             if self._spool_draining:
                 return  # the active drainer's loop will pick this up
             self._spool_draining = True
@@ -1584,9 +1631,16 @@ class Scheduler:
         with self._conflict_lock:
             streak = self._conflict_streak.get(name, 0) + 1
             self._conflict_streak[name] = streak
+        obs.event("conflict_loss", pod=name, proc=self.obs_name,
+                  streak=streak)
         if streak <= 3:
             self.queue.park(kube_pod, self.CONFLICT_RETRY_S)
         else:
+            # escalation is an anomaly worth evidence: the replica keeps
+            # re-deriving plans the arbiter refuses (stale view or
+            # pathological contention)
+            obs.FLIGHT.trigger("conflict_streak", key=name, pod=name,
+                               streak=streak)
             self.queue.add_unschedulable(kube_pod)
 
     def _conflict_cleared(self, name: str) -> None:
@@ -1616,7 +1670,7 @@ class Scheduler:
                 self._process_bind_items(items)
             except Exception:
                 log.exception("bind batch crashed; requeueing its pods")
-                for kube_pod, _, _, _ in items:
+                for kube_pod, _, _, _, _ in items:
                     try:
                         self._bind_failed(kube_pod)
                     except Exception:
@@ -1628,9 +1682,9 @@ class Scheduler:
             # no batch verb on this transport: per-pod writes
             # (bind-verb extenders never reach here — _submit_bind keeps
             # their binds on the scheduling thread)
-            for kube_pod, host, t0, ts in items:
+            for kube_pod, host, t0, ts, parent in items:
                 if self._bind(kube_pod, host, t0,
-                              attempts=self.BIND_ATTEMPTS):
+                              attempts=self.BIND_ATTEMPTS, parent=parent):
                     metrics.BIND_LATENCY_MS.observe(
                         (time.perf_counter() - ts) * 1e3)
             return
@@ -1643,9 +1697,15 @@ class Scheduler:
         semantically all-or-nothing (these pods are independent): if the
         batch write fails, each pod degrades to its own per-pod bind so
         one bad pod (deleted mid-flight, bound elsewhere) cannot requeue
-        its batch-mates."""
+        its batch-mates.
+
+        Each pod gets a ``bind_commit`` span parented under its
+        scheduling cycle; the span contexts ride the batch write
+        (``obs.batch_context`` → wire header on HTTP transports) so the
+        apiserver's arbiter-commit and WAL-append spans continue the
+        same per-pod traces."""
         ready = []
-        for kube_pod, host, t0, ts in items:
+        for kube_pod, host, t0, ts, parent in items:
             name = kube_pod["metadata"]["name"]
             if not self.volume_binder.bind(name):
                 self.cache.forget_pod(kube_pod)
@@ -1653,18 +1713,25 @@ class Scheduler:
                             "volume bind conflict; rescheduling")
                 self.queue.add_unschedulable(kube_pod)
                 continue
-            ready.append((kube_pod, host, t0, ts))
+            ready.append((kube_pod, host, t0, ts, parent))
         if not ready:
             return
         from kubegpu_tpu.cluster.apiserver import Conflict
 
         tb = time.perf_counter()
+        spans = {p["metadata"]["name"]:
+                 obs.start_span("bind_commit",
+                                pod=p["metadata"]["name"], parent=parent,
+                                proc=self.obs_name, node=host)
+                 for p, host, _, _, parent in ready}
         while ready:
             try:
-                self._gang_bind_write(
-                    [(p["metadata"]["name"], host, p)
-                     for p, host, _, _ in ready],
-                    attempts=self.BIND_ATTEMPTS)
+                with obs.batch_context({n: sp.context()
+                                        for n, sp in spans.items()}):
+                    self._gang_bind_write(
+                        [(p["metadata"]["name"], host, p)
+                         for p, host, _, _, _ in ready],
+                        attempts=self.BIND_ATTEMPTS)
                 break
             except Conflict as err:
                 # The arbiter named the losers (per-pod detail): forget +
@@ -1674,12 +1741,17 @@ class Scheduler:
                 # the pessimistic per-pod path below.
                 losers = {n for n in getattr(err, "per_pod", None) or ()}
                 if not losers:
-                    ready = self._bind_batch_pessimistic(ready)
+                    for sp in spans.values():
+                        sp.finish(outcome="degraded")
+                    self._bind_batch_pessimistic(ready)
                     return
                 survivors = []
                 for item in ready:
                     name = item[0]["metadata"]["name"]
                     if name in losers:
+                        spans.pop(name).finish(
+                            outcome="conflict",
+                            reason=err.per_pod.get(name))
                         self._event(name, "Warning", "FailedScheduling",
                                     f"bind conflict: "
                                     f"{err.per_pod.get(name)}; rescheduling")
@@ -1690,11 +1762,13 @@ class Scheduler:
                 if not ready:
                     return
             except Exception:
+                for sp in spans.values():
+                    sp.finish(outcome="degraded")
                 self._bind_batch_pessimistic(ready)
                 return
         now = time.perf_counter()
         events = []
-        for kube_pod, host, t0, ts in ready:
+        for kube_pod, host, t0, ts, _parent in ready:
             name = kube_pod["metadata"]["name"]
             self.cache.confirm_pod(name)
             self._conflict_cleared(name)
@@ -1704,6 +1778,9 @@ class Scheduler:
                            "reason": "Scheduled",
                            "message": f"Successfully assigned {name} "
                                       f"to {host}"})
+            spans[name].finish(outcome="committed")
+            metrics.SCHED_PHASE_MS.labels("bind_commit").observe(
+                (now - tb) * 1e3)
             metrics.BIND_LATENCY_MS.observe((now - ts) * 1e3)
             metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
             metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
@@ -1713,8 +1790,9 @@ class Scheduler:
         """Degrade a failed coalesced batch to per-pod binds with the
         same in-place retry budget (volume binds are already committed
         and bind() re-entry no-ops on them) — one bad pod fails alone."""
-        for kube_pod, host, t0, ts in items:
-            if self._bind(kube_pod, host, t0, attempts=self.BIND_ATTEMPTS):
+        for kube_pod, host, t0, ts, parent in items:
+            if self._bind(kube_pod, host, t0, attempts=self.BIND_ATTEMPTS,
+                          parent=parent):
                 metrics.BIND_LATENCY_MS.observe(
                     (time.perf_counter() - ts) * 1e3)
         return []
@@ -1748,7 +1826,10 @@ class Scheduler:
         gang_prio = min(_pod_priority(m) for m in members)
         reserved = self.generic._nominated_chip_reservation(
             exclude=member_names, min_priority=gang_prio)
-        assignment = self.gang_planner.plan(members, reserved=reserved)
+        with obs.span("gang_plan", pod=kube_pod["metadata"]["name"],
+                      proc=self.obs_name, gang=gang, size=size) as sp:
+            assignment = self.gang_planner.plan(members, reserved=reserved)
+            sp.attrs["planned"] = assignment is not None
         if assignment is None:
             outcome = (self._try_gang_preempt(members, gang_prio, reserved)
                        if self.preemption_enabled else False)
@@ -1904,12 +1985,18 @@ class Scheduler:
         non-committed sibling's assume — zero leaked chips — and
         requeues."""
         committed: list = []
+        spans = {n: obs.start_span("bind_commit", pod=n,
+                                   proc=self.obs_name, node=node,
+                                   gang=gang)
+                 for n, node, _ in pinned_members}
         try:
             for name, _, _ in pinned_members:
                 if not self.volume_binder.bind(name):
                     raise RuntimeError(f"volume bind conflict for {name}")
             if binder is None:
-                self._gang_bind_write(pinned_members, attempts)
+                with obs.batch_context({n: sp.context()
+                                        for n, sp in spans.items()}):
+                    self._gang_bind_write(pinned_members, attempts)
                 committed = [n for n, _, _ in pinned_members]
             else:
                 for name, node_name, pinned in pinned_members:
@@ -1928,9 +2015,10 @@ class Scheduler:
                 self.cache.confirm_pod(name)
                 self._conflict_cleared(name)
                 self.queue.forget(name)
+                spans[name].finish(outcome="committed")
                 metrics.E2E_SCHEDULING_LATENCY.observe(
                     (time.perf_counter() - t0) * 1e6)
-        except Exception:
+        except Exception as err:
             # Release every assume EXCEPT members a delegated binder
             # already bound (they are placed; their charge must stand).
             # Committed volume binds stay (idempotent and harmless, see
@@ -1941,7 +2029,11 @@ class Scheduler:
                 if name in done:
                     self.cache.confirm_pod(name)
                     self.queue.forget(name)
+                    spans[name].finish(outcome="committed")
                     continue
+                spans[name].finish(
+                    outcome="failed",
+                    reason=f"{type(err).__name__}: {err}")
                 self.volume_binder.forget(name)
                 self.cache.forget_pod(pinned)
             if not done:
@@ -2216,7 +2308,7 @@ class Scheduler:
         return self.volume_binder.assume(kube_pod, snap.kube_node)
 
     def _bind(self, kube_pod: dict, host: str, t0: float,
-              attempts: int = 1) -> bool:
+              attempts: int = 1, parent=None) -> bool:
         """Volumes first (the kubelet must find claims bound when the pod
         lands), then annotation, then the binding — the kubelet-side hook
         must see allocate_from the moment the pod lands
@@ -2234,14 +2326,20 @@ class Scheduler:
                         "volume bind conflict; rescheduling")
             self.queue.add_unschedulable(kube_pod)
             return False
+        sp = obs.start_span("bind_commit", pod=name, parent=parent,
+                            proc=self.obs_name, node=host)
         try:
-            self._bind_write(name, kube_pod, host, attempts)
+            with obs.batch_context({name: sp.context()}):
+                self._bind_write(name, kube_pod, host, attempts)
         except Exception as err:
             from kubegpu_tpu.cluster.apiserver import Conflict
 
             if isinstance(err, Conflict):
+                sp.finish(outcome="conflict", reason=str(err))
                 self._conflict_requeue(kube_pod)
             else:
+                sp.finish(outcome="failed",
+                          reason=f"{type(err).__name__}: {err}")
                 self.cache.forget_pod(kube_pod)
                 self.queue.add_unschedulable(kube_pod)
             return False
@@ -2252,6 +2350,9 @@ class Scheduler:
         self._event(name, "Normal", "Scheduled",
                     f"Successfully assigned {name} to {host}")
         now = time.perf_counter()
+        sp.finish(outcome="committed")
+        metrics.SCHED_PHASE_MS.labels("bind_commit").observe(
+            (now - tb) * 1e3)
         metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
         metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
         return True

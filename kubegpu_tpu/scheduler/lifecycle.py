@@ -34,7 +34,7 @@ import logging
 import threading
 import time
 
-from kubegpu_tpu import metrics
+from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.cluster.apiserver import Conflict
 from kubegpu_tpu.core import codec
 from kubegpu_tpu.utils import list_bound_pods
@@ -308,6 +308,12 @@ class NodeLifecycle:
                 key = gang_key(pod)
                 if key is not None and key[0] in gang_ids:
                     victims.setdefault(pod["metadata"]["name"], pod)
+            # a node loss taking whole gangs down is exactly the class of
+            # incident the flight recorder exists for: dump the span ring
+            # (once per lost node) so the eviction ships with its timeline
+            obs.FLIGHT.trigger("gang_eviction", key=lost_node,
+                               gangs=sorted(gang_ids),
+                               victims=sorted(victims))
         evicted = []
         drained = True
         for name in sorted(victims):

@@ -14,6 +14,8 @@ import itertools
 import threading
 import time
 
+from kubegpu_tpu import metrics, obs
+
 INITIAL_BACKOFF_S = 1.0
 MAX_BACKOFF_S = 60.0
 
@@ -26,6 +28,10 @@ class SchedulingQueue:
         self._seq = itertools.count()
         self._unschedulable: dict = {}   # name -> (kube_pod, retry_at)
         self._backoff: dict = {}         # name -> current backoff seconds
+        self._enqueued: dict = {}        # name -> perf_counter() at admit
+        # span identity for queue_wait spans; the owning Scheduler
+        # overwrites this with its replica name
+        self.obs_name = "scheduler"
 
     @staticmethod
     def _priority(pod: dict) -> int:
@@ -34,6 +40,10 @@ class SchedulingQueue:
     def push(self, kube_pod: dict) -> None:
         with self._lock:
             name = kube_pod["metadata"]["name"]
+            if name not in self._enqueued:
+                # queue-wait measures admission -> pop, surviving the
+                # replace-in-place a watch update performs
+                self._enqueued[name] = time.perf_counter()
             if name in self._pods:
                 self._pods[name] = kube_pod
                 return
@@ -52,6 +62,15 @@ class SchedulingQueue:
                     _, _, name = heapq.heappop(self._heap)
                     pod = self._pods.pop(name, None)
                     if pod is not None:
+                        admitted = self._enqueued.pop(name, None)
+                        if admitted is not None:
+                            wait_s = time.perf_counter() - admitted
+                            metrics.SCHED_PHASE_MS.labels(
+                                "queue_wait").observe(wait_s * 1e3)
+                            obs.record_span(
+                                "queue_wait",
+                                obs.wall_now() - wait_s, wait_s,
+                                pod=name, proc=self.obs_name)
                         return pod
                 wait = 0.05
                 if deadline is not None:
@@ -70,6 +89,9 @@ class SchedulingQueue:
                           MAX_BACKOFF_S)
             self._backoff[name] = backoff
             self._unschedulable[name] = (kube_pod, time.monotonic() + backoff)
+            self._enqueued.setdefault(name, time.perf_counter())
+        obs.event("backoff_park", pod=name, proc=self.obs_name,
+                  backoff_s=round(backoff, 3))
 
     def park(self, kube_pod: dict, delay_s: float) -> None:
         """Park a pod for a fixed delay WITHOUT growing its
@@ -81,6 +103,7 @@ class SchedulingQueue:
             name = kube_pod["metadata"]["name"]
             self._unschedulable[name] = (kube_pod,
                                          time.monotonic() + delay_s)
+            self._enqueued.setdefault(name, time.perf_counter())
 
     def _admit_backed_off_locked(self) -> None:
         now = time.monotonic()
@@ -110,6 +133,7 @@ class SchedulingQueue:
             self._pods.pop(pod_name, None)
             self._unschedulable.pop(pod_name, None)
             self._backoff.pop(pod_name, None)
+            self._enqueued.pop(pod_name, None)
 
     def pending_count(self) -> int:
         with self._lock:

@@ -17,3 +17,14 @@ ATTEMPTS = Counter("scheduler_attempts")        # BAD: counter without _total
 LATENCY = Histogram("scheduler_bind_latency")   # BAD: histogram without unit
 DUPLICATE = Counter("scheduler_retries_total")
 DUPLICATE2 = Counter("scheduler_retries_total")  # BAD: name declared twice
+
+
+def reset_all():
+    # BAD: hand-enumerated and missing ATTEMPTS/LATENCY/DUPLICATE* —
+    # their values would leak across runs
+    EVICTIONS.value = 0
+
+
+def prometheus_text():
+    # BAD: exports only one of the declared metrics
+    return f"{EVICTIONS.name} {EVICTIONS.value}\n"

@@ -17,3 +17,18 @@ class Histogram:
 
 EVICTIONS_TOTAL = Counter("scheduler_evictions_total")
 BIND_LATENCY = Histogram("scheduler_bind_latency_microseconds")
+
+
+def all_metrics():
+    return [EVICTIONS_TOTAL, BIND_LATENCY]
+
+
+def reset_all():
+    # registry-driven: exhaustive by construction
+    for metric in all_metrics():
+        metric.__init__(metric.name)
+
+
+def prometheus_text():
+    return "\n".join(f"{m.name} {getattr(m, 'value', 0)}"
+                     for m in all_metrics())
