@@ -4,9 +4,14 @@ The scheduler's correctness story is concurrency discipline: schedule-time
 device accounting stays consistent across the advertiser, the scheduler,
 and the CRI hook, each moving on its own thread or process. This package
 encodes the invariants that keep that true as named, suppressible rules
-(`engine.py` + `rules/`), plus a *dynamic* lock-order harness
-(`lockgraph.py`, wired into pytest via `pytest_plugin.py`) that fails the
-suite on lock-order inversions observed while the tests run.
+(`engine.py` + `rules/`), plus two *dynamic* harnesses: the lock-order
+graph (`lockgraph.py`, wired into pytest via `pytest_plugin.py`) that
+fails the suite on lock-order inversions observed while the tests run,
+and the deterministic interleaving explorer (`explore.py` +
+`schedules.py`) that virtualizes the package's locks, condition waits,
+and clocks onto a cooperative scheduler, systematically enumerates
+thread schedules (bounded preemptions + sleep-set pruning), and replays
+any failing schedule exactly from its recorded decision trace.
 
 CLI::
 

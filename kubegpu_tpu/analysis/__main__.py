@@ -1,6 +1,10 @@
 """CLI: ``python -m kubegpu_tpu.analysis [paths...]``.
 
 Exit codes: 0 clean, 1 findings, 2 usage/parse error.
+
+``--format`` selects the output: ``text`` (default, human), ``json``
+(machine-readable list), or ``sarif`` (SARIF 2.1.0 — what CI uploads so
+findings annotate pull requests inline).
 """
 
 from __future__ import annotations
@@ -12,6 +16,66 @@ import sys
 
 from kubegpu_tpu.analysis.engine import (AnalysisError, all_rules,
                                          run_analysis)
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: list) -> dict:
+    """Findings as one SARIF 2.1.0 run. Paths are emitted as-is
+    (repo-relative when invoked from the repo root, which is what the
+    upload action expects)."""
+    rules = sorted({f.rule for f in findings})
+    by_rule = {name: i for i, name in enumerate(rules)}
+    descriptions = {r.name: r.description for r in all_rules()}
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "kubegpu-tpu-analysis",
+                "informationUri":
+                    "https://example.invalid/kubegpu-tpu#analysis",
+                "rules": [{
+                    "id": name,
+                    "shortDescription":
+                        {"text": descriptions.get(name, name)},
+                } for name in rules],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "ruleIndex": by_rule[f.rule],
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/"),
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {"startLine": f.line},
+                    },
+                }],
+            } for f in findings],
+        }],
+    }
+
+
+def render(findings: list, fmt: str) -> str:
+    if fmt == "json":
+        return json.dumps([f.to_json() for f in findings], indent=2)
+    if fmt == "sarif":
+        return json.dumps(to_sarif(findings), indent=2)
+    lines = [f.render() for f in findings]
+    if findings:
+        by_rule: dict = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(f"{n} {r}" for r, n in sorted(by_rule.items()))
+        lines.append(f"\n{len(findings)} finding(s): {summary}")
+    else:
+        lines.append("clean: no findings")
+    return "\n".join(lines)
 
 
 def main(argv: list | None = None) -> int:
@@ -26,8 +90,14 @@ def main(argv: list | None = None) -> int:
     parser.add_argument("--tests-dir", default=None,
                         help="tests directory for round-trip-test checks "
                              "(default: ./tests when it exists)")
+    parser.add_argument("--format", default="text", dest="fmt",
+                        choices=("text", "json", "sarif"),
+                        help="output format (sarif for CI annotation "
+                             "uploads)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="machine-readable findings")
+                        help="alias for --format json")
+    parser.add_argument("--output", default=None, metavar="FILE",
+                        help="write the report to FILE instead of stdout")
     parser.add_argument("--list-rules", action="store_true",
                         help="list rules and exit")
     args = parser.parse_args(argv)
@@ -44,6 +114,7 @@ def main(argv: list | None = None) -> int:
         tests_dir = "tests"
     select = [r.strip() for r in args.select.split(",")] \
         if args.select else None
+    fmt = "json" if args.as_json else args.fmt
 
     try:
         findings = run_analysis(paths, select=select, tests_dir=tests_dir)
@@ -51,19 +122,12 @@ def main(argv: list | None = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
-    if args.as_json:
-        print(json.dumps([f.to_json() for f in findings], indent=2))
+    report = render(findings, fmt)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(report + "\n")
     else:
-        for finding in findings:
-            print(finding.render())
-        if findings:
-            by_rule: dict = {}
-            for f in findings:
-                by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
-            summary = ", ".join(f"{n} {r}" for r, n in sorted(by_rule.items()))
-            print(f"\n{len(findings)} finding(s): {summary}")
-        else:
-            print("clean: no findings")
+        print(report)
     return 1 if findings else 0
 
 

@@ -17,7 +17,8 @@ import re
 import tokenize
 from typing import Iterable, Sequence
 
-# `# analysis: disable=rule-a,rule-b  -- free-text justification`
+# `# analysis: disable=<rule>[,<rule>...]  -- free-text justification`
+# (placeholders bracketed so this very comment cannot match the regex)
 SUPPRESS_RE = re.compile(
     r"#\s*analysis:\s*disable(?P<scope>-file)?="
     r"(?P<rules>[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)")
@@ -37,6 +38,29 @@ def dotted_name(node: ast.AST) -> str | None:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return None
+
+
+class Suppression:
+    """One ``# analysis: disable[-file]=...`` comment, with usage
+    tracking: the engine marks which rules it actually silenced so the
+    unused-suppression audit can flag the stale ones (ruff's
+    unused-noqa, applied to our own suppressions)."""
+
+    __slots__ = ("line", "rules", "file_scope", "used_rules")
+
+    def __init__(self, line: int, rules: set, file_scope: bool) -> None:
+        self.line = line
+        self.rules = frozenset(rules)
+        self.file_scope = file_scope
+        self.used_rules: set = set()
+
+    def matches(self, rule: str, line: int) -> bool:
+        if rule not in self.rules and "all" not in self.rules:
+            return False
+        if self.file_scope:
+            return True
+        # a trailing comment on the offending line, or one directly above
+        return line in (self.line, self.line + 1)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,8 +97,7 @@ class SourceFile:
             self.tree = ast.parse(text, filename=path)
         except SyntaxError as e:
             raise AnalysisError(f"{path}: syntax error: {e}") from e
-        self.line_suppressions: dict = {}
-        self.file_suppressions: set = set()
+        self.suppressions: list = []
         self._collect_suppressions()
 
     @property
@@ -92,33 +115,33 @@ class SourceFile:
                     continue
                 rules = {r.strip() for r in m.group("rules").split(",")
                          if r.strip()}
-                if m.group("scope"):
-                    self.file_suppressions |= rules
-                else:
-                    self.line_suppressions.setdefault(
-                        tok.start[0], set()).update(rules)
+                self.suppressions.append(Suppression(
+                    tok.start[0], rules, bool(m.group("scope"))))
         except tokenize.TokenError:
             pass  # the AST parsed; a trailing tokenize hiccup loses nothing
 
+    def match_suppression(self, rule: str, line: int):
+        """The :class:`Suppression` disabling ``rule`` at ``line`` (by a
+        trailing comment on the line itself, a comment on the line
+        directly above, or a file-wide ``disable-file``), or None."""
+        for sup in self.suppressions:
+            if sup.matches(rule, line):
+                return sup
+        return None
+
     def suppressed(self, rule: str, line: int) -> bool:
-        """True when ``rule`` is disabled at ``line`` — by a trailing
-        comment on the line itself, a comment on the line directly above,
-        or a file-wide ``disable-file``."""
-        if rule in self.file_suppressions or "all" in self.file_suppressions:
-            return True
-        for ln in (line, line - 1):
-            rules = self.line_suppressions.get(ln)
-            if rules and (rule in rules or "all" in rules):
-                return True
-        return False
+        return self.match_suppression(rule, line) is not None
 
 
 class Context:
-    """Cross-rule invocation context (project root, tests location)."""
+    """Cross-rule invocation context (project root, tests location, and
+    — for the unused-suppression audit — which rules ran)."""
 
     def __init__(self, root: str, tests_dir: str | None = None) -> None:
         self.root = root
         self.tests_dir = tests_dir
+        self.ran_rules: set = set()
+        self.known_rules: set = set()
 
 
 def _collect_files(root: str) -> list:
@@ -177,12 +200,20 @@ def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
     by_path = {s.path: s for s in sources}
     ctx = Context(root=os.path.abspath(roots[0]) if roots else os.getcwd(),
                   tests_dir=tests_dir)
+    ctx.known_rules = {r.name for r in all_rules()}
+    ctx.ran_rules = {r.name for r in rules}
+    # the audit must observe every other rule's suppression usage, so it
+    # always runs last regardless of registry order
+    rules = sorted(rules, key=lambda r: r.name == "unused-suppression")
     findings: list = []
     for rule in rules:
         for finding in rule.run(sources, ctx):
             src = by_path.get(finding.path)
-            if src is not None and src.suppressed(finding.rule, finding.line):
-                continue
+            if src is not None:
+                sup = src.match_suppression(finding.rule, finding.line)
+                if sup is not None:
+                    sup.used_rules.add(finding.rule)
+                    continue
             findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

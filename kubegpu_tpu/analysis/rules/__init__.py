@@ -3,20 +3,27 @@
 
 from __future__ import annotations
 
+from kubegpu_tpu.analysis.rules.charges import ChargePairing
 from kubegpu_tpu.analysis.rules.clocks import MonotonicTime
 from kubegpu_tpu.analysis.rules.codecs import CodecPairing
 from kubegpu_tpu.analysis.rules.exceptions import NoSwallowedExceptions
 from kubegpu_tpu.analysis.rules.locks import (LockDiscipline,
-                                              NoBlockingUnderLock)
+                                              NoBlockingUnderLock,
+                                              TransitiveLockDiscipline)
 from kubegpu_tpu.analysis.rules.metricsrule import MetricRegistration
+from kubegpu_tpu.analysis.rules.suppressions import UnusedSuppression
 
 ALL_RULES = [
     LockDiscipline(),
     NoBlockingUnderLock(),
+    TransitiveLockDiscipline(),
     MonotonicTime(),
     CodecPairing(),
     NoSwallowedExceptions(),
     MetricRegistration(),
+    ChargePairing(),
+    # always ordered last by the engine: it audits what the others used
+    UnusedSuppression(),
 ]
 
 __all__ = ["ALL_RULES"]

@@ -89,6 +89,17 @@ class BlockingCall:
     method: str
 
 
+@dataclasses.dataclass(frozen=True)
+class SelfCall:
+    """``self.X(...)`` — an intra-class call edge, with the lock set held
+    at the call site. The transitive rules walk these."""
+
+    callee: str
+    line: int
+    held: frozenset
+    method: str
+
+
 class _ClassLockInfo:
     """Per-class result of the region walk."""
 
@@ -97,6 +108,8 @@ class _ClassLockInfo:
         self.lock_attrs: set = set()
         self.accesses: list = []
         self.blocking: list = []
+        self.self_calls: list = []
+        self.methods: set = set()
 
 
 def _lock_attrs_of(cls: ast.ClassDef) -> set:
@@ -200,6 +213,11 @@ class _RegionWalker:
     def _walk_Call(self, node: ast.Call, held: frozenset) -> None:
         func = node.func
         if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                # self.X(...): an intra-class call edge (resolved against
+                # the class's real methods by the consuming rule)
+                self.info.self_calls.append(SelfCall(
+                    func.attr, node.lineno, held, self.method))
             recv_attr = _self_attr(func.value)
             if recv_attr is not None and func.attr in MUTATORS:
                 # self.attr.mutator(...): a write to the guarded container
@@ -227,8 +245,8 @@ class _RegionWalker:
     # -- blocking-call detection ---------------------------------------------
 
     def _check_blocking(self, node: ast.Call, held: frozenset) -> None:
-        if not held:
-            return
+        # recorded even with no lock held locally: the transitive rule
+        # checks helpers that run under a CALLER's lock
         dotted = dotted_name(node.func)
         if dotted is None:
             return
@@ -258,6 +276,7 @@ def analyze_classes(src: SourceFile) -> Iterator[_ClassLockInfo]:
         info.lock_attrs = lock_attrs
         for item in node.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods.add(item.name)
                 walker = _RegionWalker(info, item.name,
                                        in_init=item.name == "__init__")
                 walker.walk_body(item.body, frozenset())
@@ -299,6 +318,89 @@ class LockDiscipline:
                         f"caller already holds it")
 
 
+class TransitiveLockDiscipline:
+    """Call-graph-aware lock discipline, closing the one-hop blind spot
+    of the two flat rules above:
+
+    1. **``_locked`` contract enforcement** — a ``*_locked`` method
+       asserts "caller holds the lock". A call site that holds no class
+       lock, is not itself inside a ``*_locked`` method (or a helper
+       only ever reached from locked contexts), and is not ``__init__``
+       breaks that contract: the helper will mutate guarded state
+       unlocked.
+    2. **Transitive blocking-under-lock** — ``NoBlockingUnderLock``
+       only sees blocking calls lexically inside a ``with self._lock``
+       body. Here the under-lock region is propagated through same-class
+       ``self.helper()`` edges (a helper invoked under the lock runs
+       ENTIRELY under it, as does every ``*_locked`` method by
+       contract), so a ``time.sleep`` or HTTP round trip hidden one or
+       more hops down still flags.
+    """
+
+    name = "transitive-locks"
+    description = ("`_locked` helpers must be called with the lock held, "
+                   "and blocking calls are traced through helper calls "
+                   "made under a lock")
+
+    @staticmethod
+    def _under_lock_closure(info: "_ClassLockInfo") -> set:
+        """Methods whose bodies (sometimes) run with a class lock held:
+        ``*_locked`` by contract, plus every method reachable through
+        ``self.X()`` edges from a locked call site or a closure member.
+        ``__init__`` never joins (single-threaded by construction)."""
+        under: set = {m for m in info.methods
+                      if m.endswith("_locked") and m != "__init__"}
+        edges: dict = {}
+        for call in info.self_calls:
+            if call.callee not in info.methods or call.callee == "__init__":
+                continue
+            if call.held:
+                under.add(call.callee)
+            edges.setdefault(call.method, set()).add(call.callee)
+        work = sorted(under)
+        while work:
+            m = work.pop()
+            for callee in sorted(edges.get(m, ())):
+                if callee not in under:
+                    under.add(callee)
+                    work.append(callee)
+        return under
+
+    def run(self, sources: list, ctx: Context) -> Iterator[Finding]:
+        for src in sources:
+            for info in analyze_classes(src):
+                under = self._under_lock_closure(info)
+                # 1. _locked helpers called without the lock, from a
+                # method that itself never runs under it
+                for call in info.self_calls:
+                    if not call.callee.endswith("_locked") or \
+                            call.callee not in info.methods:
+                        continue
+                    if call.held or call.method == "__init__" or \
+                            call.method in under:
+                        continue
+                    yield Finding(
+                        self.name, src.path, call.line,
+                        f"{info.name}.{call.method}() calls "
+                        f"{call.callee}() without holding a class lock; "
+                        f"`*_locked` asserts the caller already holds it "
+                        f"— acquire the lock or rename the helper")
+                # 2. blocking calls inside methods that run under a lock
+                # even when the local held set is empty (the one-hop
+                # blind spot of no-blocking-under-lock)
+                for call in info.blocking:
+                    if call.held:
+                        continue  # the flat rule already reports these
+                    if call.method in under and call.method != "__init__":
+                        yield Finding(
+                            self.name, src.path, call.line,
+                            f"{info.name}.{call.method}() runs under a "
+                            f"class lock (reached via locked callers or "
+                            f"the `_locked` contract) but calls "
+                            f"{call.what}; move the blocking call out of "
+                            f"the locked call chain")
+
+
 class NoBlockingUnderLock:
     """No sleeps, subprocess spawns, HTTP round trips, or foreign waits
     inside a `with <lock>` body: the lock's other users stall for the
@@ -313,6 +415,8 @@ class NoBlockingUnderLock:
         for src in sources:
             for info in analyze_classes(src):
                 for call in info.blocking:
+                    if not call.held:
+                        continue  # transitive-locks owns the helper case
                     locks = ", ".join(
                         f"self.{name}" for name in sorted(call.held))
                     yield Finding(

@@ -148,8 +148,9 @@ class _EventLog:
 
     def _record(self, kind, event, obj):
         # self._wal is set once in __init__ and never reassigned — it is
-        # configuration, not guarded state; the WAL has its own lock
-        wal = self._wal  # analysis: disable=lock-discipline -- immutable after __init__
+        # configuration, not guarded state (and never written under the
+        # lock, so lock-discipline does not flag it)
+        wal = self._wal
         with self._lock:
             self._seq += 1
             seq = self._seq

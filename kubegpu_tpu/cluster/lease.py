@@ -46,6 +46,7 @@ import zlib
 from typing import Callable, FrozenSet, Optional
 
 from kubegpu_tpu import metrics, obs
+from kubegpu_tpu.analysis.explore import probe
 
 log = logging.getLogger(__name__)
 
@@ -79,6 +80,7 @@ class LeaseTable:
         """Grant/renew: the current holder always renews; anyone takes a
         vacant or expired lease (steal-on-expiry); an unexpired lease
         held by someone else is refused."""
+        probe("lease.acquire")
         with self._lock:
             now = time.monotonic()
             current = self._leases.get(name)
@@ -99,6 +101,7 @@ class LeaseTable:
         """Drop the lease iff ``holder`` still holds it — a clean
         shutdown hands the shard over immediately instead of making the
         successor wait out the TTL."""
+        probe("lease.release")
         with self._lock:
             current = self._leases.get(name)
             if current is None or current[0] != holder:

@@ -40,6 +40,7 @@ import zlib
 from typing import Any, BinaryIO, List, Optional, Tuple
 
 from kubegpu_tpu import metrics
+from kubegpu_tpu.analysis.explore import probe
 
 log = logging.getLogger(__name__)
 
@@ -113,6 +114,7 @@ class WriteAheadLog:
         plus fsync when enabled). Called by the event log BEFORE the
         event is served to any watcher — write-ahead, so anything a
         client saw is replayable."""
+        probe("wal.append")
         data = self._encode(seq, kind, event, obj)
         t0 = time.perf_counter()
         with self._lock:

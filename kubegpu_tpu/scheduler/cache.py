@@ -15,10 +15,12 @@ from __future__ import annotations
 import json
 import threading
 import time
+from typing import Any
 
 from kubegpu_tpu import metrics
+from kubegpu_tpu.analysis.explore import probe
 from kubegpu_tpu.core import codec
-from kubegpu_tpu.core.types import NodeInfo
+from kubegpu_tpu.core.types import NodeInfo, PodInfo
 from kubegpu_tpu.scheduler import interpod
 from kubegpu_tpu.scheduler.equivalence import EquivalenceCache
 from kubegpu_tpu.scheduler.predicates import (pod_core_requests,
@@ -34,7 +36,7 @@ class CacheCorruption(RuntimeError):
 
 
 class CachedNode:
-    def __init__(self, kube_node: dict):
+    def __init__(self, kube_node: dict) -> None:
         self.kube_node = kube_node
         self.fit_fingerprint: str = ""
         self.node_ex: NodeInfo = NodeInfo()
@@ -67,7 +69,7 @@ class NodeSnapshot:
     ``set_node``/``_charge_locked`` cannot tear a fit decision
     mid-evaluation."""
 
-    def __init__(self, cached: CachedNode):
+    def __init__(self, cached: CachedNode) -> None:
         self.name = cached.name
         self.node_ex = cached.node_ex.clone()
         self.requested_core = dict(cached.requested_core)
@@ -130,7 +132,7 @@ def _slim_node_copy(kube_node: dict) -> dict:
 
 
 class SchedulerCache:
-    def __init__(self, device_scheduler):
+    def __init__(self, device_scheduler: Any) -> None:
         self.device_scheduler = device_scheduler
         self._lock = threading.RLock()
         self.nodes: dict = {}           # name -> CachedNode
@@ -256,7 +258,7 @@ class SchedulerCache:
 
     # ---- pod conversion (`schedulercache/devices.go:14-45`) ----------------
 
-    def pod_info_for_node(self, kube_pod: dict, node_name: str):
+    def pod_info_for_node(self, kube_pod: dict, node_name: str) -> PodInfo:
         """Convert a kube pod for evaluation against one node, invalidating
         stale per-node state when the pod was customized for another node."""
         pod_info = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
@@ -349,6 +351,7 @@ class SchedulerCache:
         truth, and registering an assume here would make the eventual
         Conflict's forget release a charge this assume never made —
         subtracting our planned chips from under the winner's."""
+        probe("cache.assume_pod")
         with self._lock:
             name = kube_pod["metadata"]["name"]
             if name in self._charged and name not in self._assumed:
@@ -360,7 +363,7 @@ class SchedulerCache:
             deadline = (now if now is not None else time.monotonic()) + ASSUMED_POD_TTL_S
             self._assumed[name] = (node_name, deadline, kube_pod)
 
-    def snapshot_node(self, name: str):
+    def snapshot_node(self, name: str) -> "NodeSnapshot | None":
         """A PRIVATE ``NodeSnapshot`` for lock-free fit/score evaluation,
         or None. Always freshly built: callers (preemption simulation,
         nominated-demand charging) may mutate it freely."""
@@ -429,12 +432,14 @@ class SchedulerCache:
 
     def confirm_pod(self, pod_name: str) -> None:
         """Bind succeeded: the pod is no longer merely assumed."""
+        probe("cache.confirm_pod")
         with self._lock:
             self._assumed.pop(pod_name, None)
 
     def forget_pod(self, kube_pod: dict) -> None:
         """Bind failed: release the assumed resources
         (`scheduler.go:394-431`)."""
+        probe("cache.forget_pod")
         with self._lock:
             name = kube_pod["metadata"]["name"]
             entry = self._assumed.pop(name, None)
@@ -455,6 +460,7 @@ class SchedulerCache:
         optimistic charge and account the server's truth — otherwise
         this cache both leaks our phantom chips and treats the winner's
         chips as free forever."""
+        probe("cache.add_pod")
         with self._lock:
             name = kube_pod["metadata"]["name"]
             entry = self._assumed.get(name)
@@ -478,6 +484,7 @@ class SchedulerCache:
                 self.nodes[node_name].pod_names.add(name)
 
     def remove_pod(self, kube_pod: dict, node_name: str) -> None:
+        probe("cache.remove_pod")
         with self._lock:
             name = kube_pod["metadata"]["name"]
             self._assumed.pop(name, None)
@@ -498,6 +505,7 @@ class SchedulerCache:
     def expire_assumed(self, now: float | None = None) -> list:
         """Drop assumed pods whose bind never confirmed (TTL 30s,
         `cache.go:40-81`). Returns expired pod names."""
+        probe("cache.expire_assumed")
         with self._lock:
             now = now if now is not None else time.monotonic()
             expired = [n for n, (_, dl, _) in self._assumed.items() if dl <= now]

@@ -22,6 +22,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 from kubegpu_tpu import metrics, obs
+from kubegpu_tpu.analysis.explore import probe
 from kubegpu_tpu.core import codec, grammar
 from kubegpu_tpu.scheduler import factory, interpod, predicates, priorities
 from kubegpu_tpu.scheduler.cache import SchedulerCache
@@ -1587,6 +1588,7 @@ class Scheduler:
             # reason), so its binds never ride the worker pool
             self._bind(kube_pod, host, t0, parent=parent)
             return
+        probe("core.submit_bind")
         with self._spool_lock:
             self._bind_spool.append((kube_pod, host, t0,
                                      time.perf_counter(), parent))
@@ -1603,6 +1605,7 @@ class Scheduler:
         """Crash handler for the spool drainer: clear the draining flag
         (items already popped were requeued by the drainer's own
         handling) and re-arm if work remains."""
+        probe("core.spool_crashed")
         with self._spool_lock:
             self._spool_draining = bool(self._bind_spool)
             rearm = self._spool_draining
@@ -1625,6 +1628,7 @@ class Scheduler:
         state. A conflict STREAK means the replans keep losing (stale
         view, pathological contention): degrade to the exponential
         backoff so the pod cannot hot-loop against the arbiter."""
+        probe("core.conflict_requeue")
         name = kube_pod["metadata"]["name"]
         self.volume_binder.forget(name)
         self.cache.forget_pod(kube_pod)

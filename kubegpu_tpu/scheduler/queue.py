@@ -15,13 +15,14 @@ import threading
 import time
 
 from kubegpu_tpu import metrics, obs
+from kubegpu_tpu.analysis.explore import probe
 
 INITIAL_BACKOFF_S = 1.0
 MAX_BACKOFF_S = 60.0
 
 
 class SchedulingQueue:
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = threading.Condition()
         self._heap: list = []            # (-priority, seq, pod_name)
         self._pods: dict = {}            # name -> kube_pod
@@ -38,6 +39,7 @@ class SchedulingQueue:
         return int((pod.get("spec") or {}).get("priority") or 0)
 
     def push(self, kube_pod: dict) -> None:
+        probe("queue.push")
         with self._lock:
             name = kube_pod["metadata"]["name"]
             if name not in self._enqueued:
@@ -52,8 +54,9 @@ class SchedulingQueue:
                                         next(self._seq), name))
             self._lock.notify()
 
-    def pop(self, timeout: float | None = None):
+    def pop(self, timeout: float | None = None) -> dict | None:
         """Highest-priority pending pod, blocking up to ``timeout``."""
+        probe("queue.pop")
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
@@ -83,6 +86,7 @@ class SchedulingQueue:
     def add_unschedulable(self, kube_pod: dict) -> None:
         """Park a pod that found no node, with exponential backoff
         (`backoff_utils.go`)."""
+        probe("queue.add_unschedulable")
         with self._lock:
             name = kube_pod["metadata"]["name"]
             backoff = min(self._backoff.get(name, INITIAL_BACKOFF_S / 2) * 2,
@@ -129,6 +133,7 @@ class SchedulingQueue:
             self._lock.notify_all()
 
     def forget(self, pod_name: str) -> None:
+        probe("queue.forget")
         with self._lock:
             self._pods.pop(pod_name, None)
             self._unschedulable.pop(pod_name, None)

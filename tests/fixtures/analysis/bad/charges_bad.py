@@ -1,0 +1,28 @@
+"""charge-pairing BAD: assume_pod charges leak on two path classes."""
+
+
+class LeakyBinder:
+    def __init__(self, cache, api, log):
+        self.cache = cache
+        self.api = api
+        self.log = log
+
+    def _validate(self, pod):
+        return bool(pod.get("spec"))
+
+    def bind_with_leaky_refusal(self, pod, node):
+        self.cache.assume_pod(pod, node)
+        if not self._validate(pod):
+            return  # LEAK: the refusal path never forgets the charge
+        self.api.bind_pod(pod["metadata"]["name"], node)
+        self.cache.confirm_pod(pod["metadata"]["name"])
+
+    def bind_with_swallowing_handler(self, pod, node):
+        try:
+            self.cache.assume_pod(pod, node)
+            self.api.bind_pod(pod["metadata"]["name"], node)
+            self.cache.confirm_pod(pod["metadata"]["name"])
+        except Exception:
+            # LEAK: the exception edge neither forgets nor confirms —
+            # the charge rides the 30s TTL for every failed bind
+            self.log.warning("bind failed")

@@ -1,0 +1,15 @@
+"""unused-suppression BAD: waivers that silence nothing."""
+
+import time
+
+
+def healthy_deadline():
+    # this line uses a monotonic clock, so the waiver below is stale —
+    # whatever it once excused has been fixed
+    # analysis: disable=monotonic-time -- (stale) heartbeat stamp crosses processes
+    return time.monotonic() + 5.0
+
+
+def typoed_waiver():
+    # analysis: disable=monotonic-tmie -- typo'd rule name silences nothing
+    return time.monotonic()

@@ -1,0 +1,27 @@
+"""transitive-locks BAD: blocking one hop under a lock, and a `_locked`
+helper invoked without the lock."""
+
+import threading
+import time
+
+
+class SneakyBlocker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def drain(self):
+        with self._lock:
+            self._flush()  # the helper runs entirely under the lock...
+
+    def _flush(self):
+        time.sleep(0.1)  # ...and blocks, one hop out of the with-body
+        self._items.clear()
+
+    def restock(self):
+        # the `_locked` contract says the caller holds the lock; this
+        # caller does not (and is never reached from a locked context)
+        self._restock_locked()
+
+    def _restock_locked(self):
+        self._items.append(1)

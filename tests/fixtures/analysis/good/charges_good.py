@@ -1,0 +1,45 @@
+"""charge-pairing GOOD twin: every path resolves the assumed charge."""
+
+
+class PairedBinder:
+    def __init__(self, cache, api, log):
+        self.cache = cache
+        self.api = api
+        self.log = log
+
+    def _validate(self, pod):
+        return bool(pod.get("spec"))
+
+    def bind_with_leaky_refusal(self, pod, node):
+        self.cache.assume_pod(pod, node)
+        if not self._validate(pod):
+            self.cache.forget_pod(pod)  # the refusal releases the charge
+            return
+        self.api.bind_pod(pod["metadata"]["name"], node)
+        self.cache.confirm_pod(pod["metadata"]["name"])
+
+    def bind_with_swallowing_handler(self, pod, node):
+        try:
+            self.cache.assume_pod(pod, node)
+            self.api.bind_pod(pod["metadata"]["name"], node)
+            self.cache.confirm_pod(pod["metadata"]["name"])
+        except Exception:
+            self.log.warning("bind failed; releasing the charge")
+            self.cache.forget_pod(pod)
+
+    def bind_via_handoff(self, pod, node):
+        # handing the assumed pod to a worker whose commit path
+        # transitively confirms/forgets is the designed resolution
+        self.cache.assume_pod(pod, node)
+        self._spool(pod, node)
+
+    def _spool(self, pod, node):
+        self._commit(pod, node)
+
+    def _commit(self, pod, node):
+        try:
+            self.api.bind_pod(pod["metadata"]["name"], node)
+            self.cache.confirm_pod(pod["metadata"]["name"])
+        except Exception:
+            self.log.warning("commit failed; releasing the charge")
+            self.cache.forget_pod(pod)

@@ -1,0 +1,9 @@
+"""unused-suppression GOOD twin: the waiver still suppresses a real
+finding, so the audit leaves it alone."""
+
+import time
+
+
+def heartbeat_stamp():
+    # analysis: disable=monotonic-time -- wall-clock stamp crosses the process boundary by design
+    return time.time()

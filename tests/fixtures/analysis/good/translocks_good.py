@@ -1,0 +1,26 @@
+"""transitive-locks GOOD twin: blocking happens outside the locked call
+chain, and `_locked` helpers are called with the lock held."""
+
+import threading
+import time
+
+
+class PoliteBlocker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def drain(self):
+        with self._lock:
+            self._flush()
+        time.sleep(0.1)  # blocking after the lock is released is fine
+
+    def _flush(self):
+        self._items.clear()  # helper under the lock does no blocking
+
+    def restock(self):
+        with self._lock:
+            self._restock_locked()
+
+    def _restock_locked(self):
+        self._items.append(1)

@@ -3,6 +3,7 @@ and stays quiet on the good twin; the dynamic lock-order harness detects
 an intentional inversion; and the real tree is clean (the meta-test that
 makes the analyzer a gate instead of a toy)."""
 
+import json
 import os
 import subprocess
 import sys
@@ -19,8 +20,9 @@ BAD = os.path.join(FIXTURES, "bad")
 GOOD = os.path.join(FIXTURES, "good")
 TESTS_DIR = os.path.join(REPO, "tests")
 
-RULES = ["lock-discipline", "no-blocking-under-lock", "monotonic-time",
-         "codec-pairing", "no-swallowed-exceptions", "metric-registration"]
+RULES = ["lock-discipline", "no-blocking-under-lock", "transitive-locks",
+         "monotonic-time", "codec-pairing", "no-swallowed-exceptions",
+         "metric-registration", "charge-pairing", "unused-suppression"]
 
 
 # ---- static rules: bad fixtures flag, good twins pass ----------------------
@@ -87,6 +89,110 @@ def test_disable_file_scope(tmp_path):
 def test_unknown_rule_is_an_error():
     with pytest.raises(AnalysisError):
         run_analysis([GOOD], select=["not-a-rule"])
+
+
+# ---- the interprocedural rules ---------------------------------------------
+
+def test_charge_pairing_flags_both_leak_shapes():
+    hits = findings_for(BAD, "charge-pairing")
+    msgs = " ".join(f.message for f in hits)
+    assert "not paired" in msgs          # the early-return leak
+    assert "exception edge" in msgs      # the swallowing handler
+    assert len(hits) == 2
+
+
+def test_charge_pairing_follows_handoff_through_call_graph():
+    """The good twin resolves one charge two helper hops away — the
+    rule must treat the hand-off as resolution, not a leak."""
+    assert findings_for(GOOD, "charge-pairing") == []
+
+
+def test_transitive_locks_details():
+    hits = findings_for(BAD, "transitive-locks")
+    msgs = " ".join(f.message for f in hits)
+    assert "_restock_locked" in msgs     # _locked contract violation
+    assert "time.sleep" in msgs          # blocking one hop under a lock
+    assert len(hits) == 2
+
+
+def test_transitive_locks_accepts_locked_callers_of_locked_helpers(tmp_path):
+    """A helper reached only from locked contexts may call `_locked`
+    methods with an empty local held set — that is the exact blind spot
+    the call-graph propagation exists to tolerate."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self._helper()\n"
+        "    def _helper(self):\n"
+        "        self._touch_locked()\n"
+        "    def _touch_locked(self):\n"
+        "        pass\n")
+    assert run_analysis([str(src)], select=["transitive-locks"]) == []
+
+
+# ---- the suppression audit --------------------------------------------------
+
+def test_stale_suppression_is_a_finding_when_its_rule_runs():
+    hits = run_analysis([BAD], select=["monotonic-time",
+                                       "unused-suppression"],
+                        tests_dir=TESTS_DIR)
+    stale = [f for f in hits if f.rule == "unused-suppression"]
+    msgs = " ".join(f.message for f in stale)
+    assert "no longer suppresses anything" in msgs
+    assert "unknown rule" in msgs  # the typo'd waiver
+
+
+def test_suppression_for_unselected_rule_is_not_audited():
+    """`--select` without the waived rule collects no evidence — the
+    audit must stay silent rather than cry stale."""
+    hits = run_analysis([BAD], select=["unused-suppression"],
+                        tests_dir=TESTS_DIR)
+    assert all("unknown rule" in f.message for f in hits)
+
+
+def test_used_suppression_survives_the_audit():
+    hits = run_analysis([GOOD], select=["monotonic-time",
+                                        "unused-suppression"],
+                        tests_dir=TESTS_DIR)
+    assert hits == []
+
+
+# ---- output formats ---------------------------------------------------------
+
+def test_sarif_output_is_well_formed():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.analysis", "--format", "sarif",
+         os.path.join("tests", "fixtures", "analysis", "bad")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(RULES) <= rule_ids  # every rule fires on the bad tree
+    result = run["results"][0]
+    assert result["ruleId"] in rule_ids
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith(".py")
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_format_json_matches_legacy_json_flag():
+    argv = ["-m", "kubegpu_tpu.analysis",
+            os.path.join("tests", "fixtures", "analysis", "bad")]
+    a = subprocess.run([sys.executable] + argv + ["--format", "json"],
+                       cwd=REPO, capture_output=True, text=True, timeout=120)
+    b = subprocess.run([sys.executable] + argv + ["--json"],
+                       cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert a.stdout == b.stdout
+    findings = json.loads(a.stdout)
+    assert findings and {"rule", "path", "line", "message"} <= \
+        set(findings[0])
 
 
 # ---- the meta-test: the real tree is clean ---------------------------------

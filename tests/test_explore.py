@@ -1,0 +1,620 @@
+"""Deterministic interleaving explorer: the systematic-concurrency gate.
+
+Three layers of coverage:
+
+1. The explorer itself — determinism (same seed, identical exploration),
+   exact replay of a recorded failing schedule, sleep-set pruning,
+   deadlock detection, virtual time.
+2. Control-plane safety properties explored on the REAL code: chip-
+   accounting conservation in ``SchedulerCache``, arbiter exactly-once +
+   gang all-or-nothing in ``InMemoryAPIServer``, seq-exact watch
+   delivery in ``_EventLog``. These must pass EVERY schedule in budget.
+3. The PR 6 race twins — each historical race re-introduced as a
+   minimal mutant subclass ("fix mutated out"). The explorer must
+   REDISCOVER each race deterministically within a bounded schedule
+   budget; the unmutated class passes the identical scenario clean.
+   What took a 96-trial, ~1/8-flaky chaos stress to surface now takes a
+   few dozen deterministic schedules.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from kubegpu_tpu.analysis import explore as ex
+from kubegpu_tpu.analysis import schedules as sch
+from kubegpu_tpu.cluster.apiserver import Conflict, InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
+from kubegpu_tpu.scheduler.cache import SchedulerCache
+
+# Deep nightly exploration (KGTPU_EXPLORE_DEEP=1) widens every budget;
+# tier-1 keeps them small enough to stay fast while still exhausting the
+# scenarios below (they report `exhausted` well under these caps).
+DEEP = os.environ.get("KGTPU_EXPLORE_DEEP", "") not in ("", "0")
+BUDGET = 8000 if DEEP else 1000
+PREEMPTIONS = 3 if DEEP else 2
+
+CHIP = "alpha/grpresource/tpugrp1/0/tpugrp0/{t}/tpu/{cid}"
+
+
+def pinned_pod(name: str, node: str | None, chip_ids: list) -> dict:
+    """A pod whose device annotation pins exact chips — the wire shape a
+    scheduler replica's bind carries (same helper shape as test_ha)."""
+    pi = PodInfo(name=name)
+    cont = ContainerInfo()
+    for cid in chip_ids:
+        path = CHIP.format(t=0, cid=cid) + "/chips"
+        cont.allocate_from[path] = path
+    pi.running_containers["main"] = cont
+    meta: dict = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    pod = {"metadata": meta, "spec": {}}
+    if node:
+        pod["spec"]["nodeName"] = node
+    return pod
+
+
+def _ann(pod: dict) -> dict:
+    return pod["metadata"]["annotations"]
+
+
+def chip_prefix(cid: str) -> str:
+    """The (node-local) physical-chip key the claim indexes use."""
+    return grammar.chip_prefix_from_path(CHIP.format(t=0, cid=cid) + "/chips")
+
+
+class ChipLedger:
+    """Minimal device-scheduler stand-in that keeps per-node chip
+    accounting — the conservation invariant's measurement point."""
+
+    def __init__(self):
+        self.used: dict = {}  # node -> {chip prefix -> count}
+
+    def add_node(self, name, node_ex):
+        self.used.setdefault(name, {})
+
+    def remove_node(self, name):
+        self.used.pop(name, None)
+
+    def _chips(self, pod_info):
+        out = []
+        for cont in list(pod_info.init_containers.values()) + \
+                list(pod_info.running_containers.values()):
+            for path in cont.allocate_from.values():
+                prefix = grammar.chip_prefix_from_path(str(path))
+                if prefix is not None:
+                    out.append(prefix)
+        return out
+
+    def take_pod_resources(self, pod_info, node_ex):
+        counts = self.used.setdefault(node_ex.name, {})
+        for chip in self._chips(pod_info):
+            counts[chip] = counts.get(chip, 0) + 1
+
+    def return_pod_resources(self, pod_info, node_ex):
+        counts = self.used.setdefault(node_ex.name, {})
+        for chip in self._chips(pod_info):
+            counts[chip] = counts.get(chip, 0) - 1
+
+    def counts(self, node):
+        return {c: n for c, n in self.used.get(node, {}).items() if n != 0}
+
+
+def make_cache(cache_cls=SchedulerCache):
+    ledger = ChipLedger()
+    cache = cache_cls(ledger)
+    cache.set_node({"metadata": {"name": "n1"}})
+    return cache, ledger
+
+
+# ---- explorer mechanics -----------------------------------------------------
+
+
+def lost_update_scenario():
+    """The textbook race: unsynchronized read-modify-write with a probe
+    marking the gap."""
+    state = {"n": 0}
+
+    def inc():
+        v = state["n"]
+        ex.probe("between-read-and-write")
+        state["n"] = v + 1
+
+    def invariant():
+        assert state["n"] == 2, f"lost update: n={state['n']}"
+
+    return [inc, inc], invariant
+
+
+def test_explorer_finds_the_textbook_lost_update():
+    res = sch.explore(lost_update_scenario, max_schedules=50, seed=0)
+    assert res.failure is not None
+    assert res.failure.kind == "invariant"
+    assert "lost update" in res.failure.summary
+
+
+def test_same_seed_produces_identical_exploration():
+    a = sch.explore(lost_update_scenario, max_schedules=50, seed=3)
+    b = sch.explore(lost_update_scenario, max_schedules=50, seed=3)
+    assert a.signature() == b.signature()
+    assert a.failure.decisions == b.failure.decisions
+    assert a.schedules == b.schedules
+
+
+def test_recorded_trace_replays_to_the_same_failure():
+    res = sch.explore(lost_update_scenario, max_schedules=50, seed=0)
+    for _ in range(2):  # replay is itself deterministic
+        again = sch.replay(lost_update_scenario, res.failure)
+        assert again.summary == res.failure.summary
+        assert again.decisions == res.failure.decisions
+
+
+def test_failure_trace_serializes_and_replays_from_disk(tmp_path):
+    res = sch.explore(lost_update_scenario, max_schedules=50, seed=0)
+    path = tmp_path / "trace.json"
+    res.failure.dump(str(path))
+    loaded = sch.Failure.load(str(path))
+    assert loaded.decisions == res.failure.decisions
+    assert json.loads(path.read_text())["kind"] == "invariant"
+    again = sch.replay(lost_update_scenario, loaded)
+    assert again.summary == res.failure.summary
+
+
+def test_explore_archives_failing_trace_when_dir_configured(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("KGTPU_EXPLORE_TRACE_DIR", str(tmp_path))
+    sch.explore(lost_update_scenario, max_schedules=50, seed=5)
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 1 and \
+        files[0].startswith("lost_update_scenario-seed5-s"), files
+    loaded = sch.Failure.load(str(tmp_path / files[0]))
+    assert loaded.kind == "invariant"
+
+
+def test_locked_twin_is_clean_and_pruning_helps():
+    def guarded_scenario():
+        state = {"n": 0}
+        lock = ex.Lock()
+
+        def inc():
+            with lock:
+                v = state["n"]
+                ex.probe("in-region")
+                state["n"] = v + 1
+
+        def invariant():
+            assert state["n"] == 2
+
+        return [inc, inc], invariant
+
+    pruned = sch.explore(guarded_scenario, max_schedules=500, seed=0)
+    assert pruned.ok and pruned.exhausted
+    full = sch.explore(guarded_scenario, max_schedules=500, seed=0,
+                       prune=False)
+    assert full.ok
+    assert pruned.schedules - pruned.pruned <= full.schedules
+
+
+def test_deadlock_is_detected_with_trace():
+    def ab_ba_scenario():
+        a, b = ex.Lock(), ex.Lock()
+
+        def t1():
+            with a:
+                ex.probe("t1-holds-a")
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                ex.probe("t2-holds-b")
+                with a:
+                    pass
+
+        return [t1, t2], None
+
+    res = sch.explore(ab_ba_scenario, max_schedules=200, seed=0)
+    assert res.failure is not None and res.failure.kind == "deadlock"
+    assert "blocked" in res.failure.summary
+
+
+def test_foreign_real_thread_touch_is_rejected_loudly():
+    """A scenario that spawns a REAL OS thread which touches a
+    cooperative primitive mid-run would silently break the serialization
+    model — the primitive must reject it with ExploreError instead."""
+    import threading
+
+    errs = []
+
+    def scenario():
+        lock = ex.Lock()
+
+        def body():
+            def foreign():
+                try:
+                    with lock:
+                        pass
+                except ex.ExploreError as e:
+                    errs.append(e)
+
+            t = threading.Thread(target=foreign)
+            t.start()
+            t.join()
+
+        return [body], None
+
+    sch.explore(scenario, max_schedules=5, seed=0)
+    assert errs, "foreign real-thread touch was not rejected"
+    assert "cannot serialize" in str(errs[0])
+
+
+def test_virtual_time_drives_queue_pop_timeout_deterministically():
+    """``SchedulingQueue.pop`` polls a Condition with real-time
+    deadlines; under the explorer the clock is virtual, so a pop racing
+    a push explores deterministically and a starved pop times out
+    without wall-clock sleeping."""
+    from kubegpu_tpu.scheduler.queue import SchedulingQueue
+
+    def queue_scenario():
+        q = SchedulingQueue()
+        got = []
+
+        def popper():
+            got.append(q.pop(timeout=2.0))
+
+        def pusher():
+            q.push({"metadata": {"name": "p0"}, "spec": {}})
+
+        def invariant():
+            assert got and got[0] is not None, "push lost or pop starved"
+            assert got[0]["metadata"]["name"] == "p0"
+
+        return [popper, pusher], invariant
+
+    t0 = time.monotonic()
+    res = sch.explore(queue_scenario, max_schedules=BUDGET, seed=0)
+    assert res.ok, res.failure.render()
+    assert res.exhausted
+    # 2-second virtual timeouts explored in real milliseconds
+    assert time.monotonic() - t0 < 30.0
+
+
+# ---- PR 6 race twins: fix mutated out, explorer rediscovers -----------------
+
+
+class AssumeOnChargedCache(SchedulerCache):
+    """PR 6 fix mutated out: ``assume_pod`` registers an assume on a pod
+    already charged as bound (a competing replica's commit observed
+    mid-cycle), so the eventual conflict-forget releases a charge the
+    assume never made — the accounting race the chaos stress surfaced at
+    ~1/8 flake."""
+
+    def assume_pod(self, kube_pod, node_name, now=None):
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            # missing: `if name in self._charged and name not in
+            # self._assumed: return`
+            self._charge_locked(kube_pod, node_name, take=True)
+            node = self.nodes.get(node_name)
+            if node is not None:
+                node.pod_names.add(name)
+            deadline = (now if now is not None else time.monotonic()) + 30.0
+            self._assumed[name] = (node_name, deadline, kube_pod)
+
+
+class LostConflictCache(SchedulerCache):
+    """PR 6 fix mutated out: a bound-pod watch event for an assumed pod
+    is always treated as our own bind confirming — ignoring that the
+    winner's allocation may DIFFER (the lost-conflict-vs-watch-event
+    race: the cache keeps phantom chips and treats the winner's as
+    free)."""
+
+    def add_pod(self, kube_pod, node_name):
+        with self._lock:
+            name = kube_pod["metadata"]["name"]
+            if name in self._assumed:
+                self._assumed.pop(name)
+                if node_name in self.nodes:
+                    self.nodes[node_name].pod_names.add(name)
+                return  # missing: reconcile a DIFFERENT winning allocation
+            self._charge_locked(kube_pod, node_name, take=True)
+            if node_name in self.nodes:
+                self.nodes[node_name].pod_names.add(name)
+
+
+def _conservation_scenario(cache_cls):
+    """Our replica assumes pod "p" with chip 1.0.0; the arbiter's winner
+    bound "p" with chip 0.0.0 and its watch event races our cycle; the
+    conflict reply makes us forget. Safety: whatever the interleaving,
+    the cache accounting must converge to the server's truth — exactly
+    one charge, for the winner's chip."""
+
+    def scenario():
+        cache, ledger = make_cache(cache_cls)
+        winner = pinned_pod("p", "n1", ["0.0.0"])
+        ours = pinned_pod("p", None, ["1.0.0"])
+
+        def watch_event():
+            cache.add_pod(winner, "n1")
+
+        def our_cycle():
+            cache.assume_pod(ours, "n1")
+            cache.forget_pod(ours)  # the arbiter's Conflict reply
+
+        def invariant():
+            counts = ledger.counts("n1")
+            assert counts == {chip_prefix("0.0.0"): 1}, (
+                f"chip accounting corrupted: {counts} "
+                f"(server truth: exactly one charge for 0.0.0)")
+            assert all(n >= 0 for n in counts.values()), counts
+
+        return [watch_event, our_cycle], invariant
+
+    scenario.__name__ = f"conservation_{cache_cls.__name__}"
+    return scenario
+
+
+def test_explorer_rediscovers_assume_on_charged_race():
+    res = sch.explore(_conservation_scenario(AssumeOnChargedCache),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.failure is not None, (
+        f"mutant not found in {res.schedules} schedules")
+    assert "chip accounting corrupted" in res.failure.summary
+    # deterministic: the recorded schedule replays to the same failure
+    again = sch.replay(_conservation_scenario(AssumeOnChargedCache),
+                       res.failure)
+    assert again.summary == res.failure.summary
+
+
+def test_explorer_rediscovers_lost_conflict_vs_watch_event_race():
+    res = sch.explore(_conservation_scenario(LostConflictCache),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.failure is not None, (
+        f"mutant not found in {res.schedules} schedules")
+    assert "chip accounting corrupted" in res.failure.summary
+
+
+def test_unmutated_cache_passes_conservation_exploration_clean():
+    res = sch.explore(_conservation_scenario(SchedulerCache),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.ok, res.failure.render()
+    assert res.exhausted, (
+        f"budget too small to certify: {res.schedules} schedules run")
+
+
+class UnguardedAPIServer(InMemoryAPIServer):
+    """PR 6 fix mutated out: a bound pod's allocation annotations are
+    rewritable (no ``_allocation_guard_locked``), so a losing replica's
+    stale stamp silently swaps the pod's chips under the whole control
+    plane."""
+
+    def _allocation_guard_locked(self, name, new_ann):
+        return None
+
+
+def _annotation_rewrite_scenario(server_cls):
+    """Replica A binds "w" with chip 0.0.0; replica B's stale stamp
+    rewrites w's annotations to chip 1.0.0; replica C binds rival "r"
+    claiming chip 0.0.0. Safety: a bound pod's committed allocation is
+    immutable, and committed allocations never overlap."""
+
+    def scenario():
+        api = server_cls()
+        api.create_node({"metadata": {"name": "n1"}})
+        w = pinned_pod("w", None, ["0.0.0"])
+        stale = pinned_pod("w", None, ["1.0.0"])
+        r = pinned_pod("r", None, ["0.0.0"])
+        api.create_pod(w)
+        api.create_pod(r)
+        committed = {}
+
+        def replica_a():
+            try:
+                api.bind_many({"w": "n1"}, {"w": _ann(w)})
+                committed["w"] = _ann(w)
+            except Conflict:
+                pass  # the rival won the chip first: a legitimate loss
+
+        def replica_b():
+            try:
+                api.update_pod_annotations("w", _ann(stale))
+            except Conflict:
+                pass  # the guard held: expected once w is bound
+
+        def replica_c():
+            try:
+                api.bind_many({"r": "n1"}, {"r": _ann(r)})
+                committed["r"] = _ann(r)
+            except Conflict:
+                pass  # chip already claimed by w: expected
+
+        def invariant():
+            dev = codec.POD_ANNOTATION_KEY
+            assert committed, "arbiter refused every bind"
+            if "w" in committed:
+                # immutability: w's stored allocation is the one its
+                # bind committed, whenever the stale stamp landed
+                stored = api.get_pod("w")["metadata"]["annotations"]
+                assert stored.get(dev) == committed["w"].get(dev), (
+                    "bound pod's allocation annotations were rewritten")
+            if "r" in committed and "w" in committed:
+                # exactly-once: committed allocations never overlap
+                assert committed["r"].get(dev) != committed["w"].get(dev), (
+                    "chip committed twice across replicas")
+
+        return [replica_a, replica_b, replica_c], invariant
+
+    scenario.__name__ = f"annotation_rewrite_{server_cls.__name__}"
+    return scenario
+
+
+def test_explorer_rediscovers_bound_annotation_rewrite_race():
+    res = sch.explore(_annotation_rewrite_scenario(UnguardedAPIServer),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.failure is not None, (
+        f"mutant not found in {res.schedules} schedules")
+    assert "rewritten" in res.failure.summary
+
+
+def test_unmutated_apiserver_passes_rewrite_exploration_clean():
+    res = sch.explore(_annotation_rewrite_scenario(InMemoryAPIServer),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.ok, res.failure.render()
+    assert res.exhausted
+
+
+class MemberwiseBindAPIServer(InMemoryAPIServer):
+    """Gang atomicity mutated out: ``bind_many`` commits member by
+    member, releasing the arbiter lock between members — a racing rival
+    can split a gang."""
+
+    def bind_many(self, bindings, annotations):
+        for name in sorted(bindings):
+            if name in annotations:
+                self.update_pod_annotations(name, annotations[name])
+            self.bind_pod(name, bindings[name])
+
+
+def _gang_atomicity_scenario(server_cls):
+    def scenario():
+        api = server_cls()
+        api.create_node({"metadata": {"name": "n1"}})
+        g0 = pinned_pod("g0", None, ["0.0.0"])
+        g1 = pinned_pod("g1", None, ["1.0.0"])
+        rival = pinned_pod("rv", None, ["1.0.0"])  # collides with g1
+        for p in (g0, g1, rival):
+            api.create_pod(p)
+
+        def gang_bind():
+            try:
+                api.bind_many({"g0": "n1", "g1": "n1"},
+                              {"g0": _ann(g0), "g1": _ann(g1)})
+            except Conflict:
+                pass
+
+        def rival_bind():
+            try:
+                api.bind_many({"rv": "n1"}, {"rv": _ann(rival)})
+            except Conflict:
+                pass
+
+        def invariant():
+            bound = {n: bool((api.get_pod(n).get("spec") or {})
+                             .get("nodeName")) for n in ("g0", "g1")}
+            assert bound["g0"] == bound["g1"], (
+                f"gang split across the arbiter: {bound}")
+
+        return [gang_bind, rival_bind], invariant
+
+    scenario.__name__ = f"gang_atomicity_{server_cls.__name__}"
+    return scenario
+
+
+def test_explorer_finds_gang_split_when_atomicity_mutated_out():
+    res = sch.explore(_gang_atomicity_scenario(MemberwiseBindAPIServer),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.failure is not None, (
+        f"mutant not found in {res.schedules} schedules")
+    assert "gang split" in res.failure.summary
+
+
+def test_unmutated_arbiter_keeps_gangs_atomic_across_schedules():
+    res = sch.explore(_gang_atomicity_scenario(InMemoryAPIServer),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.ok, res.failure.render()
+    assert res.exhausted
+
+
+# ---- seq-exact watch delivery ----------------------------------------------
+
+
+def test_watch_log_is_seq_exact_under_interleaved_mutations():
+    """Two mutators race a resuming watch consumer through `_EventLog`:
+    in every schedule the consumer must see strictly increasing
+    sequence numbers with no gaps below its cursor and end with every
+    object delivered."""
+    from kubegpu_tpu.cluster.httpapi import _EventLog
+
+    def scenario():
+        api = InMemoryAPIServer()
+        log = _EventLog(api)
+        seen: list = []
+
+        def writer_a():
+            api.create_pod({"metadata": {"name": "a"}, "spec": {}})
+            api.create_pod({"metadata": {"name": "b"}, "spec": {}})
+
+        def writer_b():
+            api.create_pod({"metadata": {"name": "c"}, "spec": {}})
+
+        def consumer():
+            cursor = 0
+            for _ in range(12):
+                events, latest, _folded, relist = log.since(
+                    cursor, timeout=0.1, batch_s=0.0)
+                assert not relist
+                for seq, _kind, _event, obj in events:
+                    assert seq > cursor, (
+                        f"seq {seq} at or below cursor {cursor}")
+                    seen.append((seq, obj["metadata"]["name"]))
+                assert latest >= cursor
+                cursor = latest
+                if cursor >= 3:
+                    return
+
+        def invariant():
+            seqs = [s for s, _ in seen]
+            assert seqs == sorted(set(seqs)), f"dup/regressed seq: {seqs}"
+            assert {n for _, n in seen} == {"a", "b", "c"}, seen
+
+        return [writer_a, writer_b, consumer], invariant
+
+    res = sch.explore(scenario, max_schedules=BUDGET,
+                      preemption_bound=1, seed=0)
+    assert res.ok, res.failure.render()
+
+
+# ---- exploration budget sanity ---------------------------------------------
+
+
+def test_mutants_found_within_small_deterministic_budget():
+    """The acceptance bound: each PR 6 race twin is rediscovered within
+    a fixed, seed-stable schedule budget — this is what lets the tier-1
+    gate hold these races down deterministically."""
+    for scenario, needle in (
+            (_conservation_scenario(AssumeOnChargedCache),
+             "chip accounting corrupted"),
+            (_conservation_scenario(LostConflictCache),
+             "chip accounting corrupted"),
+            (_annotation_rewrite_scenario(UnguardedAPIServer),
+             "rewritten")):
+        res = sch.explore(scenario, max_schedules=200,
+                          preemption_bound=2, seed=0)
+        assert res.failure is not None, scenario.__name__
+        assert needle in res.failure.summary
+        assert res.failure.schedule_index < 200
+
+
+@pytest.mark.slow
+def test_deep_exploration_of_clean_scenarios():
+    """The nightly-budget sweep: every clean scenario explored with the
+    deep budget and a wider preemption bound."""
+    for scenario in (
+            _conservation_scenario(SchedulerCache),
+            _annotation_rewrite_scenario(InMemoryAPIServer),
+            _gang_atomicity_scenario(InMemoryAPIServer)):
+        res = sch.explore(scenario, max_schedules=8000,
+                          preemption_bound=3, seed=0)
+        assert res.ok, f"{scenario.__name__}: {res.failure.render()}"

@@ -5,6 +5,7 @@ stealing, and the scheduler-kill + apiserver-restart chaos scenario."""
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import pytest
@@ -406,8 +407,7 @@ def test_binder_conflict_forgets_and_requeues_not_retries():
         sched.stop()
 
 
-@pytest.mark.chaos
-def test_two_replicas_converge_zero_leaks_zero_double_binds():
+def _two_replicas_converge_once():
     """2 replicas with NO shard filter — every pod deliberately raced —
     must converge to each pod placed exactly once with globally disjoint
     chips (the apiserver arbiter is the only thing preventing
@@ -452,6 +452,30 @@ def test_two_replicas_converge_zero_leaks_zero_double_binds():
     finally:
         s0.stop()
         s1.stop()
+
+
+@pytest.mark.chaos
+def test_two_replicas_converge_zero_leaks_zero_double_binds():
+    """ONE smoke trial stays in tier-1. The races this stress once
+    hunted probabilistically (~1/8 flake over 96+ trials) now have
+    deterministic explorer twins in test_explore.py — the multi-trial
+    sweep below is demoted to `-m slow` (nightly)."""
+    _two_replicas_converge_once()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_two_replicas_converge_probabilistic_stress():
+    """The original probabilistic hunt, kept as a nightly safety net
+    for interleavings outside the explorer's modeled sync points.
+    KGTPU_STRESS_TRIALS overrides the trial count."""
+    trials = int(os.environ.get("KGTPU_STRESS_TRIALS", "96"))
+    for trial in range(trials):
+        try:
+            _two_replicas_converge_once()
+        except AssertionError as err:
+            raise AssertionError(
+                f"trial {trial + 1}/{trials}: {err}") from err
 
 
 @pytest.mark.chaos
