@@ -1,13 +1,16 @@
-"""codec-pairing: every annotation encoder has a decoder and a round trip.
+"""codec-pairing: every codec encoder has a decoder and a round trip.
 
-The annotation codec IS the wire protocol between the advertiser, the
-scheduler, and the CRI hook (``core/codec.py``). An encoder without a
+The codecs ARE the wire protocol (``core/codec.py``): annotations
+between the advertiser, the scheduler, and the CRI hook, and the binary
+records the streaming transport frames carry. An encoder without a
 decoder is a write nobody can read back — state that silently falls out
-of the checkpoint/restore story (the API server is the only checkpoint).
-The repo's naming convention pairs ``<thing>_to_annotation`` with
-``annotation_to_<thing>``; this rule enforces the pairing both ways and,
-when a tests directory is available, requires both names to appear in the
-codec round-trip tests (``test_codec*.py``).
+of the checkpoint/restore story (the API server is the only checkpoint)
+or frames nobody can parse. Two naming conventions are enforced, each
+both ways, and — when a tests directory is available — both halves of
+every pair must appear in the codec round-trip tests (``test_codec*.py``):
+
+* annotation codecs: ``<thing>_to_annotation`` / ``annotation_to_<thing>``
+* binary wire codecs: ``encode_<record>`` / ``decode_<record>``
 """
 
 from __future__ import annotations
@@ -20,60 +23,69 @@ from typing import Iterator
 
 from kubegpu_tpu.analysis.engine import Context, Finding
 
-_ENCODE_RE = re.compile(r"^(?P<stem>\w+)_to_annotation$")
-_DECODE_RE = re.compile(r"^annotation_to_(?P<stem>\w+)$")
+# (encoder pattern, decoder pattern, decoder name template, encoder
+# name template) per convention
+_CONVENTIONS = (
+    (re.compile(r"^(?P<stem>\w+)_to_annotation$"),
+     re.compile(r"^annotation_to_(?P<stem>\w+)$"),
+     "annotation_to_{stem}", "{stem}_to_annotation"),
+    (re.compile(r"^encode_(?P<stem>\w+)$"),
+     re.compile(r"^decode_(?P<stem>\w+)$"),
+     "decode_{stem}", "encode_{stem}"),
+)
 
 
 class CodecPairing:
     name = "codec-pairing"
-    description = ("every `<x>_to_annotation` encoder needs an "
-                   "`annotation_to_<x>` decoder, and both must appear in a "
-                   "round-trip test")
+    description = ("every `<x>_to_annotation`/`encode_<x>` encoder needs "
+                   "an `annotation_to_<x>`/`decode_<x>` decoder, and both "
+                   "must appear in a round-trip test")
 
     def run(self, sources: list, ctx: Context) -> Iterator[Finding]:
         for src in sources:
             if src.name != "codec.py":
                 continue
-            encoders: dict = {}
-            decoders: dict = {}
-            for node in src.tree.body:
-                if not isinstance(node, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
-                m = _ENCODE_RE.match(node.name)
-                if m:
-                    encoders[m.group("stem")] = node
-                m = _DECODE_RE.match(node.name)
-                if m:
-                    decoders[m.group("stem")] = node
             test_idents = _codec_test_identifiers(ctx)
-            for stem in sorted(encoders):
-                node = encoders[stem]
-                if stem not in decoders:
-                    yield Finding(
-                        self.name, src.path, node.lineno,
-                        f"encoder `{node.name}` has no decoder "
-                        f"`annotation_to_{stem}` — annotation state that "
-                        f"cannot be read back falls out of the API-server "
-                        f"checkpoint")
-            for stem in sorted(decoders):
-                node = decoders[stem]
-                if stem not in encoders:
-                    yield Finding(
-                        self.name, src.path, node.lineno,
-                        f"decoder `{node.name}` has no encoder "
-                        f"`{stem}_to_annotation` — nothing produces what "
-                        f"this reads")
-            if test_idents is None:
-                continue  # no tests tree in scope: pairing check only
-            for stem in sorted(set(encoders) & set(decoders)):
-                for node in (encoders[stem], decoders[stem]):
-                    if node.name not in test_idents:
+            for enc_re, dec_re, dec_tpl, enc_tpl in _CONVENTIONS:
+                encoders: dict = {}
+                decoders: dict = {}
+                for node in src.tree.body:
+                    if not isinstance(node, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    m = enc_re.match(node.name)
+                    if m:
+                        encoders[m.group("stem")] = node
+                    m = dec_re.match(node.name)
+                    if m:
+                        decoders[m.group("stem")] = node
+                for stem in sorted(encoders):
+                    node = encoders[stem]
+                    if stem not in decoders:
                         yield Finding(
                             self.name, src.path, node.lineno,
-                            f"`{node.name}` never appears in the codec "
-                            f"round-trip tests (test_codec*.py) — an "
-                            f"untested codec pair drifts")
+                            f"encoder `{node.name}` has no decoder "
+                            f"`{dec_tpl.format(stem=stem)}` — state that "
+                            f"cannot be read back falls out of the wire/"
+                            f"checkpoint story")
+                for stem in sorted(decoders):
+                    node = decoders[stem]
+                    if stem not in encoders:
+                        yield Finding(
+                            self.name, src.path, node.lineno,
+                            f"decoder `{node.name}` has no encoder "
+                            f"`{enc_tpl.format(stem=stem)}` — nothing "
+                            f"produces what this reads")
+                if test_idents is None:
+                    continue  # no tests tree in scope: pairing check only
+                for stem in sorted(set(encoders) & set(decoders)):
+                    for node in (encoders[stem], decoders[stem]):
+                        if node.name not in test_idents:
+                            yield Finding(
+                                self.name, src.path, node.lineno,
+                                f"`{node.name}` never appears in the codec "
+                                f"round-trip tests (test_codec*.py) — an "
+                                f"untested codec pair drifts")
 
 
 def _codec_test_identifiers(ctx: Context) -> set | None:

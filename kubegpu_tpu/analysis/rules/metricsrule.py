@@ -26,7 +26,7 @@ from kubegpu_tpu.analysis.engine import (Context, Finding, SourceFile,
                                          dotted_name)
 
 _METRIC_TYPES = frozenset({"Counter", "Gauge", "Histogram",
-                           "LabeledHistogram"})
+                           "LabeledHistogram", "LabeledCounter"})
 _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _HISTOGRAM_UNITS = ("_microseconds", "_milliseconds", "_seconds", "_us",
                     "_ms", "_bytes", "_total")
@@ -148,7 +148,8 @@ class MetricRegistration:
                     self.name, src.path, node.lineno,
                     f"metric name `{metric_name}` is not snake_case")
                 continue
-            if kind == "Counter" and not metric_name.endswith("_total"):
+            if kind in ("Counter", "LabeledCounter") and \
+                    not metric_name.endswith("_total"):
                 yield Finding(
                     self.name, src.path, node.lineno,
                     f"counter `{metric_name}` must end in `_total`")

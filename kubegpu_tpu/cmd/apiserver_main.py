@@ -26,6 +26,12 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8070)
+    parser.add_argument("--wire", choices=("stream", "json"),
+                        default="stream",
+                        help="stream (default) also serves the framed "
+                             "binary wire behind an Upgrade handshake; "
+                             "json refuses upgrades, so every client "
+                             "negotiates down to JSON long-poll HTTP")
     parser.add_argument("--wal-dir", default=None,
                         help="directory for the write-ahead log + "
                              "snapshot; restart recovers state and the "
@@ -44,8 +50,9 @@ def main(argv=None) -> int:
 
         wal = WriteAheadLog(args.wal_dir, fsync=not args.wal_no_fsync,
                             snapshot_every=args.wal_snapshot_every)
-    server, url = serve_api(api, args.host, args.port, wal=wal)
-    print(f"apiserver listening at {url}"
+    server, url = serve_api(api, args.host, args.port, wal=wal,
+                            stream_wire=args.wire == "stream")
+    print(f"apiserver listening at {url} (wire: {args.wire}+json)"
           + (f" (WAL at {args.wal_dir})" if wal else ""), flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -75,6 +75,12 @@ def prometheus_text() -> str:
         elif isinstance(m, metrics.Histogram):
             lines.append(f"# TYPE {m.name} histogram")
             lines.extend(_histogram_lines(m))
+        elif isinstance(m, metrics.LabeledCounter):
+            lines.append(f"# TYPE {m.name} counter")
+            for values, child in m.children():
+                rendered = ",".join(
+                    f'{k}="{v}"' for k, v in zip(m.label_names, values))
+                lines.append(f"{m.name}{{{rendered}}} {child.value}")
         elif isinstance(m, metrics.Counter):
             lines.append(f"# TYPE {m.name} counter")
             lines.append(f"{m.name} {m.value}")

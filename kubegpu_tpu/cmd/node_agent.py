@@ -35,6 +35,10 @@ def build_manager(backend_kind: str, sysfs_root: str,
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--api", default="http://127.0.0.1:8070")
+    parser.add_argument("--wire", choices=("stream", "json"),
+                        default="stream",
+                        help="control-plane wire (stream negotiates down "
+                             "to json against an older apiserver)")
     parser.add_argument("--node-name", default=None,
                         help="defaults to the hostname, like kubelet")
     parser.add_argument("--node-address", default=None,
@@ -69,7 +73,7 @@ def main(argv=None) -> int:
                         "sysfs_root", "cri_socket", "cri_port"])
 
     node_name = args.node_name or socket.gethostname()
-    client = HTTPAPIClient(args.api)
+    client = HTTPAPIClient(args.api, wire=args.wire)
     if args.register_node:
         try:
             client.get_node(node_name)

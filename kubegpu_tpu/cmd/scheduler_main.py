@@ -111,6 +111,12 @@ def main(argv=None) -> int:
     sys.setswitchinterval(0.0005)
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--api", default="http://127.0.0.1:8070")
+    parser.add_argument("--wire", choices=("stream", "json"),
+                        default="stream",
+                        help="control-plane wire: framed binary streams "
+                             "with server-pushed watch deltas (default) "
+                             "or JSON long-poll HTTP; stream negotiates "
+                             "down to json against an older apiserver")
     parser.add_argument("--parallelism", type=int, default=16)
     parser.add_argument("--bind-async", action="store_true",
                         help="pipelined binder: the scheduling cycle "
@@ -158,7 +164,8 @@ def main(argv=None) -> int:
                         help="JSON/YAML file; explicit flags win")
     args = parser.parse_args(argv)
     config = common.load_config(args.config)
-    common.merge_flags(args, config, ["api", "parallelism", "lease_ttl",
+    common.merge_flags(args, config, ["api", "wire", "parallelism",
+                                      "lease_ttl",
                                       "node_grace_s", "node_stale_s",
                                       "bind_workers", "watch_batch_ms",
                                       "replicas", "shard"])
@@ -167,7 +174,8 @@ def main(argv=None) -> int:
     # only, so Event records never pay encode/decode on this stream
     client = HTTPAPIClient(args.api,
                            watch_batch_s=args.watch_batch_ms / 1e3,
-                           watch_kinds=("node", "pod", "pv", "pvc"))
+                           watch_kinds=("node", "pod", "pv", "pvc"),
+                           wire=args.wire)
     holder = f"{os.uname().nodename}-{os.getpid()}"
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -65,14 +65,25 @@ def _fit_cache_summary() -> dict:
 
 
 def _data_plane_summary() -> dict:
-    """Binder-pipeline and watch-batching health (metrics.py): bind
-    latency p50/count, live binder depth, last watch batch size, and
-    events the server coalesced away before delivery."""
+    """Binder-pipeline, watch-batching, and wire-transport health
+    (metrics.py): bind latency p50/count, live binder depth, last watch
+    batch size, events the server coalesced away before delivery, bytes
+    per wire+direction, frame codec cost, and stream-push lag."""
     return {"bind_p50_ms": round(metrics.BIND_LATENCY_MS.percentile(0.5), 3),
             "bind_count": metrics.BIND_LATENCY_MS.n,
             "bind_inflight": metrics.BIND_INFLIGHT.value,
             "watch_batch_size": metrics.WATCH_BATCH_SIZE.value,
-            "watch_coalesced_total": metrics.WATCH_COALESCED.value}
+            "watch_coalesced_total": metrics.WATCH_COALESCED.value,
+            "transport_bytes_total": {
+                f"{wire}_{direction}": child.value
+                for (wire, direction), child
+                in metrics.TRANSPORT_BYTES.children()},
+            "frame_encode_p50_ms": round(
+                metrics.FRAME_ENCODE_MS.percentile(0.5), 4),
+            "frame_decode_p50_ms": round(
+                metrics.FRAME_DECODE_MS.percentile(0.5), 4),
+            "watch_push_lag_p50_ms": round(
+                metrics.WATCH_PUSH_LAG_MS.percentile(0.5), 4)}
 
 
 def _ha_summary() -> dict:
@@ -222,7 +233,8 @@ def _bound_chips(api, names):
 def run_ha_chaos_scenario(pods_before: int = 6, pods_mid: int = 3,
                           pods_after: int = 3, wal_dir: str | None = None,
                           lease_ttl_s: float = 0.6,
-                          deadline_s: float = 30.0):
+                          deadline_s: float = 30.0,
+                          wire: str = "stream"):
     """The HA control-plane chaos scenario: 2 optimistic scheduler
     replicas (shard leases + work stealing) over a WAL-backed HTTP
     apiserver. Mid-stream, replica 0 is killed — replica 1 must steal
@@ -245,7 +257,7 @@ def run_ha_chaos_scenario(pods_before: int = 6, pods_mid: int = 3,
     wal = WriteAheadLog(tmp, fsync=False, snapshot_every=40)
     server, url = serve_api(api, wal=wal)
     port = int(url.rsplit(":", 1)[1])
-    admin = HTTPAPIClient(url)
+    admin = HTTPAPIClient(url, wire=wire)
     replicas = []
     submitted: list = []
     try:
@@ -264,7 +276,8 @@ def run_ha_chaos_scenario(pods_before: int = 6, pods_mid: int = 3,
 
         def start_replica(shard):
             client = HTTPAPIClient(url, watch_batch_s=0.002,
-                                   watch_kinds=("node", "pod", "pv", "pvc"))
+                                   watch_kinds=("node", "pod", "pv", "pvc"),
+                                   wire=wire)
             coord = ShardCoordinator(client, shard, 2, f"replica-{shard}",
                                      ttl_s=lease_ttl_s)
             ds = DevicesScheduler()
@@ -406,6 +419,11 @@ def main(argv=None) -> int:
                              "replicas")
     parser.add_argument("--seed", type=int, default=0,
                         help="chaos transport seed")
+    parser.add_argument("--wire", choices=("stream", "json"),
+                        default="stream",
+                        help="control-plane wire for the HTTP scenarios "
+                             "(--chaos-ha): framed binary streams "
+                             "(default) or JSON long-poll")
     parser.add_argument("--trace-out", default=None,
                         help="write the run's span ring as Chrome "
                              "trace-event JSON (open in Perfetto); "
@@ -435,7 +453,8 @@ def main(argv=None) -> int:
         return 0
 
     if args.chaos_ha:
-        result = run_ha_chaos_scenario()
+        result = run_ha_chaos_scenario(wire=args.wire)
+        result["wire_protocol"] = args.wire
         dump_trace()
         if args.json:
             print(json.dumps(result, indent=2))

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import math
 import re
+import struct
 
 from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
 
@@ -46,6 +47,7 @@ _QUANTITY_SUFFIXES = {
     "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
 }
 _QUANTITY_RE = re.compile(r"^([+-]?[0-9.eE+-]+?)([A-Za-z]*)$")
+_F64 = struct.Struct("<d")
 
 
 def parse_quantity(val: "int | float | str") -> int:
@@ -180,6 +182,356 @@ def _merge_kube_containers(
         for info in containers.values():
             info.allocate_from = {}
             info.dev_requests = dict(info.requests)
+
+
+# ---- binary wire codec ------------------------------------------------------
+# The compact encoding the streaming transport (cluster/stream.py) frames
+# carry: a tagged value format for the JSON-shaped control-plane records
+# (pods, node snapshots, watch deltas, requests/responses) with string
+# interning. Two interning layers:
+#
+#   * a STATIC table of protocol constants (object keys, verbs, event
+#     types, the annotation keys) shared by both ends — these never cost
+#     more than a 1-2 byte reference on the wire;
+#   * a DYNAMIC per-frame table: the first occurrence of any other
+#     string inside one frame is sent inline and assigned the next id,
+#     every repeat is a reference. Pod/node/class names repeat heavily
+#     inside a coalesced watch batch or a bind_many body, which is where
+#     the bytes are.
+#
+# The dynamic table is scoped to ONE frame on purpose: every frame
+# decodes standalone, so the server can encode a watch batch once and
+# fan the identical bytes out to every subscriber regardless of when
+# each subscribed, and a reconnect never has interner state to resync.
+
+_T_NONE = 0x00
+_T_TRUE = 0x01
+_T_FALSE = 0x02
+_T_INT = 0x03
+_T_FLOAT = 0x04
+_T_STR_NEW = 0x05   # inline utf-8, registers the next dynamic id
+_T_STR_REF = 0x06   # varint reference into static+dynamic table
+_T_LIST = 0x07
+_T_DICT = 0x08
+
+_STATIC_STRINGS: "tuple[str, ...]" = (
+    # object shape
+    "metadata", "name", "annotations", "labels", "spec", "status",
+    "nodeName", "containers", "initContainers", "resources", "requests",
+    "allocatable", "capacity", "cpu", "pods", "priority", "phase",
+    "volumes", "persistentVolumeClaim", "claimName", "volumeName",
+    "storageClassName", "nodeAffinity",
+    # annotation keys (the hot per-record payloads)
+    NODE_ANNOTATION_KEY, POD_ANNOTATION_KEY, NODE_ADDRESS_ANNOTATION,
+    NODE_HEARTBEAT_ANNOTATION, NODE_CHIP_HEALTH_ANNOTATION,
+    # verbs + routes
+    "GET", "POST", "PUT", "PATCH", "DELETE",
+    # watch stream
+    "node", "pod", "pv", "pvc", "added", "modified", "deleted",
+    "events", "seq", "coalesced", "relist", "epoch", "items",
+    # error detail
+    "error", "per_pod", "bindings", "holder", "ttl",
+)
+_STATIC_INDEX = {s: i for i, s in enumerate(_STATIC_STRINGS)}
+
+
+class CodecError(ValueError):
+    """Malformed binary payload: truncated, bad tag, or a dangling
+    intern reference. Raised by every decode_* function — a transport
+    must treat it as a poisoned frame, never retry the bytes."""
+
+
+# Both varint halves share one magnitude cap (1024 bits — far beyond any
+# control-plane quantity, tight enough that hostile frames cannot force
+# quadratic bigint work): the ENCODER refuses what the decoder would
+# reject, so the wire never carries a frame only one side understands.
+_VARINT_MAX_BITS = 1024
+
+
+def _encode_varint(buf: bytearray, n: int) -> None:
+    if n.bit_length() > _VARINT_MAX_BITS:
+        raise CodecError(f"integer too large for the wire "
+                         f"({n.bit_length()} bits > {_VARINT_MAX_BITS})")
+    while n > 0x7F:
+        buf.append((n & 0x7F) | 0x80)
+        n >>= 7
+    buf.append(n)
+
+
+def _encode_into(buf: bytearray, obj: object, table: "dict[str, int]") -> None:
+    # Hot path: type checks ordered by frequency (strings dominate the
+    # control-plane records), one-byte varints inlined.
+    t = type(obj)
+    if t is str:
+        idx = table.get(obj)
+        if idx is not None:
+            if idx < 0x80:
+                buf.append(_T_STR_REF)
+                buf.append(idx)
+            else:
+                buf.append(_T_STR_REF)
+                _encode_varint(buf, idx)
+        else:
+            table[obj] = len(table)  # type: ignore[index]
+            raw = obj.encode()  # type: ignore[union-attr]
+            buf.append(_T_STR_NEW)
+            n = len(raw)
+            if n < 0x80:
+                buf.append(n)
+            else:
+                _encode_varint(buf, n)
+            buf += raw
+    elif t is dict:
+        buf.append(_T_DICT)
+        _encode_varint(buf, len(obj))  # type: ignore[arg-type]
+        for key, val in obj.items():  # type: ignore[union-attr]
+            _encode_into(buf, key, table)
+            _encode_into(buf, val, table)
+    elif t is int:
+        buf.append(_T_INT)
+        # zigzag: sign rides the low bit so magnitudes stay short
+        zz = (obj << 1) if obj >= 0 else ((-obj) << 1) - 1  # type: ignore
+        if zz < 0x80:
+            buf.append(zz)
+        else:
+            _encode_varint(buf, zz)
+    elif t is list or t is tuple:
+        buf.append(_T_LIST)
+        _encode_varint(buf, len(obj))  # type: ignore[arg-type]
+        for item in obj:  # type: ignore[union-attr]
+            _encode_into(buf, item, table)
+    elif obj is None:
+        buf.append(_T_NONE)
+    elif obj is True:
+        buf.append(_T_TRUE)
+    elif obj is False:
+        buf.append(_T_FALSE)
+    elif t is float:
+        buf.append(_T_FLOAT)
+        buf += _F64.pack(obj)  # type: ignore[arg-type]
+    else:
+        # slow path: subclasses coerce to their exact base type and
+        # re-enter the fast path above — ONE copy of every encoding;
+        # anything else falls back to str, like the WAL's json default
+        _encode_into(buf, _coerce(obj), table)
+
+
+def _coerce(obj: object) -> object:
+    if isinstance(obj, bool):
+        return bool(obj)
+    if isinstance(obj, str):
+        return str(obj)
+    if isinstance(obj, int):
+        return int(obj)
+    if isinstance(obj, float):
+        return float(obj)
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    if isinstance(obj, dict):
+        return dict(obj)
+    return str(obj)
+
+
+def _decode_varint(data: bytes, pos: int) -> "tuple[int, int]":
+    out = 0
+    shift = 0
+    end = len(data)
+    while True:
+        if pos >= end:
+            raise CodecError("truncated varint")
+        byte = data[pos]
+        pos += 1
+        out |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return out, pos
+        shift += 7
+        if shift > _VARINT_MAX_BITS:
+            raise CodecError("varint too long")
+
+
+def _decode_from(data: bytes, pos: int,
+                 table: "list[str]") -> "tuple[object, int]":
+    # Hot path (every watch delta and response decodes through here):
+    # branches ordered by frequency, one-byte varints inlined. Index
+    # errors from truncation surface as IndexError and are wrapped into
+    # CodecError by the public entry points.
+    end = len(data)
+    if pos >= end:
+        raise CodecError("truncated value")
+    tag = data[pos]
+    pos += 1
+    if tag == _T_STR_REF:
+        idx = data[pos]
+        pos += 1
+        if idx & 0x80:
+            idx, pos = _decode_varint(data, pos - 1)
+        if idx >= len(table):
+            raise CodecError(f"dangling string reference {idx}")
+        return table[idx], pos
+    if tag == _T_STR_NEW:
+        n = data[pos]
+        pos += 1
+        if n & 0x80:
+            n, pos = _decode_varint(data, pos - 1)
+        if pos + n > end:
+            raise CodecError("truncated string")
+        s = data[pos:pos + n].decode()
+        table.append(s)
+        return s, pos + n
+    if tag == _T_DICT:
+        n = data[pos]
+        pos += 1
+        if n & 0x80:
+            n, pos = _decode_varint(data, pos - 1)
+        if n > end - pos:
+            raise CodecError("dict longer than payload")
+        out_d: "dict[object, object]" = {}
+        for _ in range(n):
+            key, pos = _decode_from(data, pos, table)
+            val, pos = _decode_from(data, pos, table)
+            out_d[key] = val
+        return out_d, pos
+    if tag == _T_LIST:
+        n = data[pos]
+        pos += 1
+        if n & 0x80:
+            n, pos = _decode_varint(data, pos - 1)
+        if n > end - pos:
+            raise CodecError("list longer than payload")
+        out_l: "list[object]" = []
+        append = out_l.append
+        for _ in range(n):
+            item, pos = _decode_from(data, pos, table)
+            append(item)
+        return out_l, pos
+    if tag == _T_INT:
+        zz = data[pos]
+        pos += 1
+        if zz & 0x80:
+            zz, pos = _decode_varint(data, pos - 1)
+        return (zz >> 1) ^ -(zz & 1), pos
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_FLOAT:
+        if pos + 8 > end:
+            raise CodecError("truncated float")
+        return _F64.unpack_from(data, pos)[0], pos + 8
+    raise CodecError(f"unknown tag 0x{tag:02x}")
+
+
+def encode_value(obj: object) -> bytes:
+    """Encode one JSON-shaped value (the generic wire payload)."""
+    buf = bytearray()
+    _encode_into(buf, obj, dict(_STATIC_INDEX))
+    return bytes(buf)
+
+
+def decode_value(data: bytes) -> object:
+    """Decode one value; raises :class:`CodecError` on malformed bytes
+    (truncation, bad tags, dangling intern references) and rejects
+    trailing garbage — a frame is exactly one value."""
+    try:
+        val, pos = _decode_from(data, 0, list(_STATIC_STRINGS))
+    except IndexError:
+        raise CodecError("truncated value") from None
+    except RecursionError:
+        raise CodecError("value nested too deeply") from None
+    except UnicodeDecodeError:
+        raise CodecError("string payload is not valid utf-8") from None
+    except TypeError:
+        # e.g. a decoded list arriving in dict-key position
+        raise CodecError("unhashable dict key in payload") from None
+    if pos != len(data):
+        raise CodecError(f"{len(data) - pos} trailing byte(s) after value")
+    return val
+
+
+def _expect_dict(val: object, what: str) -> dict:
+    if not isinstance(val, dict):
+        raise CodecError(f"{what}: expected an object, got "
+                         f"{type(val).__name__}")
+    return val
+
+
+def encode_pod(pod: dict) -> bytes:
+    """Compact encoding of one pod object (JSON dict shape)."""
+    return encode_value(pod)
+
+
+def decode_pod(data: bytes) -> dict:
+    return _expect_dict(decode_value(data), "pod record")
+
+
+def encode_node_snapshot(node: dict) -> bytes:
+    """Compact encoding of one node object, device annotation included —
+    the advertiser re-patch / watch payload, where repeated chip-class
+    names are what interning folds away."""
+    return encode_value(node)
+
+
+def decode_node_snapshot(data: bytes) -> dict:
+    return _expect_dict(decode_value(data), "node snapshot")
+
+
+def encode_watch_batch(events: "list[tuple]", seq: int, coalesced: int = 0,
+                       relist: bool = False, epoch: "str | None" = None,
+                       ts: float = 0.0) -> bytes:
+    """One coalesced watch window ``[(seq, kind, event, obj), ...]`` plus
+    its resume cursor — encoded ONCE; the event-log fan-out writes the
+    same bytes to every subscribed watcher. ``ts`` is the sender's
+    wall-clock stamp (cross-process, so not monotonic) backing
+    ``watch_push_lag_ms``."""
+    return encode_value([[list(e) for e in events], seq, coalesced,
+                         relist, epoch, ts])
+
+
+def decode_watch_batch(data: bytes) -> dict:
+    val = decode_value(data)
+    if not isinstance(val, list) or len(val) != 6 or \
+            not isinstance(val[0], list):
+        raise CodecError("malformed watch batch")
+    events = []
+    for ev in val[0]:
+        if not isinstance(ev, list) or len(ev) != 4:
+            raise CodecError("malformed watch event")
+        events.append(tuple(ev))
+    return {"events": events, "seq": val[1], "coalesced": val[2],
+            "relist": bool(val[3]), "epoch": val[4], "ts": val[5]}
+
+
+def encode_request(method: str, path: str, body: object,
+                   trace: "str | None" = None) -> bytes:
+    """One framed API request: verb + route + body + optional trace
+    context (the X-KGTPU-Trace equivalent, riding the frame)."""
+    return encode_value([method, path, body, trace])
+
+
+def decode_request(data: bytes) -> "tuple[str, str, object, str | None]":
+    val = decode_value(data)
+    if not isinstance(val, list) or len(val) != 4 or \
+            not isinstance(val[0], str) or not isinstance(val[1], str) or \
+            not (val[3] is None or isinstance(val[3], str)):
+        raise CodecError("malformed request frame")
+    return val[0], val[1], val[2], val[3]
+
+
+def encode_response(status: int, body: object) -> bytes:
+    """One framed API response: HTTP-compatible status + body (error
+    bodies carry the same ``{"error", "per_pod"}`` conflict/bind detail
+    the JSON wire sends)."""
+    return encode_value([status, body])
+
+
+def decode_response(data: bytes) -> "tuple[int, object]":
+    val = decode_value(data)
+    if not isinstance(val, list) or len(val) != 2 or \
+            not isinstance(val[0], int):
+        raise CodecError("malformed response frame")
+    return val[0], val[1]
 
 
 def kube_pod_to_pod_info(kube_pod: dict, invalidate_existing: bool) -> PodInfo:

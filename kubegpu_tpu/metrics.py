@@ -110,6 +110,38 @@ class Gauge:
             self.value = 0
 
 
+class LabeledCounter:
+    """A counter family keyed by one or more labels (Prometheus
+    ``name{a="x",b="y"}``): children are created on first use and
+    rendered per label tuple by the exposition."""
+
+    def __init__(self, name: str, labels: tuple):
+        self.name = name
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._children: dict = {}
+
+    def labels(self, *values: str) -> Counter:
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name} takes labels "
+                             f"{self.label_names}, got {values}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = Counter(self.name)
+                self._children[values] = child
+            return child
+
+    def children(self) -> list:
+        """[(label values tuple, child counter)] sorted by labels."""
+        with self._lock:
+            return sorted(self._children.items())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._children = {}
+
+
 class LabeledHistogram:
     """A histogram family keyed by one label (Prometheus
     ``name{label="value"}``): children are created on first use and
@@ -201,6 +233,19 @@ WAL_SNAPSHOT_BYTES = Gauge("wal_snapshot_bytes")
 # anomaly dumps the flight recorder wrote.
 SCHED_PHASE_MS = LabeledHistogram("sched_phase_ms", "phase", start_us=0.01)
 FLIGHT_DUMPS = Counter("flight_dumps_total")
+# Wire transport (cluster/stream.py + cluster/httpapi.py): bytes moved
+# per wire ("json"/"stream") and direction ("tx"/"rx") through THIS
+# process's wire boundary — stream frames count wherever they are
+# read/written (client and server alike), json counts the client's HTTP
+# bodies (headers excluded, so the json wire's true framing overhead is
+# larger than it shows); per-frame binary codec encode/decode cost; and
+# watch_push_lag_ms — server batch-encode wall-clock stamp to client
+# delivery on the stream wire's push path (the latency the long-poll
+# re-request used to hide).
+TRANSPORT_BYTES = LabeledCounter("transport_bytes_total", ("wire", "dir"))
+FRAME_ENCODE_MS = Histogram("frame_encode_ms", start_us=0.002)
+FRAME_DECODE_MS = Histogram("frame_decode_ms", start_us=0.002)
+WATCH_PUSH_LAG_MS = Histogram("watch_push_lag_ms", start_us=0.01)
 
 
 def all_metrics() -> list:
@@ -210,7 +255,8 @@ def all_metrics() -> list:
     out = []
     for name in sorted(globals()):
         obj = globals()[name]
-        if isinstance(obj, (Histogram, Counter, Gauge, LabeledHistogram)):
+        if isinstance(obj, (Histogram, Counter, Gauge, LabeledHistogram,
+                            LabeledCounter)):
             out.append(obj)
     return out
 

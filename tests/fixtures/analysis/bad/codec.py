@@ -11,3 +11,8 @@ def inventory_to_annotation(meta, inventory):
 def annotation_to_lease(meta):
     # BAD: no lease_to_annotation encoder exists
     return json.loads(meta.get("annotations", {}).get("x/Lease", "null"))
+
+
+def encode_orphan_record(obj):
+    # BAD: no decode_orphan_record exists — frames nobody can parse
+    return repr(obj).encode()

@@ -10,3 +10,13 @@ def node_info_to_annotation(meta, info):
 
 def annotation_to_node_info(meta):
     return json.loads(meta.get("annotations", {}).get("x/NodeInfo", "null"))
+
+
+def encode_pod(pod):
+    # paired with decode_pod below; REAL name, so the round-trip-test
+    # check resolves against tests/test_codec_binary.py
+    return json.dumps(pod).encode()
+
+
+def decode_pod(data):
+    return json.loads(data.decode())

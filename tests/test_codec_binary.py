@@ -1,0 +1,213 @@
+"""Binary wire codec round trips (ISSUE 9): every ``encode_X`` paired
+with its ``decode_X`` (the codec-pairing analysis rule resolves the
+tested-pair requirement against this file), per-frame string interning,
+and hostile-input robustness — a damaged payload must raise the typed
+``CodecError``, never hang, over-allocate, or return garbage."""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from kubegpu_tpu.core import codec
+from kubegpu_tpu.core.codec import (CodecError, decode_node_snapshot,
+                                    decode_pod, decode_request,
+                                    decode_response, decode_value,
+                                    decode_watch_batch,
+                                    encode_node_snapshot, encode_pod,
+                                    encode_request, encode_response,
+                                    encode_value, encode_watch_batch)
+
+
+def fat_pod(name="p-0"):
+    """A pod in its wire shape, device annotation included — the hot
+    record the transport exists for."""
+    alloc = {f"alpha/grpresource/tpugrp1/0/tpugrp0/{i}/tpu/c{i}/chips":
+             f"alpha/grpresource/tpugrp1/0/tpugrp0/{i}/tpu/c{i}/chips"
+             for i in range(4)}
+    return {"metadata": {
+        "name": name,
+        "annotations": {codec.POD_ANNOTATION_KEY: json.dumps(
+            {"running_containers": {"main": {"allocate_from": alloc}}})}},
+        "spec": {"containers": [{"name": "main"}]}}
+
+
+# ---- generic value codec ----------------------------------------------------
+
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, 1, -1, 63, 64, 127, 128, 16384, -2**40, 2**70,
+    -2**70, 0.0, 1.5, -3.25, "", "hello", "π ünïcode",
+    [], {}, [1, [2, [3, None]]], {"a": {"b": {"c": [True, False]}}},
+    {"metadata": {"name": "x", "labels": {"a": "1"}}},
+])
+def test_value_round_trips(value):
+    assert decode_value(encode_value(value)) == value
+
+
+def test_tuples_encode_as_lists():
+    assert decode_value(encode_value((1, ("a", 2)))) == [1, ["a", 2]]
+
+
+def test_non_json_leaves_fall_back_to_str_like_the_wal():
+    class Weird:
+        def __str__(self):
+            return "weird"
+
+    assert decode_value(encode_value({"k": Weird()})) == {"k": "weird"}
+
+
+def test_interning_repeated_strings_shrinks_the_frame():
+    name = "pod-name-that-repeats-often"
+    once = len(encode_value([name]))
+    ten = len(encode_value([name] * 10))
+    # 9 repeats ride as 2-3 byte references, not 9 copies
+    assert ten < once + 9 * 5
+    assert decode_value(encode_value([name] * 10)) == [name] * 10
+
+
+def test_static_table_strings_never_ride_inline():
+    # a dict of nothing but protocol constants should carry no string
+    # payload bytes at all
+    data = encode_value({"metadata": "spec", "name": "nodeName"})
+    assert b"metadata" not in data
+    assert b"nodeName" not in data
+
+
+def test_frames_are_self_contained_across_calls():
+    """Frame-scoped interning: the second encode must not reference the
+    first frame's dynamic table (encode-once fan-out depends on any
+    subscriber decoding any frame standalone)."""
+    a = encode_value(["dynamic-string-a"])
+    b = encode_value(["dynamic-string-a"])
+    assert a == b
+    assert decode_value(b) == ["dynamic-string-a"]
+
+
+# ---- named record codecs ----------------------------------------------------
+
+
+def test_pod_round_trip():
+    pod = fat_pod()
+    assert decode_pod(encode_pod(pod)) == pod
+
+
+def test_pod_decoder_rejects_non_object():
+    with pytest.raises(CodecError):
+        decode_pod(encode_value([1, 2, 3]))
+
+
+def test_node_snapshot_round_trip():
+    node = {"metadata": {"name": "host0", "annotations": {
+        codec.NODE_ANNOTATION_KEY: json.dumps({"name": "host0"}),
+        codec.NODE_HEARTBEAT_ANNOTATION: "123.5"}},
+        "status": {"allocatable": {"cpu": "128", "pods": 1000}}}
+    assert decode_node_snapshot(encode_node_snapshot(node)) == node
+
+
+def test_watch_batch_round_trip():
+    events = [(1, "pod", "added", fat_pod("a")),
+              (2, "node", "modified", {"metadata": {"name": "n1"}}),
+              (5, "pod", "deleted", fat_pod("a"))]
+    out = decode_watch_batch(encode_watch_batch(
+        events, seq=5, coalesced=2, relist=False, epoch="e1", ts=77.25))
+    assert out["events"] == events
+    assert (out["seq"], out["coalesced"], out["relist"],
+            out["epoch"], out["ts"]) == (5, 2, False, "e1", 77.25)
+
+
+def test_watch_batch_relist_signal_round_trips():
+    out = decode_watch_batch(encode_watch_batch([], 9, relist=True))
+    assert out["relist"] is True and out["events"] == []
+
+
+def test_request_round_trip():
+    method, path, body, trace = decode_request(encode_request(
+        "POST", "/pods?x=1", fat_pod(), "trace-ctx"))
+    assert (method, path, trace) == ("POST", "/pods?x=1", "trace-ctx")
+    assert body == fat_pod()
+    assert decode_request(encode_request("GET", "/nodes", None))[3] is None
+
+
+def test_response_round_trip():
+    status, body = decode_response(encode_response(
+        409, {"error": "chip taken",
+              "per_pod": {"p1": "chip 0/0 claimed by p2"}}))
+    assert status == 409
+    assert body["per_pod"]["p1"].startswith("chip")
+
+
+def test_record_decoders_reject_wrong_shapes():
+    for decoder in (decode_watch_batch, decode_request, decode_response):
+        with pytest.raises(CodecError):
+            decoder(encode_value({"not": "the shape"}))
+        with pytest.raises(CodecError):
+            decoder(encode_value([1]))
+
+
+# ---- hostile input ----------------------------------------------------------
+
+
+def test_truncation_at_every_offset_raises_codec_error():
+    data = encode_watch_batch([(1, "pod", "added", fat_pod())], 1)
+    for cut in range(len(data)):
+        with pytest.raises(CodecError):
+            decode_watch_batch(data[:cut] if cut else b"")
+
+
+def test_trailing_garbage_is_rejected():
+    with pytest.raises(CodecError):
+        decode_value(encode_value({"a": 1}) + b"\x00")
+
+
+def test_random_garbage_never_hangs_or_escapes_codec_error():
+    rng = random.Random(7)
+    for _ in range(4000):
+        raw = bytes(rng.randrange(256)
+                    for _ in range(rng.randrange(0, 64)))
+        try:
+            decode_value(raw)
+        except CodecError:
+            pass
+
+
+def test_bit_flips_in_a_real_frame_stay_typed():
+    data = encode_value(fat_pod())
+    rng = random.Random(11)
+    for _ in range(500):
+        pos = rng.randrange(len(data))
+        flipped = bytearray(data)
+        flipped[pos] ^= 1 << rng.randrange(8)
+        try:
+            out = decode_value(bytes(flipped))
+        except CodecError:
+            continue
+        # a surviving flip decoded SOMETHING structurally valid; that is
+        # acceptable at this layer — frame CRC (cluster/stream.py) is
+        # what rejects corruption in transit
+        assert out is None or isinstance(
+            out, (dict, list, str, int, float, bool))
+
+
+def test_nesting_bomb_is_rejected_not_fatal():
+    bomb = bytes([0x07, 1]) * 20000  # list-of-list-of-...
+    with pytest.raises(CodecError):
+        decode_value(bomb)
+
+
+def test_huge_ints_round_trip_symmetrically():
+    """JSON carries arbitrary-precision ints; the binary wire must not
+    encode what its own decoder rejects — magnitudes up to the shared
+    varint cap round-trip, and beyond it ENCODING fails typed (never a
+    frame only one side understands)."""
+    for value in (2**69, -2**69, 2**200, 10**300, -(10**300)):
+        assert decode_value(encode_value(value)) == value
+    with pytest.raises(CodecError, match="too large"):
+        encode_value(2**1025)
+
+
+def test_dangling_intern_reference_is_typed():
+    with pytest.raises(CodecError, match="dangling"):
+        decode_value(bytes([0x06, 0xFF, 0x7F]))  # ref far past any table

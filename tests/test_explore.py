@@ -586,6 +586,77 @@ def test_watch_log_is_seq_exact_under_interleaved_mutations():
     assert res.ok, res.failure.render()
 
 
+def test_stream_push_reconnect_never_drops_or_doubles_deltas():
+    """ISSUE 9: the stream wire's push fan-out under a reconnect racing
+    live mutations — the subscriber's connection is severed mid-stream
+    (frames offered to the dead incarnation vanish, exactly like a
+    closed socket) and the client resubscribes at ITS cursor. In every
+    schedule, the delivered stream must carry strictly increasing
+    sequence numbers (nothing doubled) and end with every object
+    delivered (nothing dropped). The probe() points in the fan-out
+    (stream.pump / stream.offer / stream.subscribe) are what give the
+    explorer its preemption sites."""
+    import io
+
+    from kubegpu_tpu.cluster import stream as stream_mod
+    from kubegpu_tpu.cluster.httpapi import _EventLog
+
+    def scenario():
+        api = InMemoryAPIServer()
+        log = _EventLog(api)
+        state = {"cursor": 0, "delivered": [], "gen": 0, "sub": None}
+
+        def make_deliver(gen):
+            def deliver(data):
+                if state["gen"] != gen:
+                    return  # severed connection: the frame goes nowhere
+                ftype, _rid, payload = stream_mod.read_frame(
+                    io.BytesIO(data))
+                if ftype != stream_mod.PUSH:
+                    return
+                batch = codec.decode_watch_batch(payload)
+                for seq, _kind, _etype, obj in batch["events"]:
+                    state["delivered"].append(
+                        (seq, obj["metadata"]["name"]))
+                state["cursor"] = max(state["cursor"], batch["seq"])
+            return deliver
+
+        state["sub"] = log.add_stream_subscriber(
+            make_deliver(0), since=0, threaded=False)
+
+        def writer():
+            api.create_pod({"metadata": {"name": "a"}, "spec": {}})
+            api.create_pod({"metadata": {"name": "b"}, "spec": {}})
+
+        def pumper():
+            for _ in range(20):
+                if {n for _, n in state["delivered"]} == {"a", "b"}:
+                    return
+                log.pump_once(wait_s=0.05)
+
+        def reconnector():
+            # the push connection dies mid-stream...
+            state["gen"] += 1
+            log.remove_stream_subscriber(state["sub"])
+            # ...and the client reconnects, resuming at its cursor
+            state["sub"] = log.add_stream_subscriber(
+                make_deliver(state["gen"]), since=state["cursor"],
+                threaded=False)
+
+        def invariant():
+            seqs = [s for s, _ in state["delivered"]]
+            assert seqs == sorted(set(seqs)), \
+                f"doubled/regressed deltas: {state['delivered']}"
+            assert {n for _, n in state["delivered"]} == {"a", "b"}, \
+                f"dropped deltas: {state['delivered']}"
+
+        return [writer, pumper, reconnector], invariant
+
+    res = sch.explore(scenario, max_schedules=BUDGET,
+                      preemption_bound=PREEMPTIONS, seed=0)
+    assert res.ok, res.failure.render()
+
+
 # ---- exploration budget sanity ---------------------------------------------
 
 

@@ -226,6 +226,55 @@ def test_http_watch_relist_signals(tmp_path, monkeypatch):
         wal.close()
 
 
+def test_stream_watch_resume_is_seq_exact_across_wal_restart(tmp_path):
+    """ISSUE 9: the push-watch wire honors the same durability contract
+    as the long-poll — a WAL-backed apiserver restart severs every
+    stream connection, the client reconnects and resubscribes at its
+    cursor, and the recovered sequence space serves the gap seq-exact:
+    every event delivered exactly once, zero relists."""
+    import time
+
+    api = InMemoryAPIServer()
+    wal = WriteAheadLog(str(tmp_path), fsync=False)
+    server, url = serve_api(api, wal=wal)
+    port = int(url.rsplit(":", 1)[1])
+    client = HTTPAPIClient(url, wire="stream")
+    seen: list = []
+    client.add_watcher(
+        lambda k, e, o: seen.append((e, o["metadata"]["name"])))
+
+    def wait_for(item, timeout_s=10.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if item in seen:
+                return True
+            time.sleep(0.01)
+        return False
+
+    try:
+        api.create_pod({"metadata": {"name": "before"}})
+        assert wait_for(("added", "before"))
+        assert client.wire == "stream"
+        # crash: the restart severs the push connection mid-stream
+        server.shutdown()
+        server.server_close()
+        wal.close()
+        api2 = InMemoryAPIServer()
+        wal = WriteAheadLog(str(tmp_path), fsync=False)
+        server, _ = serve_api(api2, port=port, wal=wal)
+        api2.create_pod({"metadata": {"name": "after"}})
+        assert wait_for(("added", "after"))
+        assert seen.count(("added", "before")) == 1
+        assert seen.count(("added", "after")) == 1
+        assert client.relist_count == 0  # seq-exact resume, no relist
+        assert client.wire == "stream"  # never negotiated down
+    finally:
+        client.close()
+        server.shutdown()
+        server.server_close()
+        wal.close()
+
+
 def test_client_relists_and_scheduler_resyncs_on_restart():
     """Satellite: a restarted apiserver WITHOUT a WAL must not strand
     watchers — the client detects the sequence regression, fires its
