@@ -12,9 +12,12 @@ PY ?= python
 check: analyze lint type test
 
 # project-native invariants: lock discipline, monotonic clocks, codec
-# pairing, swallowed exceptions, metric registry (exit 1 on findings)
+# pairing, swallowed exceptions, metric registry, charge pairing,
+# resource lifecycle, wire contracts (exit 1 on findings; exit 3 when
+# the dataflow pass blows the wall-clock budget — a perf regression in
+# the analyzer itself is a finding too)
 analyze:
-	$(PY) -m kubegpu_tpu.analysis kubegpu_tpu
+	$(PY) -m kubegpu_tpu.analysis --stats --budget-s 120 kubegpu_tpu
 
 rules:
 	$(PY) -m kubegpu_tpu.analysis --list-rules

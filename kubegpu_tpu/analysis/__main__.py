@@ -1,10 +1,15 @@
 """CLI: ``python -m kubegpu_tpu.analysis [paths...]``.
 
-Exit codes: 0 clean, 1 findings, 2 usage/parse error.
+Exit codes: 0 clean, 1 findings, 2 usage/parse error, 3 wall-clock
+budget exceeded (``--budget-s``).
 
 ``--format`` selects the output: ``text`` (default, human), ``json``
 (machine-readable list), or ``sarif`` (SARIF 2.1.0 — what CI uploads so
-findings annotate pull requests inline).
+findings annotate pull requests inline; driver metadata carries EVERY
+registered rule, not just the ones that fired). ``--rule NAME`` (repeat
+to combine) selects rules, ``--stats`` prints the per-rule timing
+report, and ``--budget-s`` turns the total into a CI gate — the
+dataflow pass made analysis cost a regression axis worth guarding.
 """
 
 from __future__ import annotations
@@ -24,10 +29,11 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
 def to_sarif(findings: list) -> dict:
     """Findings as one SARIF 2.1.0 run. Paths are emitted as-is
     (repo-relative when invoked from the repo root, which is what the
-    upload action expects)."""
-    rules = sorted({f.rule for f in findings})
-    by_rule = {name: i for i, name in enumerate(rules)}
+    upload action expects). The driver advertises EVERY registered
+    rule's metadata — a clean run still documents what was checked."""
     descriptions = {r.name: r.description for r in all_rules()}
+    rules = sorted(set(descriptions) | {f.rule for f in findings})
+    by_rule = {name: i for i, name in enumerate(rules)}
     return {
         "$schema": SARIF_SCHEMA,
         "version": "2.1.0",
@@ -61,6 +67,18 @@ def to_sarif(findings: list) -> dict:
     }
 
 
+def render_stats(stats: dict) -> str:
+    """The ``--stats`` timing report (stderr: never mixes into parseable
+    stdout output)."""
+    lines = [f"analysis stats: {stats.get('files', 0)} file(s), "
+             f"parse {stats.get('parse_s', 0.0) * 1e3:.0f} ms, "
+             f"total {stats.get('total_s', 0.0) * 1e3:.0f} ms"]
+    rules = stats.get("rules", {})
+    for name, seconds in sorted(rules.items(), key=lambda kv: -kv[1]):
+        lines.append(f"  {name:26s} {seconds * 1e3:8.1f} ms")
+    return "\n".join(lines)
+
+
 def render(findings: list, fmt: str) -> str:
     if fmt == "json":
         return json.dumps([f.to_json() for f in findings], indent=2)
@@ -87,6 +105,16 @@ def main(argv: list | None = None) -> int:
                              "(default: the kubegpu_tpu package)")
     parser.add_argument("--select", default=None, metavar="RULE[,RULE...]",
                         help="run only these rules")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="RULE", dest="rules",
+                        help="run only this rule (repeatable; combines "
+                             "with --select)")
+    parser.add_argument("--stats", action="store_true",
+                        help="print the per-rule timing report")
+    parser.add_argument("--budget-s", type=float, default=None,
+                        metavar="SECONDS",
+                        help="exit 3 when the full analysis exceeds this "
+                             "wall-clock budget (the CI perf gate)")
     parser.add_argument("--tests-dir", default=None,
                         help="tests directory for round-trip-test checks "
                              "(default: ./tests when it exists)")
@@ -113,11 +141,15 @@ def main(argv: list | None = None) -> int:
     if tests_dir is None and os.path.isdir("tests"):
         tests_dir = "tests"
     select = [r.strip() for r in args.select.split(",")] \
-        if args.select else None
+        if args.select else []
+    if args.rules:
+        select.extend(r.strip() for r in args.rules)
     fmt = "json" if args.as_json else args.fmt
 
+    stats: dict = {}
     try:
-        findings = run_analysis(paths, select=select, tests_dir=tests_dir)
+        findings = run_analysis(paths, select=select or None,
+                                tests_dir=tests_dir, stats=stats)
     except AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -128,6 +160,12 @@ def main(argv: list | None = None) -> int:
             fh.write(report + "\n")
     else:
         print(report)
+    if args.stats:
+        print(render_stats(stats), file=sys.stderr)
+    if args.budget_s is not None and stats["total_s"] > args.budget_s:
+        print(f"error: analysis took {stats['total_s']:.2f}s, over the "
+              f"{args.budget_s:.2f}s budget", file=sys.stderr)
+        return 3
     return 1 if findings else 0
 
 

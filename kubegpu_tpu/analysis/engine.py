@@ -14,6 +14,7 @@ import dataclasses
 import io
 import os
 import re
+import time
 import tokenize
 from typing import Iterable, Sequence
 
@@ -185,9 +186,15 @@ def all_rules() -> list:
 
 
 def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
-                 tests_dir: str | None = None) -> list:
+                 tests_dir: str | None = None,
+                 stats: dict | None = None) -> list:
     """Run the (selected) rules over ``roots``; returns findings sorted by
-    location, with suppressed findings already dropped."""
+    location, with suppressed findings already dropped. When ``stats``
+    is a dict it is filled with the timing report ``--stats`` prints:
+    ``{"files": N, "parse_s": float, "rules": {name: seconds},
+    "total_s": float}`` — the dataflow pass made per-rule cost worth
+    watching, and CI holds the total to a wall-clock budget."""
+    t_start = time.perf_counter()
     rules = all_rules()
     if select is not None:
         wanted = set(select)
@@ -197,6 +204,7 @@ def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
                 f"unknown rule(s): {', '.join(sorted(unknown))}")
         rules = [r for r in rules if r.name in wanted]
     sources = load_sources(roots)
+    parse_s = time.perf_counter() - t_start
     by_path = {s.path: s for s in sources}
     ctx = Context(root=os.path.abspath(roots[0]) if roots else os.getcwd(),
                   tests_dir=tests_dir)
@@ -206,7 +214,9 @@ def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
     # always runs last regardless of registry order
     rules = sorted(rules, key=lambda r: r.name == "unused-suppression")
     findings: list = []
+    rule_times: dict = {}
     for rule in rules:
+        t_rule = time.perf_counter()
         for finding in rule.run(sources, ctx):
             src = by_path.get(finding.path)
             if src is not None:
@@ -215,5 +225,11 @@ def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
                     sup.used_rules.add(finding.rule)
                     continue
             findings.append(finding)
+        rule_times[rule.name] = time.perf_counter() - t_rule
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if stats is not None:
+        stats["files"] = len(sources)
+        stats["parse_s"] = parse_s
+        stats["rules"] = rule_times
+        stats["total_s"] = time.perf_counter() - t_start
     return findings

@@ -1,36 +1,82 @@
-"""Pytest plugin: run the whole suite under the lock-order harness.
+"""Pytest plugin: the suite runs under the dynamic analysis harnesses.
 
-Registered from ``tests/conftest.py`` (``pytest_plugins``). While the
-suite runs, every lock the package constructs is instrumented
-(:mod:`kubegpu_tpu.analysis.lockgraph`); at session end the accumulated
-acquisition graph is checked for cycles and the run FAILS if any exist —
-a lock-order inversion is a deadlock waiting for the right interleaving,
-and it must not ride a green build.
+Registered from ``tests/conftest.py`` (``pytest_plugins``). Two layers:
 
-Disable with ``KGTPU_LOCKGRAPH=0`` (e.g. when bisecting an unrelated
-failure).
+* **lock-order harness** — every lock the package constructs is
+  instrumented (:mod:`kubegpu_tpu.analysis.lockgraph`); at session end
+  the accumulated acquisition graph is checked for cycles and the run
+  FAILS if any exist — a lock-order inversion is a deadlock waiting
+  for the right interleaving, and it must not ride a green build.
+  Disable with ``KGTPU_LOCKGRAPH=0``.
+
+* **per-test leak guard** — the dynamic twin of the static
+  resource-lifecycle rule (:mod:`kubegpu_tpu.analysis.leakguard`):
+  package-created threads and sockets are snapshotted at test start,
+  and a test that finishes leaving a non-daemon package thread alive
+  or a package socket open FAILS at teardown, with the creation site
+  in the message. Disable with ``KGTPU_LEAKGUARD=0`` (e.g. when
+  bisecting an unrelated failure).
 """
 
 from __future__ import annotations
 
 import os
+from typing import Iterator
 
-from kubegpu_tpu.analysis import lockgraph
+import pytest
+
+from kubegpu_tpu.analysis import leakguard, lockgraph
 
 _ENV_FLAG = "KGTPU_LOCKGRAPH"
+_LEAK_FLAG = "KGTPU_LEAKGUARD"
 
 
 def _enabled() -> bool:
     return os.environ.get(_ENV_FLAG, "1") not in ("0", "false", "no")
 
 
+def _leak_enabled() -> bool:
+    return os.environ.get(_LEAK_FLAG, "1") not in ("0", "false", "no")
+
+
 def pytest_configure(config: object) -> None:
     if _enabled():
         lockgraph.install()
+    if _leak_enabled():
+        leakguard.install()
 
 
 def pytest_unconfigure(config: object) -> None:
     lockgraph.uninstall()
+    leakguard.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _kgtpu_leakguard(request: object) -> Iterator[None]:
+    """Per-test snapshot/verdict. Autouse and dependency-free, so it is
+    set up before (and torn down after) the test's own fixtures — a
+    server a fixture shuts down in ITS teardown is already closed by
+    the time the verdict runs."""
+    if not leakguard.installed():
+        yield
+        return
+    threads_before, socks_before = leakguard.snapshot()
+    yield
+    threads = leakguard.leaked_threads(threads_before)
+    if threads:
+        names = ", ".join(f"{name} (started at {origin})"
+                          for name, origin in threads)
+        pytest.fail(
+            f"leak guard: non-daemon package thread(s) still alive at "
+            f"teardown: {names} — join them, make them daemon, or "
+            f"disable with {_LEAK_FLAG}=0", pytrace=False)
+    socks = leakguard.leaked_sockets(socks_before)
+    if socks:
+        pytest.fail(
+            f"leak guard: package-created socket(s) still open at "
+            f"teardown: {', '.join(socks)} — close the client/server "
+            f"that owns them, or disable with {_LEAK_FLAG}=0",
+            pytrace=False)
 
 
 def pytest_terminal_summary(terminalreporter: object, exitstatus: int,

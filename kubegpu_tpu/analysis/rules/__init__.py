@@ -7,11 +7,13 @@ from kubegpu_tpu.analysis.rules.charges import ChargePairing
 from kubegpu_tpu.analysis.rules.clocks import MonotonicTime
 from kubegpu_tpu.analysis.rules.codecs import CodecPairing
 from kubegpu_tpu.analysis.rules.exceptions import NoSwallowedExceptions
+from kubegpu_tpu.analysis.rules.lifecycle import ResourceLifecycle
 from kubegpu_tpu.analysis.rules.locks import (LockDiscipline,
                                               NoBlockingUnderLock,
                                               TransitiveLockDiscipline)
 from kubegpu_tpu.analysis.rules.metricsrule import MetricRegistration
 from kubegpu_tpu.analysis.rules.suppressions import UnusedSuppression
+from kubegpu_tpu.analysis.rules.wire import WireContract
 
 ALL_RULES = [
     LockDiscipline(),
@@ -22,6 +24,8 @@ ALL_RULES = [
     NoSwallowedExceptions(),
     MetricRegistration(),
     ChargePairing(),
+    ResourceLifecycle(),
+    WireContract(),
     # always ordered last by the engine: it audits what the others used
     UnusedSuppression(),
 ]

@@ -11,179 +11,51 @@ capacity loss, and the TTL exists for crashes, not for control flow).
 
 The rule is **interprocedural**: a call counts as resolving when its
 callee *transitively* reaches ``confirm_pod``/``forget_pod`` through the
-package call graph — handing the assumed pod to the pipelined binder
-(whose commit/crash paths confirm or forget) is the designed resolution,
-not a leak. Callees are resolved by name across the scanned tree (an
+package call graph (:class:`~kubegpu_tpu.analysis.dataflow.CallGraph`) —
+handing the assumed pod to the pipelined binder (whose commit/crash
+paths confirm or forget) is the designed resolution, not a leak.
+Callees are resolved by name across the scanned tree (an
 over-approximation: a same-named function anywhere in the package
 matches), which errs toward silence, never toward noise.
 
-Checked per ``assume_pod`` call site:
+Since PR 10 the path reasoning itself lives in the shared dataflow
+engine (:mod:`kubegpu_tpu.analysis.dataflow`): the rule builds the
+function's CFG, treats each ``assume_pod`` statement as an *acquire*
+site and every statement calling a resolving name as a *release*, and
+asks :func:`~kubegpu_tpu.analysis.dataflow.may_leak` whether the charge
+can reach a checked exit still open. The contract is unchanged:
 
-- **Normal paths** — every path from the call to function exit must
-  contain a resolving call; a ``return`` or ``raise`` before one is a
-  finding.
+- **Normal paths** — every path from the call site to function exit
+  (including an explicit ``raise``) must contain a resolving call.
 - **Exception edges** — when the call site sits inside a ``try``, each
   ``except`` handler is a path of its own and must also resolve (a
   handler that logs-and-returns swallowed the failure AND the charge).
   Outside any ``try``, an unexpected exception propagates to the TTL
   backstop by design and is not flagged.
+- **Loops** — may-iterate semantics with the canonical-cleanup
+  refinement: ``for p in assumed: forget_pod(p)`` iterates exactly when
+  there is a charge to release and counts as resolving.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, List, Set
 
+from kubegpu_tpu.analysis.dataflow import (CallGraph, LeakReport, Node,
+                                           build_cfg, call_names, may_leak,
+                                           stmt_sites)
 from kubegpu_tpu.analysis.engine import Context, Finding, SourceFile
 
 ASSUME = "assume_pod"
 RESOLVERS = frozenset({"confirm_pod", "forget_pod"})
 
 
-def _call_names(node: ast.AST) -> set:
-    """Names of everything called anywhere under ``node`` (attribute
-    calls by attr name, plain calls by identifier) — lambdas included:
-    a deferred ``submit(lambda: self._commit(...))`` hands off work and
-    the handed-off call is what matters."""
-    out: set = set()
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call):
-            func = sub.func
-            if isinstance(func, ast.Attribute):
-                out.add(func.attr)
-            elif isinstance(func, ast.Name):
-                out.add(func.id)
+def _effect_calls(node: Node) -> Set[str]:
+    out: Set[str] = set()
+    for sub in node.effect_asts():
+        out |= call_names(sub)
     return out
-
-
-def _resolving_names(sources: list) -> set:
-    """Fixpoint closure: a function name is *resolving* when any
-    function bearing it (anywhere in the tree) calls a resolving name.
-    Seeds: ``confirm_pod`` / ``forget_pod`` themselves."""
-    calls_by_name: dict = {}
-    for src in sources:
-        for node in ast.walk(src.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                calls_by_name.setdefault(node.name, set()) \
-                    .update(_call_names(node))
-    resolving = set(RESOLVERS)
-    changed = True
-    while changed:
-        changed = False
-        for name, called in calls_by_name.items():
-            if name not in resolving and called & resolving:
-                resolving.add(name)
-                changed = True
-    return resolving
-
-
-class _FunctionChecker:
-    """Path analysis for one function containing ``assume_pod`` calls.
-
-    Statements are folded right-to-left carrying ``k`` — "does the
-    suffix after this statement resolve on every path" — so each assume
-    site is checked against exactly its own continuation. ``try``
-    blocks additionally require every handler to resolve when an assume
-    (or its continuation) lives in the protected body."""
-
-    def __init__(self, rule_name: str, src: SourceFile,
-                 resolving: set) -> None:
-        self.rule_name = rule_name
-        self.src = src
-        self.resolving = resolving
-        self.findings: list = []
-
-    # -- expression-level tests ----------------------------------------------
-
-    def _stmt_resolves(self, stmt: ast.AST) -> bool:
-        return bool(_call_names(stmt) & self.resolving)
-
-    def _stmt_assumes(self, stmt: ast.AST) -> bool:
-        return ASSUME in _call_names(stmt)
-
-    # -- the fold -------------------------------------------------------------
-
-    def check_function(self, fn: ast.AST) -> None:
-        self._block(list(fn.body), False, [])
-
-    def _block(self, stmts: list, k: bool, tries: list) -> bool:
-        """``k``: whether falling off the end of this block resolves.
-        ``tries``: enclosing (handlers, handler_continuation) pairs —
-        the exception edges an assume inside this block must cover.
-        Returns whether every path entering the block resolves."""
-        res = k
-        for stmt in reversed(stmts):
-            res = self._stmt(stmt, res, tries)
-        return res
-
-    def _stmt(self, stmt: ast.AST, k: bool, tries: list) -> bool:
-        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            # a nested def is a separate unit checked on its own; its
-            # mere definition resolves nothing
-            return k
-        if isinstance(stmt, ast.If):
-            body = self._block(list(stmt.body), k, tries)
-            orelse = self._block(list(stmt.orelse), k, tries)
-            return body and orelse
-        if isinstance(stmt, (ast.With, ast.AsyncWith)):
-            if self._assumes_in_items(stmt):
-                self._check_site(stmt, self._block(list(stmt.body), k,
-                                                   tries), tries)
-            return self._block(list(stmt.body), k, tries)
-        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
-            # a loop whose every body path resolves is treated as
-            # resolving (may-iterate assumption — `for p in assumed:
-            # forget_pod(p)` is the canonical cleanup shape and iterates
-            # exactly when there is a charge to release); the body's
-            # fall-through continuation is the after-loop suffix
-            body_ok = self._block(list(stmt.body), k, tries)
-            if stmt.orelse:
-                return self._block(list(stmt.orelse), k, tries)
-            return body_ok or k
-        if isinstance(stmt, ast.Try):
-            k_final = self._block(list(stmt.finalbody), k, tries) \
-                if stmt.finalbody else k
-            if stmt.finalbody and self._block(list(stmt.finalbody),
-                                              False, tries):
-                # a finally that itself resolves covers every path
-                return True
-            handler_info = ([(h, k_final) for h in stmt.handlers], k_final)
-            body_ok = self._block(list(stmt.body) + list(stmt.orelse),
-                                  k_final, tries + [handler_info])
-            handlers_ok = all(
-                self._block(list(h.body), k_final, tries)
-                for h in stmt.handlers)
-            return body_ok and handlers_ok
-        if isinstance(stmt, (ast.Return, ast.Raise)):
-            return self._stmt_resolves(stmt)
-        if isinstance(stmt, (ast.Break, ast.Continue)):
-            return k
-        # simple statement (Expr/Assign/AugAssign/AnnAssign/Assert/...)
-        if self._stmt_assumes(stmt):
-            self._check_site(stmt, k or self._stmt_resolves(stmt), tries)
-        return self._stmt_resolves(stmt) or k
-
-    def _assumes_in_items(self, stmt: ast.AST) -> bool:
-        return any(ASSUME in _call_names(item.context_expr)
-                   for item in getattr(stmt, "items", ()))
-
-    def _check_site(self, stmt: ast.AST, normal_ok: bool,
-                    tries: list) -> None:
-        if not normal_ok:
-            self.findings.append(Finding(
-                self.rule_name, self.src.path, stmt.lineno,
-                f"`{ASSUME}` call is not paired: a path from here to "
-                f"function exit reaches no confirm_pod/forget_pod "
-                f"(directly or through any called function); the "
-                f"assumed charge leaks until the TTL sweep"))
-        for handlers, k_handler in tries:
-            for handler, k_h in handlers:
-                if not self._block(list(handler.body), k_h, []):
-                    self.findings.append(Finding(
-                        self.rule_name, self.src.path, handler.lineno,
-                        f"exception edge drops the assumed charge: this "
-                        f"handler covers an `{ASSUME}` call but no path "
-                        f"through it reaches confirm_pod/forget_pod"))
 
 
 class ChargePairing:
@@ -197,7 +69,7 @@ class ChargePairing:
                    "handlers included), transitively through callees")
 
     def run(self, sources: list, ctx: Context) -> Iterator[Finding]:
-        resolving = _resolving_names(sources)
+        resolving = CallGraph(sources).closure(RESOLVERS)
         for src in sources:
             for node in ast.walk(src.tree):
                 if not isinstance(node, (ast.FunctionDef,
@@ -205,13 +77,44 @@ class ChargePairing:
                     continue
                 if node.name == ASSUME:
                     continue  # the definition, not a consumer
-                if ASSUME not in _call_names(node):
+                if ASSUME not in call_names(node):
                     continue
-                checker = _FunctionChecker(self.name, src, resolving)
-                checker.check_function(node)
-                seen: set = set()
-                for finding in checker.findings:
-                    key = (finding.line, finding.message)
-                    if key not in seen:
-                        seen.add(key)
-                        yield finding
+                yield from self._check_function(src, node, resolving)
+
+    def _check_function(self, src: SourceFile, fn: ast.AST,
+                        resolving: frozenset) -> Iterator[Finding]:
+        cfg = build_cfg(fn)
+
+        def releases(node: Node) -> bool:
+            return bool(_effect_calls(node) & resolving)
+
+        sites = stmt_sites(cfg, lambda n: ASSUME in _effect_calls(n))
+        reports: List[LeakReport] = []
+        site_lines: List[int] = []
+        for site in sites:
+            reports.append(may_leak(cfg, site, releases,
+                                    site_releases=releases(site)))
+            site_lines.append(getattr(site.stmt, "lineno", fn.lineno))
+        seen: Set[tuple] = set()
+        for line, report in zip(site_lines, reports):
+            if report.normal:
+                finding = Finding(
+                    self.name, src.path, line,
+                    f"`{ASSUME}` call is not paired: a path from here to "
+                    f"function exit reaches no confirm_pod/forget_pod "
+                    f"(directly or through any called function); the "
+                    f"assumed charge leaks until the TTL sweep")
+                key = (finding.line, finding.message)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding
+            for handler in report.handlers:
+                finding = Finding(
+                    self.name, src.path, handler.lineno,
+                    f"exception edge drops the assumed charge: this "
+                    f"handler covers an `{ASSUME}` call but no path "
+                    f"through it reaches confirm_pod/forget_pod")
+                key = (finding.line, finding.message)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding

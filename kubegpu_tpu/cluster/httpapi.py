@@ -186,6 +186,12 @@ class _StreamSubscriber:
 
     def stop(self) -> None:
         self._die()
+        # a dead subscriber's writer exits on the notify; join it so
+        # teardown leaves no writer thread behind (self-join guarded:
+        # _die may be invoked from the writer's own send failure)
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5.0)
 
     def _die(self) -> None:
         """Alive->dead transition: wake the writer and fire ``on_dead``
@@ -421,14 +427,20 @@ class _EventLog:
 
     def stop_stream(self) -> None:
         """Tear down the fan-out (server shutdown): stops the pump and
-        every subscriber's writer thread."""
+        every subscriber's writer thread, and JOINS them — a "stopped"
+        stream with its pump still draining a wait was the unjoined-
+        thread path the lifecycle work closed."""
         with self._lock:
             self._pump_stop = True
             subs = list(self._subs)
             self._subs = []
+            pump = self._pump_thread
+            self._pump_thread = None
             self._lock.notify_all()
         for sub in subs:
             sub.stop()
+        if pump is not None and pump is not threading.current_thread():
+            pump.join(timeout=5.0)
 
     def _pump_loop(self):
         while True:
@@ -557,9 +569,9 @@ def _route_request(api: InMemoryAPIServer, log: _EventLog, method: str,
     or raises NotFound/Conflict for the transport to map. Both the HTTP
     handler and the stream dispatcher call THIS — one route surface,
     two framings."""
-    if parts == ["healthz"]:
+    if parts == ["healthz"]:  # analysis: disable=wire-contract -- curl/monitoring liveness probe; no package client consumes it
         return 200, {"ok": True}
-    if parts == ["debug", "traces"] and method == "GET":
+    if parts == ["debug", "traces"] and method == "GET":  # analysis: disable=wire-contract -- operator debug surface (curl/Perfetto), deliberately client-less
         # this process's span ring, Perfetto-loadable
         return 200, obs.chrome_trace()
     if parts[:2] == ["debug", "pod"] and len(parts) == 3 \
@@ -687,8 +699,10 @@ def _route_request(api: InMemoryAPIServer, log: _EventLog, method: str,
 def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
               wal=None, stream_wire: bool = True):
     """Start serving; returns (ThreadingHTTPServer, base_url). The server
-    runs on a daemon thread; call ``server.shutdown()`` (and
-    ``server.server_close()`` to release the port) to stop. With ``wal``
+    runs on a daemon thread; ``server.shutdown()`` stops it COMPLETELY —
+    live connections severed, the stream fan-out joined, the WAL handle
+    closed, and the listening port released (a further
+    ``server_close()`` is a harmless no-op). With ``wal``
     (a ``cluster.wal.WriteAheadLog``), the apiserver's state and watch
     log are recovered from disk before the first request is served, and
     every subsequent event is logged write-ahead — watch resume
@@ -932,6 +946,18 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
                     conn.close()
                 except OSError:
                     pass
+            if wal is not None:
+                # every mutator path is severed above; a "stopped"
+                # apiserver must not keep its WAL file handle open
+                # (apiserver_main also closes on its own exit path —
+                # close() is idempotent — but tests and chaos restarts
+                # call shutdown() directly and used to leak it)
+                wal.close()
+            # ...nor its port: serve_forever has returned by the time
+            # super().shutdown() comes back, so releasing the listening
+            # socket here is safe, and a second server_close() from a
+            # caller following the old two-step contract is a no-op
+            self.server_close()
 
     server = Server((host, port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True,
@@ -1005,6 +1031,13 @@ class HTTPAPIClient:
         single seam tests use to inject transport failures."""
         conn = getattr(self._local, "conn", None)
         if conn is None:
+            if self._stop.is_set():
+                # a closed client must not quietly re-dial: the watch
+                # thread caught mid-poll used to open a FRESH connection
+                # after close() and long-poll the server for up to 30
+                # more seconds past the client's lifetime (the socket
+                # leak the resource-lifecycle work was built to end)
+                raise ConnectionError("client is closed")
             split = urllib.parse.urlsplit(self.base_url)
             cls = http.client.HTTPSConnection if split.scheme == "https" \
                 else http.client.HTTPConnection
@@ -1057,6 +1090,8 @@ class HTTPAPIClient:
         JSON."""
         conn = getattr(self._local, "stream", None)
         if conn is None or conn.closed:
+            if self._stop.is_set():
+                raise ConnectionError("client is closed")
             conn = stream.StreamConn.connect(self.base_url, timeout)
             self._local.stream = conn
             with self._conn_lock:
@@ -1387,6 +1422,11 @@ class HTTPAPIClient:
         try:
             conn = stream.StreamConn.connect(self.base_url, 10.0)
             with self._conn_lock:
+                if self._stop.is_set():
+                    # close() already swept the connection set; a conn
+                    # registered after that sweep would outlive the
+                    # client — drop it instead (the finally closes it)
+                    return
                 self._stream_conns.add(conn)
             ack = conn.subscribe(st["seq"], self.watch_kinds,
                                  self.watch_batch_s, timeout=10.0)
@@ -1531,3 +1571,10 @@ class HTTPAPIClient:
                 pass
         for sconn in sconns:
             sconn.close()
+        # the watch thread's sockets are dead and _roundtrip refuses new
+        # ones, so the loop exits promptly: join it so close() returns a
+        # client with NO live threads (the per-test leak guard's
+        # contract, and what a 'closed' client should mean)
+        watcher = self._watch_thread
+        if watcher is not None and watcher is not threading.current_thread():
+            watcher.join(timeout=5.0)

@@ -283,8 +283,18 @@ def serve_mock_kube(api: InMemoryAPIServer | None = None,
         def do_DELETE(self):
             self._route("DELETE")
 
-    server = ThreadingHTTPServer((host, port), Handler)
-    server.daemon_threads = True
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+
+        def shutdown(self):
+            # stopped means STOPPED: serve_forever has returned by the
+            # time super().shutdown() comes back, so the listening
+            # socket is released here instead of leaking until process
+            # exit (same lifecycle contract as cluster/httpapi.py)
+            super().shutdown()
+            self.server_close()
+
+    server = Server((host, port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="mock-kube-apiserver").start()
     return server, f"http://{host}:{server.server_address[1]}", api

@@ -268,6 +268,13 @@ class StreamConn:
     def close(self) -> None:
         self.closed = True
         try:
+            # the makefile reader holds an io-ref on the socket: without
+            # closing it the OS fd survives sock.close() until GC — the
+            # per-test leak guard's first catch
+            self._rfile.close()
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass

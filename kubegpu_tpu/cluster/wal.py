@@ -69,6 +69,7 @@ class WriteAheadLog:
         os.makedirs(dir_path, exist_ok=True)
         self._lock = threading.Lock()
         self._fh: Optional[BinaryIO] = None
+        self._closed = False
         self._since_snapshot = 0
         self.appended_total = 0
         self.recovered_records = 0
@@ -129,6 +130,12 @@ class WriteAheadLog:
 
     def _open_locked(self) -> BinaryIO:
         # Always called with self._lock held.
+        if self._closed:
+            # close() latches: an in-flight mutator racing a server
+            # shutdown must not quietly reopen the handle the shutdown
+            # just released — its request fails instead (the client's
+            # retry/reconnect layer owns what happens next)
+            raise RuntimeError("write-ahead log is closed")
         if self._fh is None:
             self._fh = open(self.wal_path, "ab")
         return self._fh
@@ -156,6 +163,8 @@ class WriteAheadLog:
                          default=str).encode()
         tmp = self.snapshot_path + ".tmp"
         with self._lock:
+            if self._closed:
+                raise RuntimeError("write-ahead log is closed")
             with open(tmp, "wb") as fh:
                 fh.write(doc)
                 fh.flush()
@@ -262,6 +271,7 @@ class WriteAheadLog:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None

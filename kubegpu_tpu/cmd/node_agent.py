@@ -32,6 +32,27 @@ def build_manager(backend_kind: str, sysfs_root: str,
     return mgr
 
 
+def _primary_address() -> str | None:
+    """The routable primary IP, via a connected UDP socket (no packet
+    is sent). gethostbyname(hostname) is wrong here: stock /etc/hosts
+    maps the hostname to 127.0.1.1, and advertising a loopback address
+    cluster-wide would make every remote gang member dial itself. On
+    failure advertise nothing — the hook then falls back to the node
+    name, which may resolve."""
+    probe = None
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.connect(("10.255.255.255", 1))
+        return str(probe.getsockname()[0])
+    except OSError:
+        # the probe could not determine a route; the socket (when it
+        # was created at all) is still closed below
+        return None
+    finally:
+        if probe is not None:
+            probe.close()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--api", default="http://127.0.0.1:8070")
@@ -80,21 +101,7 @@ def main(argv=None) -> int:
         except KeyError:
             client.create_node({"metadata": {"name": node_name}})
 
-    address = args.node_address
-    if not address:
-        # the routable primary IP, via a connected UDP socket (no packet
-        # is sent). gethostbyname(hostname) is wrong here: stock
-        # /etc/hosts maps the hostname to 127.0.1.1, and advertising a
-        # loopback address cluster-wide would make every remote gang
-        # member dial itself. On failure advertise nothing — the hook
-        # then falls back to the node name, which may resolve.
-        try:
-            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            probe.connect(("10.255.255.255", 1))
-            address = probe.getsockname()[0]
-            probe.close()
-        except OSError:
-            address = None
+    address = args.node_address or _primary_address()
     mgr = build_manager(args.backend, args.sysfs_root,
                         args.device_plugins_dir)
     adv = DeviceAdvertiser(client, mgr, node_name, address=address)

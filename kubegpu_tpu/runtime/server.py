@@ -58,17 +58,17 @@ class _UnixHTTPServer(ThreadingHTTPServer):
         # but a LIVE socket (another agent serving) must: probe-connect
         # before unlinking so a second agent fails loudly instead of
         # silently stealing the endpoint.
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
-            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             probe.settimeout(0.5)
-            try:
-                probe.connect(self.server_address)
-                raise OSError(
-                    f"socket {self.server_address} is live (another agent?)")
-            except (ConnectionRefusedError, FileNotFoundError):
-                pass  # stale or absent: safe to (re)bind
-            finally:
-                probe.close()
+            probe.connect(self.server_address)
+            raise OSError(
+                f"socket {self.server_address} is live (another agent?)")
+        except (ConnectionRefusedError, FileNotFoundError):
+            pass  # stale or absent: safe to (re)bind
+        finally:
+            probe.close()
+        try:
             os.unlink(self.server_address)
         except FileNotFoundError:
             pass

@@ -20,6 +20,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable
 
 from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.analysis.explore import probe
@@ -39,7 +40,7 @@ DEFAULT_PARALLELISM = 16
 
 
 class FitError(Exception):
-    def __init__(self, pod_name: str, failures: dict):
+    def __init__(self, pod_name: str, failures: dict) -> None:
         self.pod_name = pod_name
         self.failures = failures  # node name -> [reason strings]
         super().__init__(f"pod {pod_name} fits no node: {failures}")
@@ -55,11 +56,11 @@ def _pod_priority(kube_pod: dict) -> int:
 class GenericScheduler:
     """Fit/score/select/allocate (`core/generic_scheduler.go:130-188`)."""
 
-    def __init__(self, cache: SchedulerCache, device_scheduler,
+    def __init__(self, cache: SchedulerCache, device_scheduler: Any,
                  parallelism: int = DEFAULT_PARALLELISM,
                  extenders: list | None = None,
                  priority_weights: dict | None = None,
-                 algorithm: factory.AlgorithmConfig | None = None):
+                 algorithm: factory.AlgorithmConfig | None = None) -> None:
         self.cache = cache
         self.device_scheduler = device_scheduler
         self.parallelism = max(1, parallelism)
@@ -104,7 +105,8 @@ class GenericScheduler:
             if getattr(fn, "reads", factory.VOLUME_READS)
             & factory.VOLUME_READS]
 
-    def _parallel_map(self, fn, items):
+    def _parallel_map(self, fn: Callable[[Any], Any],
+                      items: Iterable[Any]) -> list:
         """Order-preserving pool map in node-list chunks, not one task
         per node: at 64+ nodes the per-task queue/lock overhead of
         Executor.map dominated the (mostly GIL-serialized) per-node work
@@ -131,7 +133,7 @@ class GenericScheduler:
 
     _AUTO_META = object()  # sentinel: compute inter-pod metadata if needed
 
-    def _interpod_meta(self, kube_pod: dict):
+    def _interpod_meta(self, kube_pod: dict) -> Any:
         """Cluster-wide inter-pod-affinity metadata, or None when neither
         the incoming pod nor any placed pod declares any — the gate that
         keeps affinity free for the common case (`metadata.go` analogue)."""
@@ -140,7 +142,7 @@ class GenericScheduler:
             return self.cache.interpod_snapshot()
         return None
 
-    def _pod_info_provider(self, kube_pod: dict):
+    def _pod_info_provider(self, kube_pod: dict) -> Callable[[str], Any]:
         """Parse the pod's device annotation ONCE per scheduling pass and
         hand out clones per node (same semantics as
         `cache.pod_info_for_node`, minus the per-node JSON decode — the
@@ -150,7 +152,7 @@ class GenericScheduler:
         base = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=False)
         inv = codec.kube_pod_to_pod_info(kube_pod, invalidate_existing=True)
 
-        def get(node_name: str):
+        def get(node_name: str) -> Any:
             return (base if base.node_name == node_name else inv).clone()
         # exposed so the device-verdict cache can tell WHICH variant a
         # node evaluates: the pod's annotated node sees the pinned
@@ -202,7 +204,7 @@ class GenericScheduler:
         return self._nominations_by_node(exclude, min_priority) \
             .get(node_name, [])
 
-    def _charge_nominated(self, nominated: list, snap) -> None:
+    def _charge_nominated(self, nominated: list, snap: Any) -> None:
         """Charge nominated pods' demand onto a (private) fit snapshot:
         core requests always; device demand via a simulated allocation
         (the nominated pod has no allocate_from yet — its chips are
@@ -254,7 +256,7 @@ class GenericScheduler:
                 out[node] = out.get(node, 0) + chips
         return out
 
-    def _volume_snapshot(self, kube_pod: dict):
+    def _volume_snapshot(self, kube_pod: dict) -> Any:
         """Pass-level PV/PVC snapshot for CheckVolumeBinding, or None when
         the pod references no PVCs / no binder is wired."""
         if self.volume_binder is None:
@@ -263,11 +265,14 @@ class GenericScheduler:
 
     def _fits_on_node(self, kube_pod: dict, node_name: str,
                       eq_class: str | None = None,
-                      meta=_AUTO_META, pod_info_get=None,
-                      device_class=_AUTO_META, eq_gen: int | None = None,
-                      vol=_AUTO_META, snap=None, vol_split=None,
-                      nominated=None, memo_checked=False, sibling_hit=None,
-                      out_snaps=None):
+                      meta: Any = _AUTO_META, pod_info_get: Any = None,
+                      device_class: Any = _AUTO_META,
+                      eq_gen: int | None = None,
+                      vol: Any = _AUTO_META, snap: Any = None,
+                      vol_split: Any = None,
+                      nominated: Any = None, memo_checked: bool = False,
+                      sibling_hit: Any = None,
+                      out_snaps: dict | None = None) -> tuple:
         """The full predicate chain against a point-in-time snapshot so
         concurrent watcher mutations of node usage cannot tear mid-fit.
         Order mirrors the reference providers: cheap node gates first, the
@@ -420,9 +425,11 @@ class GenericScheduler:
             sort_keys=True, default=str)
         return hashlib.sha256(f"{ann}|{res}".encode()).hexdigest()
 
-    def _run_predicates(self, kube_pod: dict, snap, meta=None,
-                        pod_info_get=None, device_class: str | None = None,
-                        vol=None):
+    def _run_predicates(self, kube_pod: dict, snap: Any,
+                        meta: Any = None,
+                        pod_info_get: Any = None,
+                        device_class: str | None = None,
+                        vol: Any = None) -> tuple:
         ctx = factory.PredicateContext(kube_pod, snap, meta, vol)
         for _name, pred in self.algorithm.predicates:
             ok, reasons = pred(ctx)
@@ -499,7 +506,7 @@ class GenericScheduler:
                 if ev is not None:
                     ev.set()
 
-    def find_nodes_that_fit(self, kube_pod: dict):
+    def find_nodes_that_fit(self, kube_pod: dict) -> tuple:
         """Parallel filter over all nodes (`generic_scheduler.go:310-383`),
         memoized per equivalence class, then extender callouts. The cycle
         snapshot (one lock acquisition for every node's snapshot + fit
@@ -601,7 +608,8 @@ class GenericScheduler:
         return feasible, failures, snaps, meta
 
     def prioritize_nodes(self, kube_pod: dict, feasible: dict,
-                         snaps: dict | None = None, meta=_AUTO_META) -> dict:
+                         snaps: dict | None = None,
+                         meta: Any = _AUTO_META) -> dict:
         """Map-reduce the configured priority functions over feasible nodes
         (`generic_scheduler.go:526-...`): stock priorities + the device
         score from the fit pass + extender scores, weighted-summed.
@@ -678,7 +686,7 @@ class GenericScheduler:
 
     OWNER_LIST_TTL_S = 2.0
 
-    def _owner_listings(self):
+    def _owner_listings(self) -> Any:
         """The four owner lists, TTL-cached: prioritizing a burst of N
         pods must not cost 4N list round-trips on a networked transport.
         A transient lister failure keeps serving the stale listing (and
@@ -705,7 +713,7 @@ class GenericScheduler:
         self._owner_cache = (now + self.OWNER_LIST_TTL_S, listings)
         return listings
 
-    def _owner_selectors(self, kube_pod: dict):
+    def _owner_selectors(self, kube_pod: dict) -> Any:
         """Selectors of the Services/RCs/RSs/StatefulSets selecting this
         pod, for SelectorSpreadPriority — or None when the API transport
         exposes no owner listers (standalone engines fall back to
@@ -769,7 +777,8 @@ class GenericScheduler:
         return not any(marker in reason for reason in reasons
                        for marker in cls.UNRESOLVABLE_MARKERS)
 
-    def preempt(self, kube_pod: dict, failures: dict | None = None):
+    def preempt(self, kube_pod: dict,
+                failures: dict | None = None) -> tuple | None:
         """Find the best node to preempt on. Victim selection per the
         reference: remove ALL lower-priority pods, verify fit, then
         reprieve victims — PDB-violating candidates first, then the rest,
@@ -840,7 +849,7 @@ class GenericScheduler:
         pod_info_get = self._pod_info_provider(kube_pod)
         device_class = self._device_class(kube_pod)
 
-        def eval_node(node_name):
+        def eval_node(node_name: str) -> tuple | None:
             snap = self.cache.snapshot_node(node_name)
             if snap is None:
                 return None
@@ -870,7 +879,7 @@ class GenericScheduler:
         labels = (pod.get("metadata") or {}).get("labels") or {}
         return all(labels.get(k) == v for k, v in selector.items())
 
-    def _pdb_state(self):
+    def _pdb_state(self) -> list:
         """Per-PDB disruption allowance, computed once per preemption pass:
         allowed = (bound pods matching the selector) - minAvailable. The
         reference reads pdb.Status.PodDisruptionsAllowed; here the status
@@ -917,7 +926,8 @@ class GenericScheduler:
         return state
 
     @staticmethod
-    def _split_by_pdb_violation(candidates: list, pdb_state: list):
+    def _split_by_pdb_violation(candidates: list,
+                                pdb_state: list) -> tuple:
         """Partition candidate victims into (violating, non_violating) the
         way upstream's filterPodsWithPDBViolation does: walk candidates
         highest-priority-first (then name for determinism) with a copy of
@@ -939,9 +949,10 @@ class GenericScheduler:
                 ok.append(pod)
         return violating, ok
 
-    def _fits_after_evictions(self, kube_pod, snap, meta, evicted: set,
-                              pod_info_get=None, vol=None,
-                              device_class=None):
+    def _fits_after_evictions(self, kube_pod: dict, snap: Any,
+                              meta: Any, evicted: set,
+                              pod_info_get: Any = None, vol: Any = None,
+                              device_class: Any = None) -> bool:
         """Full predicate chain against the mutated snapshot — taints,
         selectors, volume conflicts, inter-pod terms AND device fit — the
         reference's podFitsOnNode during preemption. A node where only
@@ -963,10 +974,12 @@ class GenericScheduler:
                                           pod_info_get, device_class, vol)
         return fits
 
-    def _victims_on_node(self, kube_pod, snap, prio, meta=None,
+    def _victims_on_node(self, kube_pod: dict, snap: Any, prio: int,
+                         meta: Any = None,
                          pdb_state: list | None = None,
                          pods_by_name: dict | None = None,
-                         pod_info_get=None, vol=None, device_class=None):
+                         pod_info_get: Any = None, vol: Any = None,
+                         device_class: Any = None) -> tuple | None:
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
         from kubegpu_tpu.scheduler.predicates import (pod_host_ports,
                                                       pod_volumes)
@@ -993,7 +1006,7 @@ class GenericScheduler:
             return None
         evicted: set = set()
 
-        def charge(pod, sign):
+        def charge(pod: dict, sign: int) -> None:
             """sign=-1 evicts (frees), +1 re-admits. Keeps the WHOLE
             snapshot consistent — core usage, device usage, ports, labels,
             volumes — because the full predicate chain reads all of it."""
@@ -1073,7 +1086,7 @@ class BindWorkerPool:
     item can never strand its pods: the catch-all runs ``on_crash``,
     which forgets the assumes and requeues — requeued, not lost."""
 
-    def __init__(self, workers: int = 4):
+    def __init__(self, workers: int = 4) -> None:
         self.workers = max(1, int(workers))
         self._cond = threading.Condition()
         self._items: deque = deque()  # (run, on_crash, submitted_at)
@@ -1081,7 +1094,8 @@ class BindWorkerPool:
         self._stopped = False
         self._threads: list = []
 
-    def submit(self, run, on_crash) -> bool:
+    def submit(self, run: Callable[[], None],
+               on_crash: Callable[[], None]) -> bool:
         """Queue a work item. Returns False (instead of raising) when the
         pool is stopped — a shutdown racing a cycle must let the caller
         run the item inline rather than strand an assumed pod."""
@@ -1170,13 +1184,15 @@ class Scheduler:
     # retry sees strictly more committed state.
     CONFLICT_RETRY_S = 0.05
 
-    def __init__(self, api, device_scheduler, bind_async: bool = False,
+    def __init__(self, api: Any, device_scheduler: Any,
+                 bind_async: bool = False,
                  parallelism: int = DEFAULT_PARALLELISM,
                  extenders: list | None = None,
                  priority_weights: dict | None = None,
                  algorithm: factory.AlgorithmConfig | None = None,
-                 bind_workers: int = 4, shard_owned=None,
-                 name: str | None = None):
+                 bind_workers: int = 4,
+                 shard_owned: Callable[[str], bool] | None = None,
+                 name: str | None = None) -> None:
         from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
 
         self.api = api
@@ -1579,7 +1595,7 @@ class Scheduler:
         return kube_pod["metadata"]["name"]
 
     def _submit_bind(self, kube_pod: dict, host: str, t0: float,
-                     parent=None) -> None:
+                     parent: Any = None) -> None:
         binder_ext = next((e for e in self.generic.extenders
                            if getattr(e, "bind_verb", None)), None)
         if binder_ext is not None:
@@ -1981,8 +1997,9 @@ class Scheduler:
                     raise
                 self._stop.wait(0.02 * (attempt + 1))
 
-    def _commit_gang(self, members: list, pinned_members: list, gang: int,
-                     t0: float, binder, attempts: int = 1) -> None:
+    def _commit_gang(self, members: list, pinned_members: list,
+                     gang: int, t0: float, binder: Any,
+                     attempts: int = 1) -> None:
         """The transport half of a gang commit: volume binds, then the
         atomic batch bind (or the delegated binder's per-member path).
         All members are already assumed; ANY failure forgets every
@@ -2159,7 +2176,7 @@ class Scheduler:
         return True
 
     def _try_gang_preempt(self, members: list, gang_prio: int,
-                          reserved: dict | None = None):
+                          reserved: dict | None = None) -> Any:
         """Slice defragmentation (VERDICT r4 #2): when no contiguous
         block is free for a gang, evict the CHEAPEST set of lower-
         priority pods whose chips complete one. Victim cost follows the
@@ -2220,7 +2237,7 @@ class Scheduler:
             return False
         pdb_state = self.generic._pdb_state()
 
-        def closure(victim_names) -> frozenset | None:
+        def closure(victim_names: frozenset) -> frozenset | None:
             """Expand victims to whole bound gangs: evicting one member
             of a running gang strands its siblings mid-collective, so
             the eviction unit is the gang. None = some closure member is
@@ -2234,7 +2251,7 @@ class Scheduler:
                 return None
             return frozenset(out)
 
-        def cost(victim_names: frozenset):
+        def cost(victim_names: frozenset) -> tuple | None:
             if not victim_names:
                 # strictly below EVERY real eviction set (priorities can
                 # be negative, so no 4-tuple sentinel is safely minimal;
@@ -2312,7 +2329,7 @@ class Scheduler:
         return self.volume_binder.assume(kube_pod, snap.kube_node)
 
     def _bind(self, kube_pod: dict, host: str, t0: float,
-              attempts: int = 1, parent=None) -> bool:
+              attempts: int = 1, parent: Any = None) -> bool:
         """Volumes first (the kubelet must find claims bound when the pod
         lands), then annotation, then the binding — the kubelet-side hook
         must see allocate_from the moment the pod lands

@@ -1,0 +1,39 @@
+"""resource-lifecycle BAD: acquired resources leak on four path shapes."""
+
+import socket
+import threading
+
+
+class LeakyTransport:
+    def __init__(self, log):
+        self.log = log
+
+    def connect_with_branch_leak(self, host, port, ok):
+        conn = socket.create_connection((host, port))
+        if not ok:
+            return None  # LEAK: the refusal path never closes the socket
+        data = conn.recv(64)
+        conn.close()
+        return data
+
+    def read_with_swallowing_handler(self, path):
+        fh = open(path, "rb")
+        try:
+            return fh.read()
+        except OSError:
+            # LEAK: the exception edge returns without closing the handle
+            self.log.warning("read failed")
+            return b""
+
+    def start_unjoined_worker(self, fn):
+        worker = threading.Thread(target=fn)
+        worker.start()
+        self.log.info("worker running")
+        # LEAK: a non-daemon thread is started and never joined
+
+    def watch_with_loop_leak(self, log, items):
+        sub = log.add_stream_subscriber(self.log.info)
+        for item in items:
+            if item is None:
+                return  # LEAK: leaves the loop with the subscriber live
+        sub.stop()

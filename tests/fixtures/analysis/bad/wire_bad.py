@@ -1,0 +1,129 @@
+"""wire-contract BAD: every paired wire surface has a one-sided hole.
+
+One module modeling both ends of a dual-wire transport: a route table
+(`_route_request`), a client (`_req` calls), a framed-stream layer
+(`_FRAME_TYPES` + send/dispatch), a tagged codec (`_T_*`), and the
+typed-error maps of two dispatch sites. Each surface is broken on
+exactly one side."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    pass
+
+
+# ---- frame types: BYE is sent but no reader ever dispatches on it ----------
+
+REQ = 1
+RESP = 2
+BYE = 3
+
+_FRAME_TYPES = frozenset({REQ, RESP, BYE})
+
+
+def send_frame(sock, ftype, payload):
+    sock.sendall(bytes([ftype]) + payload)
+
+
+def send_request(sock, payload):
+    send_frame(sock, REQ, payload)
+
+
+def send_response(sock, payload):
+    send_frame(sock, RESP, payload)
+
+
+def send_goodbye(sock):
+    send_frame(sock, BYE, b"")
+
+
+def read_loop(rfile, on_request, on_response):
+    while True:
+        ftype, payload = rfile.read_one()
+        if ftype == REQ:
+            on_request(payload)
+        elif ftype == RESP:
+            on_response(payload)
+        # BYE falls through: the peer that sends it poisons the stream
+
+
+# ---- codec tags: _T_BYTES is encoded but the decoder rejects it ------------
+
+_T_INT = 0x01
+_T_BYTES = 0x02
+
+
+def encode_value(buf, obj):
+    if isinstance(obj, int):
+        buf.append(_T_INT)
+        buf.append(obj)
+    else:
+        buf.append(_T_BYTES)
+        buf.extend(obj)
+
+
+def decode_value(data):
+    tag = data[0]
+    if tag == _T_INT:
+        return data[1]
+    raise ValueError(f"unknown tag {tag}")
+
+
+# ---- route table: /orphans served with no caller; client calls /frobs ------
+
+def _route_request(api, method, parts, query, body):
+    if parts and parts[0] == "orphans":
+        if method == "GET":
+            return 200, {"items": api.list_orphans()}
+    if parts and parts[0] == "pods":
+        if method == "GET":
+            return 200, {"items": api.list_pods()}
+        if method == "POST":
+            return 201, api.create_pod(body)
+    return 404, {"error": "no route"}
+
+
+# ---- error maps: the stream dispatcher forgot the Conflict mapping ---------
+
+def _serve_json(api, method, parts, query, body, send):
+    try:
+        send(*_route_request(api, method, parts, query, body))
+    except NotFound as e:
+        send(404, {"error": str(e)})
+    except Conflict as e:
+        send(409, {"error": str(e)})
+
+
+def _serve_stream(api, method, parts, query, body, send):
+    try:
+        send(*_route_request(api, method, parts, query, body))
+    except NotFound as e:
+        send(404, {"error": str(e)})
+    # MISSING: Conflict -> 409; on this wire a lost bind race comes
+    # back as a generic failure and the binder blind-retries
+
+
+class Client:
+    def __init__(self, transport):
+        self._transport = transport
+
+    def _req(self, method, path, body=None):
+        status, doc = self._transport(method, path, body)
+        if status == 404:
+            raise NotFound(doc)
+        if status == 409:
+            raise Conflict(doc)
+        return doc
+
+    def list_pods(self):
+        return self._req("GET", "/pods")["items"]
+
+    def create_pod(self, pod):
+        return self._req("POST", "/pods", pod)
+
+    def list_frobs(self):
+        # no server route serves /frobs on either wire
+        return self._req("GET", "/frobs")["items"]

@@ -1,0 +1,65 @@
+"""resource-lifecycle GOOD twin: every shape releases, hands off, or is
+daemon-exempt."""
+
+import socket
+import threading
+
+
+class PairedTransport:
+    def __init__(self, log):
+        self.log = log
+
+    def connect_with_branch_leak(self, host, port, ok):
+        conn = socket.create_connection((host, port))
+        if not ok:
+            conn.close()  # the refusal path releases before leaving
+            return None
+        data = conn.recv(64)
+        conn.close()
+        return data
+
+    def read_with_swallowing_handler(self, path):
+        fh = open(path, "rb")
+        try:
+            return fh.read()
+        except OSError:
+            self.log.warning("read failed")
+            return b""
+        finally:
+            fh.close()  # the finally covers normal AND exception edges
+
+    def read_with_context_manager(self, path):
+        with open(path, "rb") as fh:  # managed: released on every exit
+            return fh.read()
+
+    def start_daemon_worker(self, fn):
+        worker = threading.Thread(target=fn, daemon=True)
+        worker.start()  # daemon threads die with the process: exempt
+        self.log.info("worker running")
+
+    def start_and_join_worker(self, fn):
+        worker = threading.Thread(target=fn)
+        worker.start()
+        worker.join()  # joined on the only path out
+
+    def start_handed_off_worker(self, fn):
+        worker = threading.Thread(target=fn)
+        self._workers = worker  # ownership moved to the instance
+        worker.start()
+
+    def watch_with_loop_release(self, log, items):
+        sub = log.add_stream_subscriber(self.log.info)
+        while True:
+            item = self.log.next(items)
+            if item is None:
+                log.remove_stream_subscriber(sub)  # severed before exit
+                return
+            self.log.info(item)
+
+    def drain_all(self, conns):
+        conn = socket.create_connection(("127.0.0.1", 1))
+        try:
+            for other in conns:
+                self.log.info(other)
+        finally:
+            conn.close()

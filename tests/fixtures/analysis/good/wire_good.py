@@ -1,0 +1,112 @@
+"""wire-contract GOOD twin: every wire surface is paired on both sides."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    pass
+
+
+# ---- frame types: every registered type is sent AND dispatched -------------
+
+REQ = 1
+RESP = 2
+
+_FRAME_TYPES = frozenset({REQ, RESP})
+
+
+def send_frame(sock, ftype, payload):
+    sock.sendall(bytes([ftype]) + payload)
+
+
+def send_request(sock, payload):
+    send_frame(sock, REQ, payload)
+
+
+def send_response(sock, payload):
+    send_frame(sock, RESP, payload)
+
+
+def read_loop(rfile, on_request, on_response):
+    while True:
+        ftype, payload = rfile.read_one()
+        if ftype == REQ:
+            on_request(payload)
+        elif ftype == RESP:
+            on_response(payload)
+
+
+# ---- codec tags: both tags known to encoder AND decoder --------------------
+
+_T_INT = 0x01
+_T_BYTES = 0x02
+
+
+def encode_value(buf, obj):
+    if isinstance(obj, int):
+        buf.append(_T_INT)
+        buf.append(obj)
+    else:
+        buf.append(_T_BYTES)
+        buf.extend(obj)
+
+
+def decode_value(data):
+    tag = data[0]
+    if tag == _T_INT:
+        return data[1]
+    if tag == _T_BYTES:
+        return bytes(data[1:])
+    raise ValueError(f"unknown tag {tag}")
+
+
+# ---- route table: every served route has a caller, and vice versa ----------
+
+def _route_request(api, method, parts, query, body):
+    if parts and parts[0] == "pods":
+        if method == "GET":
+            return 200, {"items": api.list_pods()}
+        if method == "POST":
+            return 201, api.create_pod(body)
+    return 404, {"error": "no route"}
+
+
+# ---- error maps: both dispatch sites carry the full mapping set ------------
+
+def _serve_json(api, method, parts, query, body, send):
+    try:
+        send(*_route_request(api, method, parts, query, body))
+    except NotFound as e:
+        send(404, {"error": str(e)})
+    except Conflict as e:
+        send(409, {"error": str(e)})
+
+
+def _serve_stream(api, method, parts, query, body, send):
+    try:
+        send(*_route_request(api, method, parts, query, body))
+    except NotFound as e:
+        send(404, {"error": str(e)})
+    except Conflict as e:
+        send(409, {"error": str(e)})
+
+
+class Client:
+    def __init__(self, transport):
+        self._transport = transport
+
+    def _req(self, method, path, body=None):
+        status, doc = self._transport(method, path, body)
+        if status == 404:
+            raise NotFound(doc)
+        if status == 409:
+            raise Conflict(doc)
+        return doc
+
+    def list_pods(self):
+        return self._req("GET", "/pods")["items"]
+
+    def create_pod(self, pod):
+        return self._req("POST", "/pods", pod)

@@ -22,7 +22,8 @@ TESTS_DIR = os.path.join(REPO, "tests")
 
 RULES = ["lock-discipline", "no-blocking-under-lock", "transitive-locks",
          "monotonic-time", "codec-pairing", "no-swallowed-exceptions",
-         "metric-registration", "charge-pairing", "unused-suppression"]
+         "metric-registration", "charge-pairing", "resource-lifecycle",
+         "wire-contract", "unused-suppression"]
 
 
 # ---- static rules: bad fixtures flag, good twins pass ----------------------
@@ -135,6 +136,241 @@ def test_transitive_locks_accepts_locked_callers_of_locked_helpers(tmp_path):
     assert run_analysis([str(src)], select=["transitive-locks"]) == []
 
 
+# ---- the typestate rules on the dataflow engine ----------------------------
+
+def test_lifecycle_flags_all_four_path_shapes():
+    hits = findings_for(BAD, "resource-lifecycle")
+    msgs = " ".join(f.message for f in hits)
+    assert "socket is never closed" in msgs            # branch shape
+    assert "file handle is never closed" in msgs       # handler shape
+    assert "exception edge leaks the file" in msgs
+    assert "never joined" in msgs                      # thread shape
+    assert "never severed" in msgs                     # subscriber/loop shape
+    assert len(hits) == 5
+
+
+def test_lifecycle_good_twin_is_clean():
+    """Daemon threads, hand-offs, with-blocks, finally cleanup, and the
+    None-guarded remove all discharge the obligation."""
+    assert findings_for(GOOD, "resource-lifecycle") == []
+
+
+def test_wire_contract_flags_each_one_sided_surface():
+    hits = findings_for(BAD, "wire-contract")
+    msgs = " ".join(f.message for f in hits)
+    assert "no reader dispatches" in msgs              # frame type BYE
+    assert "no decoder handles it" in msgs             # _T_BYTES tag
+    assert "serves no /frobs route" in msgs            # missing route
+    assert "missing from dispatch site _serve_stream()" in msgs  # one-wire
+    assert "no client caller" in msgs                  # unconsumed route
+    assert len(hits) == 5
+
+
+def test_wire_contract_good_twin_is_clean():
+    assert findings_for(GOOD, "wire-contract") == []
+
+
+# ---- the dataflow engine itself ---------------------------------------------
+
+def _cfg_of(code):
+    import ast
+
+    from kubegpu_tpu.analysis import dataflow
+
+    fn = ast.parse(code).body[0]
+    return dataflow.build_cfg(fn), dataflow
+
+
+def test_cfg_if_has_branch_and_merge():
+    cfg, df = _cfg_of(
+        "def f(a):\n"
+        "    if a:\n"
+        "        x = 1\n"
+        "    y = 2\n")
+    if_node = [n for n in cfg.nodes if n.kind == "stmt"
+               and getattr(n.stmt, "lineno", 0) == 2][0]
+    succs = cfg.successors(if_node)
+    lines = sorted(getattr(n.stmt, "lineno", 0) for n in succs)
+    assert lines == [3, 4]  # then-branch and fall-through (merge at y)
+    y_node = [n for n in cfg.nodes if n.kind == "stmt"
+              and getattr(n.stmt, "lineno", 0) == 4][0]
+    assert len(cfg.preds[y_node.idx]) == 2  # the merge point
+
+
+def test_cfg_loop_has_back_and_skip_edges():
+    cfg, df = _cfg_of(
+        "def f(items):\n"
+        "    for i in items:\n"
+        "        use(i)\n"
+        "    done()\n")
+    header = [n for n in cfg.nodes if n.kind == "stmt"
+              and getattr(n.stmt, "lineno", 0) == 2][0]
+    kinds = {e.kind for e in cfg.succs[header.idx]}
+    assert df.SKIP in kinds          # zero-iteration edge
+    assert any(e.kind == df.BACK for e in cfg.preds[header.idx])
+
+
+def test_cfg_while_true_has_no_skip_edge():
+    cfg, df = _cfg_of(
+        "def f(q):\n"
+        "    while True:\n"
+        "        q.pop()\n")
+    header = [n for n in cfg.nodes if n.kind == "stmt"
+              and getattr(n.stmt, "lineno", 0) == 2][0]
+    assert not any(e.kind == df.SKIP for e in cfg.succs[header.idx])
+
+
+def test_cfg_try_statements_point_at_dispatch():
+    cfg, df = _cfg_of(
+        "def f(x):\n"
+        "    try:\n"
+        "        work(x)\n"
+        "    except ValueError:\n"
+        "        handle(x)\n")
+    work = [n for n in cfg.nodes if n.kind == "stmt"
+            and getattr(n.stmt, "lineno", 0) == 3][0]
+    except_edges = [e for e in cfg.succs[work.idx] if e.kind == df.EXCEPT]
+    assert len(except_edges) == 1
+    dispatch = cfg.nodes[except_edges[0].dst]
+    assert dispatch.kind == "dispatch"
+    handlers = [n for n in cfg.successors(dispatch) if n.kind == "handler"]
+    assert len(handlers) == 1
+
+
+def _leak(code, resolving=("release",), acquire="acquire"):
+    import ast
+
+    from kubegpu_tpu.analysis import dataflow as df
+
+    fn = ast.parse(code).body[0]
+    cfg = df.build_cfg(fn)
+
+    def releases(node):
+        calls = set()
+        for sub in node.effect_asts():
+            calls |= df.call_names(sub)
+        return bool(calls & set(resolving))
+
+    sites = df.stmt_sites(
+        cfg, lambda n: any(acquire in df.call_names(a)
+                           for a in n.effect_asts()))
+    assert len(sites) == 1
+    return df.may_leak(cfg, sites[0], releases)
+
+
+def test_mayleak_joins_at_merge_points():
+    """One branch releases, the other does not: the join must keep the
+    leaking state alive (set-union lattice, not intersection)."""
+    rep = _leak(
+        "def f(a):\n"
+        "    x = acquire()\n"
+        "    if a:\n"
+        "        release(x)\n"
+        "    done()\n")
+    assert rep.normal and not rep.handlers
+    rep = _leak(
+        "def f(a):\n"
+        "    x = acquire()\n"
+        "    if a:\n"
+        "        release(x)\n"
+        "    else:\n"
+        "        release(x)\n"
+        "    done()\n")
+    assert rep.clean()  # both arms release: the join is clean
+
+
+def test_mayleak_attributes_handler_edges():
+    rep = _leak(
+        "def f():\n"
+        "    try:\n"
+        "        x = acquire()\n"
+        "        use(x)\n"
+        "        release(x)\n"
+        "    except Exception:\n"
+        "        log()\n")
+    assert not rep.normal
+    assert [h.lineno for h in rep.handlers] == [6]
+
+
+def test_mayleak_canonical_loop_cleanup_is_clean():
+    rep = _leak(
+        "def f(assumed):\n"
+        "    acquire()\n"
+        "    for p in assumed:\n"
+        "        release(p)\n")
+    assert rep.clean()
+
+
+def test_mayleak_releasing_finally_covers_every_path():
+    rep = _leak(
+        "def f(a):\n"
+        "    x = acquire()\n"
+        "    try:\n"
+        "        if a:\n"
+        "            return\n"
+        "        use(x)\n"
+        "    finally:\n"
+        "        release(x)\n")
+    assert rep.clean()
+
+
+def test_mayleak_else_block_is_not_covered_by_its_own_handlers():
+    """Python's try/else runs only after the body completed without
+    raising, and its exceptions are NOT caught by this try's handlers —
+    a resource acquired and released entirely inside the else block
+    must not be charged to those handlers."""
+    rep = _leak(
+        "def f(p):\n"
+        "    try:\n"
+        "        check()\n"
+        "    except ValueError:\n"
+        "        log()\n"
+        "        return\n"
+        "    else:\n"
+        "        x = acquire()\n"
+        "        use(x)\n"
+        "        release(x)\n")
+    assert rep.clean()
+
+
+def test_charge_same_statement_resolve_still_owes_its_handlers(tmp_path):
+    """`resolve_it(cache.assume_pod(p))` resolves on the normal path,
+    but if the resolver raises AFTER the assume landed, a swallowing
+    handler still leaks the charge — the PR 8 contract the port must
+    keep."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "class C:\n"
+        "    def f(self, cache, p):\n"
+        "        try:\n"
+        "            self.resolve_it(cache.assume_pod(p))\n"
+        "        except Exception:\n"
+        "            self.log()\n"
+        "    def resolve_it(self, x):\n"
+        "        self.cache.confirm_pod(x)\n")
+    hits = run_analysis([str(src)], select=["charge-pairing"])
+    assert len(hits) == 1 and "exception edge" in hits[0].message
+
+
+def test_callgraph_closure_follows_helpers():
+    import ast
+
+    from kubegpu_tpu.analysis import dataflow as df
+
+    tree = ast.parse(
+        "def leaf():\n"
+        "    release()\n"
+        "def mid():\n"
+        "    leaf()\n"
+        "def top():\n"
+        "    mid()\n"
+        "def unrelated():\n"
+        "    other()\n")
+    closure = df.CallGraph([tree]).closure({"release"})
+    assert {"release", "leaf", "mid", "top"} <= closure
+    assert "unrelated" not in closure
+
+
 # ---- the suppression audit --------------------------------------------------
 
 def test_stale_suppression_is_a_finding_when_its_rule_runs():
@@ -193,6 +429,44 @@ def test_format_json_matches_legacy_json_flag():
     findings = json.loads(a.stdout)
     assert findings and {"rule", "path", "line", "message"} <= \
         set(findings[0])
+
+
+def test_sarif_driver_advertises_every_rule_even_when_clean():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.analysis", "--format", "sarif",
+         os.path.join("tests", "fixtures", "analysis", "good")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert set(RULES) <= rule_ids  # metadata survives a clean run
+
+
+def test_rule_flag_selects_like_select():
+    argv = [sys.executable, "-m", "kubegpu_tpu.analysis",
+            os.path.join("tests", "fixtures", "analysis", "bad")]
+    a = subprocess.run(argv + ["--rule", "wire-contract",
+                               "--rule", "resource-lifecycle"],
+                       cwd=REPO, capture_output=True, text=True, timeout=120)
+    b = subprocess.run(argv + ["--select",
+                               "wire-contract,resource-lifecycle"],
+                       cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert a.stdout == b.stdout
+    assert a.returncode == b.returncode == 1
+
+
+def test_stats_report_and_budget_gate():
+    argv = [sys.executable, "-m", "kubegpu_tpu.analysis", "--stats",
+            os.path.join("tests", "fixtures", "analysis", "good")]
+    ok = subprocess.run(argv + ["--budget-s", "300"], cwd=REPO,
+                        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "analysis stats:" in ok.stderr
+    assert "resource-lifecycle" in ok.stderr  # per-rule timings listed
+    blown = subprocess.run(argv + ["--budget-s", "0.000001"], cwd=REPO,
+                           capture_output=True, text=True, timeout=120)
+    assert blown.returncode == 3
+    assert "over the" in blown.stderr
 
 
 # ---- the meta-test: the real tree is clean ---------------------------------

@@ -1,0 +1,159 @@
+"""Regression tests for the true positives the resource-lifecycle work
+surfaced — each one pins the FIXED behavior:
+
+* ``StreamConn.close()`` releases the OS fd (the makefile reader held
+  an io-ref that kept it open past ``sock.close()``),
+* ``HTTPAPIClient.close()`` leaves no live watch thread and refuses to
+  re-dial (the watch loop caught mid-poll used to open a FRESH
+  connection after close and long-poll for up to 30 more seconds),
+* ``serve_api(...).shutdown()`` releases the listening port, closes the
+  WAL handle, and joins the stream fan-out's pump/writer threads,
+* ``node_agent._primary_address`` closes its UDP probe on the error
+  edge (the probe leaked when ``connect`` raised).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+from kubegpu_tpu.cluster import stream
+from kubegpu_tpu.cluster.wal import WriteAheadLog
+from kubegpu_tpu.cmd import node_agent
+
+
+def wait_for(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+@pytest.fixture()
+def server():
+    api = InMemoryAPIServer()
+    srv, url = serve_api(api)
+    yield api, srv, url
+    srv.shutdown()
+
+
+def test_streamconn_close_releases_the_fd(server):
+    _api, _srv, url = server
+    conn = stream.StreamConn.connect(url, timeout=5.0)
+    fd = conn._sock.fileno()
+    assert fd != -1
+    conn.close()
+    # the socket AND its buffered reader are closed: the fd is gone
+    # immediately, not whenever GC collects the reader
+    assert conn._sock.fileno() == -1
+    assert conn._rfile.closed
+
+
+def test_client_close_kills_watch_thread_and_refuses_redial(server):
+    api, _srv, url = server
+    client = HTTPAPIClient(url, wire="json")
+    seen = []
+    client.add_watcher(lambda kind, event, obj: seen.append(event))
+    api.create_node({"metadata": {"name": "n1"}})
+    assert wait_for(lambda: seen)
+    watcher = client._watch_thread
+    assert watcher is not None and watcher.is_alive()
+    client.close()
+    # close() joins the informer: a "closed" client has no live threads
+    assert not watcher.is_alive()
+    # ...and a closed client must not quietly open fresh connections
+    with pytest.raises(ConnectionError):
+        client.get_node("n1")
+    assert client._conns == set() and client._stream_conns == set()
+
+
+def test_client_close_kills_stream_watch_session(server):
+    api, _srv, url = server
+    client = HTTPAPIClient(url, wire="stream")
+    seen = []
+    client.add_watcher(lambda kind, event, obj: seen.append(event))
+    api.create_node({"metadata": {"name": "n1"}})
+    assert wait_for(lambda: seen)
+    watcher = client._watch_thread
+    client.close()
+    assert watcher is not None and not watcher.is_alive()
+    with pytest.raises(ConnectionError):
+        client.list_nodes()
+
+
+def test_server_shutdown_releases_port_joins_fanout_and_closes_wal(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), fsync=False)
+    api = InMemoryAPIServer()
+    srv, url = serve_api(api, wal=wal)
+    client = HTTPAPIClient(url, wire="stream")
+    seen = []
+    client.add_watcher(lambda kind, event, obj: seen.append(event))
+    api.create_node({"metadata": {"name": "n1"}})
+    assert wait_for(lambda: seen)
+    host, port = url.split("//")[1].split(":")
+    client.close()
+    before = {t.name for t in threading.enumerate() if t.is_alive()}
+    assert "watch-fanout" in before  # the pump was running
+    srv.shutdown()
+    # the WAL handle is closed, not left to the process exit
+    assert wal._fh is None
+    # the fan-out pump and subscriber writers are joined, not abandoned
+    assert wait_for(lambda: not any(
+        t.name in ("watch-fanout", "watch-push")
+        for t in threading.enumerate() if t.is_alive()))
+    # and the port is actually free again — shutdown() means STOPPED
+    probe = socket.socket()
+    probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        probe.bind((host, int(port)))
+    finally:
+        probe.close()
+
+
+def test_primary_address_closes_probe_on_error_edge(monkeypatch):
+    created = []
+
+    class FakeSock:
+        def __init__(self, *a, **k):
+            self.closed = False
+            created.append(self)
+
+        def connect(self, addr):
+            raise OSError("unreachable")
+
+        def getsockname(self):  # pragma: no cover - not reached
+            return ("203.0.113.7", 0)
+
+        def close(self):
+            self.closed = True
+
+    monkeypatch.setattr(node_agent.socket, "socket", FakeSock)
+    assert node_agent._primary_address() is None
+    assert created and all(s.closed for s in created)
+
+
+def test_primary_address_closes_probe_on_success(monkeypatch):
+    created = []
+
+    class FakeSock:
+        def __init__(self, *a, **k):
+            self.closed = False
+            created.append(self)
+
+        def connect(self, addr):
+            pass
+
+        def getsockname(self):
+            return ("203.0.113.7", 0)
+
+        def close(self):
+            self.closed = True
+
+    monkeypatch.setattr(node_agent.socket, "socket", FakeSock)
+    assert node_agent._primary_address() == "203.0.113.7"
+    assert created and all(s.closed for s in created)
