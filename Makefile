@@ -7,17 +7,23 @@
 
 PY ?= python
 
-.PHONY: check analyze lint type test rules
+.PHONY: check analyze lint type test rules report
 
 check: analyze lint type test
 
 # project-native invariants: lock discipline, monotonic clocks, codec
 # pairing, swallowed exceptions, metric registry, charge pairing,
-# resource lifecycle, wire contracts (exit 1 on findings; exit 3 when
-# the dataflow pass blows the wall-clock budget — a perf regression in
+# resource lifecycle, wire contracts, interprocedural lockset races,
+# hot-path purity contracts (exit 1 on findings; exit 3 when the
+# dataflow pass blows the wall-clock budget — a perf regression in
 # the analyzer itself is a finding too)
 analyze:
 	$(PY) -m kubegpu_tpu.analysis --stats --budget-s 120 kubegpu_tpu
+
+# the ranked vectorization-blockers inventory for the hot-path closure
+# (the worklist the vectorized-core refactor burns down)
+report:
+	$(PY) -m kubegpu_tpu.analysis --rule hot-path --report kubegpu_tpu
 
 rules:
 	$(PY) -m kubegpu_tpu.analysis --list-rules

@@ -124,11 +124,14 @@ class _GrpAllocator:
         return c
 
     def _take(self, other: "_GrpAllocator") -> None:
-        """Adopt another allocator's state (`grpallocate.go:125-130`)."""
-        self.allocate_from = other.allocate_from
-        self.pod_resource = other.pod_resource
-        self.node_resource = other.node_resource
-        self.score = other.score
+        """Adopt another allocator's state (`grpallocate.go:125-130`).
+        Search-private: each fit worker builds, mutates, and discards
+        its own allocator inside one ``pod_fits_resources`` call —
+        instances never cross threads."""
+        self.allocate_from = other.allocate_from    # racer: single-writer
+        self.pod_resource = other.pod_resource      # racer: single-writer
+        self.node_resource = other.node_resource    # racer: single-writer
+        self.score = other.score                    # racer: single-writer
 
     def _reset_resources(self, saved: "_GrpAllocator") -> None:
         """Restore usage/score but keep allocate_from (`grpallocate.go:132-136`)."""
@@ -276,6 +279,7 @@ class _GrpAllocator:
         if not self.grp_required:
             return True, []
 
+        # racer: single-writer -- search-private allocator state (see _take)
         subgrps_req, self.is_req_subgrp = _find_subgroups(self.req_base, self.grp_required)
 
         best: _GrpAllocator | None = None
@@ -527,6 +531,8 @@ def _native_pod_fits(node: NodeInfo, pod: PodInfo, allocating: bool):
         metrics.NATIVE_FALLBACKS.inc()
         global _native_fallback_logged
         if not _native_fallback_logged:
+            # racer: single-writer -- log-once latch: racing writers all
+            # store True, atomically under the GIL
             _native_fallback_logged = True
             import logging
             logging.getLogger(__name__).exception(

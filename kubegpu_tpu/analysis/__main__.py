@@ -111,6 +111,10 @@ def main(argv: list | None = None) -> int:
                              "with --select)")
     parser.add_argument("--stats", action="store_true",
                         help="print the per-rule timing report")
+    parser.add_argument("--report", action="store_true",
+                        help="print rule side-reports (the hot-path "
+                             "rule's ranked vectorization-blockers "
+                             "inventory) after the findings")
     parser.add_argument("--budget-s", type=float, default=None,
                         metavar="SECONDS",
                         help="exit 3 when the full analysis exceeds this "
@@ -147,9 +151,11 @@ def main(argv: list | None = None) -> int:
     fmt = "json" if args.as_json else args.fmt
 
     stats: dict = {}
+    reports: dict = {}
     try:
         findings = run_analysis(paths, select=select or None,
-                                tests_dir=tests_dir, stats=stats)
+                                tests_dir=tests_dir, stats=stats,
+                                reports=reports)
     except AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -160,6 +166,14 @@ def main(argv: list | None = None) -> int:
             fh.write(report + "\n")
     else:
         print(report)
+    if args.report:
+        from kubegpu_tpu.analysis.rules.racer import render_report
+
+        if "hot-path" in reports:
+            print(render_report(reports["hot-path"]))
+        else:
+            print("no side-reports (run with --rule hot-path)",
+                  file=sys.stderr)
     if args.stats:
         print(render_stats(stats), file=sys.stderr)
     if args.budget_s is not None and stats["total_s"] > args.budget_s:

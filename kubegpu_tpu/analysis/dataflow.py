@@ -179,9 +179,11 @@ class LoopInfo:
 class ControlFlowGraph:
     def __init__(self, fn: ast.AST) -> None:
         self.fn = fn
-        self.nodes: List[Node] = []
-        self.succs: Dict[int, List[Edge]] = {}
-        self.preds: Dict[int, List[Edge]] = {}
+        # builder-private: a CFG is built and then read by one analysis
+        # thread; instances never cross threads
+        self.nodes: List[Node] = []                 # racer: single-writer
+        self.succs: Dict[int, List[Edge]] = {}      # racer: single-writer
+        self.preds: Dict[int, List[Edge]] = {}      # racer: single-writer
         self.stmt_nodes: Dict[int, Node] = {}  # id(ast stmt) -> header node
         self.loops: List[LoopInfo] = []
         self.entry = self._new("entry")

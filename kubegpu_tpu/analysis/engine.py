@@ -143,6 +143,9 @@ class Context:
         self.tests_dir = tests_dir
         self.ran_rules: set = set()
         self.known_rules: set = set()
+        # rule name -> structured side-report (the hot-path rule's ranked
+        # vectorization-blockers inventory rides here; --report renders it)
+        self.reports: dict = {}
 
 
 def _collect_files(root: str) -> list:
@@ -187,7 +190,8 @@ def all_rules() -> list:
 
 def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
                  tests_dir: str | None = None,
-                 stats: dict | None = None) -> list:
+                 stats: dict | None = None,
+                 reports: dict | None = None) -> list:
     """Run the (selected) rules over ``roots``; returns findings sorted by
     location, with suppressed findings already dropped. When ``stats``
     is a dict it is filled with the timing report ``--stats`` prints:
@@ -227,6 +231,8 @@ def run_analysis(roots: Sequence[str], select: Iterable[str] | None = None,
             findings.append(finding)
         rule_times[rule.name] = time.perf_counter() - t_rule
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if reports is not None:
+        reports.update(ctx.reports)
     if stats is not None:
         stats["files"] = len(sources)
         stats["parse_s"] = parse_s

@@ -165,6 +165,8 @@ class VThread:
 
     def _wait_turn(self) -> None:
         self._event.wait()
+        # racer: single-writer -- explorer token-passing: at most one
+        # thread runs between sync points
         self._event.clear()
         if self.ctl._aborting:
             raise _Abort()
@@ -286,6 +288,8 @@ class CoopLock:
     def _release_all(self, vt: VThread) -> int:
         if self.owner is not vt:
             raise RuntimeError(f"wait() on un-owned {self.label}")
+        # racer: single-writer -- explorer token-passing: at most one
+        # thread runs between sync points
         depth, self.depth, self.owner = self.depth, 0, None
         vt.ctl.wake_lock_waiters(self)
         return depth
@@ -340,6 +344,8 @@ class CoopCondition:
             return False
         ctl = vt.ctl
         depth = self._lock._release_all(vt)
+        # racer: single-writer -- explorer token-passing: at most one
+        # thread runs between sync points
         self._waiters.append(vt)
         deadline = ctl.clock + timeout if timeout is not None else None
         reason = ctl.yield_blocked(vt, None, deadline,
@@ -538,12 +544,15 @@ class Controller:
     # -- the run --------------------------------------------------------------
 
     def run(self, bodies: Sequence[Callable[[], object]]) -> RunRecord:
-        self.bodies_live = True
-        self.threads = [VThread(i, fn, self) for i, fn in enumerate(bodies)]
+        # controller state below is written by the exploring thread and,
+        # between sync points, by exactly one token-holding VThread
+        self.bodies_live = True     # racer: single-writer
+        self.threads = [VThread(i, fn, self)  # racer: single-writer
+                        for i, fn in enumerate(bodies)]
         try:
             self._loop()
         except PruneRun:
-            self.record.pruned = True
+            self.record.pruned = True  # racer: single-writer
         finally:
             self._teardown()
             self.bodies_live = False
@@ -576,15 +585,16 @@ class Controller:
                 step, chosen_tid, chosen.next_op, tuple(cands),
                 self._last, preempt))
             self._switch_to(chosen)
+            # racer: single-writer -- exploring-thread-owned cursor
             self._last = chosen_tid if chosen.state != DONE else None
             step += 1
 
     def _switch_to(self, vt: VThread) -> None:
-        self._current = vt
-        self._token.clear()
+        self._current = vt    # racer: single-writer -- token protocol
+        self._token.clear()   # racer: single-writer -- token protocol
         vt._resume()
         if not self._token.wait(self.watchdog_s):
-            self._aborting = True
+            self._aborting = True  # racer: single-writer -- abort latch
             raise ExploreError(
                 f"schedule wedged: thread {vt.tid} did not reach a sync "
                 f"point within {self.watchdog_s}s — a non-cooperative "
@@ -595,6 +605,7 @@ class Controller:
                      if t.state == BLOCKED and t.deadline is not None]
         if not deadlines:
             return False
+        # racer: single-writer -- advanced only when every thread blocks
         self.clock = max(self.clock, min(deadlines))
         for t in self.threads:
             if t.state == BLOCKED and t.deadline is not None \

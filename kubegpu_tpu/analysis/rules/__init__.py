@@ -12,6 +12,7 @@ from kubegpu_tpu.analysis.rules.locks import (LockDiscipline,
                                               NoBlockingUnderLock,
                                               TransitiveLockDiscipline)
 from kubegpu_tpu.analysis.rules.metricsrule import MetricRegistration
+from kubegpu_tpu.analysis.rules.racer import HotPathPurity, Racer
 from kubegpu_tpu.analysis.rules.suppressions import UnusedSuppression
 from kubegpu_tpu.analysis.rules.wire import WireContract
 
@@ -26,6 +27,8 @@ ALL_RULES = [
     ChargePairing(),
     ResourceLifecycle(),
     WireContract(),
+    Racer(),
+    HotPathPurity(),
     # always ordered last by the engine: it audits what the others used
     UnusedSuppression(),
 ]

@@ -1016,11 +1016,15 @@ class HTTPAPIClient:
         self._relist_listeners: list = []
         self._watch_thread = None
         self._stop = threading.Event()
+        # racer: single-writer -- threading.local: each thread writes
+        # only its own slot by construction
         self._local = threading.local()  # per-thread keep-alive connection
         self._conn_lock = threading.Lock()
         self._conns: set = set()  # every live connection, for close()
         self._stream_conns: set = set()  # live framed conns, for close()
-        self.retry_count = 0   # transport-level retries performed
+        # transport-level retries performed; bumped under _conn_lock —
+        # every thread with a keep-alive connection retries through here
+        self.retry_count = 0
         self.watch_errors = 0  # failed watch polls survived
         self.relist_count = 0  # watch resume gaps that forced a relist
 
@@ -1118,6 +1122,8 @@ class HTTPAPIClient:
                 logging.getLogger(__name__).info(
                     "server at %s has no stream wire; negotiated down "
                     "to json", self.base_url)
+                # racer: single-writer -- one-way latch: every racing
+                # writer stores the same constant, atomically under the GIL
                 self.wire = stream.WIRE_JSON
         data = json.dumps(body).encode() if body is not None else None
         status, payload = self._roundtrip(method, path, data, timeout)
@@ -1127,6 +1133,15 @@ class HTTPAPIClient:
         except ValueError:
             doc = {"error": text}
         return status, doc
+
+    def _count_retry(self) -> None:
+        """Count one transport retry, guarded: every thread with a
+        keep-alive connection funnels through this counter, and an
+        unguarded ``+=`` from N concurrent retriers loses updates (the
+        racer rule's first true positive in this file)."""
+        probe("httpapi.count_retry")
+        with self._conn_lock:
+            self.retry_count += 1
 
     def _req(self, method: str, path: str, body=None, timeout=None):
         """One API round trip. Idempotent verbs retry transient transport
@@ -1144,7 +1159,7 @@ class HTTPAPIClient:
                     ConnectionError, TimeoutError, OSError):
                 if attempt + 1 >= attempts:
                     raise
-                self.retry_count += 1
+                self._count_retry()
                 backoff = min(self.RETRY_CAP_S,
                               self.RETRY_BASE_S * 2 ** attempt)
                 # jitter so a fleet of clients doesn't resend in lockstep

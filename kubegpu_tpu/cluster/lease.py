@@ -168,13 +168,11 @@ class Elector:
             was = self._leading
             self._leading = granted
         if granted and not was:
-            metrics.LEASE_TRANSITIONS.inc()
-            self.transitions += 1
+            self._count_transition()
             log.info("lease %s: %s became holder", self.name, self.holder)
             self._fire(self._on_acquire)
         elif not granted and was:
-            metrics.LEASE_TRANSITIONS.inc()
-            self.transitions += 1
+            self._count_transition()
             log.info("lease %s: %s lost the lease", self.name, self.holder)
             # losing a held lease mid-run is an anomaly worth evidence
             # (who was scheduling what when leadership moved); the
@@ -183,6 +181,16 @@ class Elector:
                                holder=self.holder)
             self._fire(self._on_lose)
         return granted
+
+    def _count_transition(self) -> None:
+        """Count one leadership transition, guarded: ``stop()`` runs on
+        the owner thread while ``tick()`` may still be finishing a
+        renewal on the elector thread — an unguarded ``+=`` between them
+        loses updates (a racer-rule true positive)."""
+        probe("lease.count_transition")
+        metrics.LEASE_TRANSITIONS.inc()
+        with self._lock:
+            self.transitions += 1
 
     @staticmethod
     def _fire(callback: Optional[Callable[[], None]]) -> None:
@@ -206,7 +214,9 @@ class Elector:
                     log.exception("elector tick failed")
                 self._stop.wait(interval)
 
+        # racer: single-writer -- start()/stop() are owner-thread calls
         self._stop = threading.Event()
+        # racer: single-writer -- stop() joins the loop before clearing
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"elector-{self.name}")
         self._thread.start()
@@ -222,8 +232,7 @@ class Elector:
             was = self._leading
             self._leading = False
         if demote and was:
-            metrics.LEASE_TRANSITIONS.inc()
-            self.transitions += 1
+            self._count_transition()
             self._fire(self._on_lose)
 
 
@@ -320,7 +329,9 @@ class ShardCoordinator:
                     log.exception("shard coordinator tick failed")
                 self._stop.wait(interval)
 
+        # racer: single-writer -- start()/stop() are owner-thread calls
         self._stop = threading.Event()
+        # racer: single-writer -- stop() joins the loop before clearing
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"shard-coord-{self.shard}")
         self._thread.start()

@@ -165,7 +165,11 @@ class StreamConn:
         self._sock = sock
         self._rfile = sock.makefile("rb")
         self._wlock = threading.Lock()
+        # racer: single-writer -- a StreamConn serves one requesting
+        # thread at a time (per-thread keep-alive contract)
         self._rid = 0
+        # racer: single-writer -- one-way latch: close() may race the
+        # owner but every writer stores True
         self.closed = False
 
     @classmethod

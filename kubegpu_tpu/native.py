@@ -43,6 +43,8 @@ def get_lib():
         return None
     if _lib_tried:
         return _lib
+    # racer: single-writer -- idempotent lazy-init latch under the GIL;
+    # a racing duplicate load resolves to the same library
     _lib_tried = True
     if not os.path.exists(LIB_PATH):
         return None
@@ -77,9 +79,10 @@ def get_lib():
             lib.dl_last_error.restype = ctypes.c_char_p
         except AttributeError:
             pass  # stale library without the data loader
+        # racer: single-writer -- idempotent lazy init (see _lib_tried)
         _lib = lib
     except OSError:
-        _lib = None
+        _lib = None  # racer: single-writer -- idempotent lazy init
     return _lib
 
 

@@ -65,8 +65,9 @@ class DeviceAdvertiser:
             meta.setdefault("annotations", {})[
                 codec.NODE_ADDRESS_ANNOTATION] = self.address
         self.client.patch_node_metadata(self.node_name, meta)
-        self.patch_count += 1
-        self.last_success_monotonic = time.monotonic()
+        # the advertise loop is the only writer; healthz only reads
+        self.patch_count += 1  # racer: single-writer
+        self.last_success_monotonic = time.monotonic()  # racer: single-writer
 
     def healthy(self, now: float | None = None) -> bool:
         """The node agent's /healthz signal: unhealthy until the first
@@ -84,8 +85,9 @@ class DeviceAdvertiser:
               retry_s: float = DEFAULT_RETRY_S) -> None:
         """Run the advertise loop in a daemon thread
         (`advertise_device.go:120-133`)."""
+        # racer: single-writer -- start()/stop() are owner-thread calls
         self._interval_s = interval_s
-        self._retry_s = retry_s
+        self._retry_s = retry_s  # racer: single-writer -- ditto
 
         def loop():
             while not self._stop.is_set():
@@ -103,6 +105,7 @@ class DeviceAdvertiser:
                     wait = retry_s
                 self._stop.wait(wait)
 
+        # racer: single-writer -- stop() joins the loop before clearing
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"advertiser-{self.node_name}")
         self._thread.start()

@@ -64,6 +64,8 @@ class FakeTPUBackend(TPUBackend):
         self._health: dict = {}
 
     def enumerate(self) -> TPUInventory:
+        # racer: single-writer -- test-observability counter; the
+        # advertise loop is the only live writer
         self.enumerate_calls += 1
         if self.fail:
             raise RuntimeError("fake libtpu enumeration failure")

@@ -58,15 +58,17 @@ class TPUDeviceManager:
         try:
             self._refresh()
         except Exception:
-            self.inventory = None
+            self.inventory = None  # racer: single-writer -- see _refresh
 
     def _refresh(self) -> None:
+        # discovery state is owned by the node agent's advertise loop
+        # (start() runs before the loop exists); peers only read
         inv = self.backend.enumerate()
-        self.inventory = inv
+        self.inventory = inv     # racer: single-writer
         dims = inv.mesh_dims if all(inv.mesh_dims) else (1, 1, 1)
-        self.mesh = ICIMesh(dims, inv.mesh_wrap)
+        self.mesh = ICIMesh(dims, inv.mesh_wrap)  # racer: single-writer
         try:
-            self.health = dict(self.backend.chip_health() or {})
+            self.health = dict(self.backend.chip_health() or {})  # racer: single-writer
         except Exception:
             # health telemetry is advisory: a broken probe must not take
             # the whole inventory down with it
@@ -182,8 +184,9 @@ class DevicesManager:
 
     def add_device(self, device) -> None:
         name = device.get_name()  # probe before mutating (atomic register)
-        self.devices.append(device)
-        self.operational[name] = False
+        # registration happens during single-threaded agent startup
+        self.devices.append(device)      # racer: single-writer
+        self.operational[name] = False   # racer: single-writer
 
     def add_devices_from_plugins(self, directory: str) -> int:
         """Load device plugins from a directory (`devicemanager.go:46-77`,

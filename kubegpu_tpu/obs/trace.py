@@ -101,10 +101,12 @@ class Span:
         """End the span (idempotent) and record it."""
         if self._done:
             return self
-        self._done = True
-        self.dur_s = time.perf_counter() - self._t0
+        # a span is finished by the thread that opened it; the recorder
+        # ring beyond this point has its own lock
+        self._done = True   # racer: single-writer
+        self.dur_s = time.perf_counter() - self._t0  # racer: single-writer
         if attrs:
-            self.attrs.update(attrs)
+            self.attrs.update(attrs)  # racer: single-writer
         self._recorder.record(self)
         return self
 

@@ -645,6 +645,7 @@ class GenericScheduler:
         (`generic_scheduler.go:204-223`)."""
         best = max(scored.values())
         top = sorted(n for n, s in scored.items() if s == best)
+        # racer: single-writer -- scheduling-thread-owned round-robin cursor
         self._last_node_index += 1
         return top[self._last_node_index % len(top)]
 
@@ -710,6 +711,8 @@ class GenericScheduler:
                     "owner listers failed; keeping previous listing",
                     exc_info=True)
                 listings = cached[1] if cached is not None else None
+        # racer: single-writer -- TTL cache rebuilt on the scheduling
+        # thread (priorities run serially); peers only read
         self._owner_cache = (now + self.OWNER_LIST_TTL_S, listings)
         return listings
 
@@ -1198,6 +1201,8 @@ class Scheduler:
         self.api = api
         self.device_scheduler = device_scheduler
         self.cache = SchedulerCache(device_scheduler)
+        # guarded-by: SchedulingQueue._lock -- the queue is a monitor:
+        # every mutator takes its own condition lock internally
         self.queue = SchedulingQueue()
         # span identity: which scheduler replica a trace row belongs to
         # (an HA run puts several engines over one apiserver — their
@@ -1214,6 +1219,7 @@ class Scheduler:
         self.generic.obs_name = self.obs_name
         self.volume_binder = VolumeBinder(api)
         self.generic.volume_binder = self.volume_binder
+        # guarded-by: GangBuffer._lock -- monitor object, internally locked
         self.gang_buffer = GangBuffer()
         self.gang_planner = GangPlanner(self.cache)
         self.bind_async = bind_async

@@ -35,6 +35,7 @@ import threading
 import time
 
 from kubegpu_tpu import metrics, obs
+from kubegpu_tpu.analysis.explore import probe
 from kubegpu_tpu.cluster.apiserver import Conflict
 from kubegpu_tpu.core import codec
 from kubegpu_tpu.utils import list_bound_pods
@@ -107,6 +108,8 @@ class NodeLifecycle:
         # only), and a wall-clock step here would age every node at once
         # and mass-evict a healthy cluster.
         self.clock = clock if clock is not None else time.monotonic
+        # racer: single-writer -- tick() owns this; written on the loop
+        # thread only (stop() joins the loop before reading leftovers)
         self.states: dict = {}   # node name -> READY/STALE/LOST
         # Heartbeat observations: node -> (last heartbeat VALUE, when
         # this controller first saw that value, by its own clock). Aging
@@ -117,12 +120,22 @@ class NodeLifecycle:
         # controller must observe a heartbeat stand still for the full
         # grace period before declaring the node Lost (no mass eviction
         # on scheduler restart).
+        # racer: single-writer -- tick()-thread-owned heartbeat ledger
         self._observed: dict = {}
         # Lost nodes whose eviction has not finished draining. A deleted
         # node disappears from list_nodes, so without this set a single
         # failed pod-list during its one LOST tick would strand its pods
         # bound to a nonexistent node forever.
+        # racer: single-writer -- tick()-thread-owned drain set
         self._draining: set = set()
+        # The pending-retry ledgers and the eviction counter are shared
+        # between the tick loop and stop()'s last-chance drain — the
+        # join in stop() is TIMED, so a wedged tick can still be
+        # flushing while stop() drains (the racer rule's finding here):
+        # every mutation holds _pending_lock, and _flush_pending_requeues
+        # CLAIMS its batch under it so each replacement is created (and
+        # counted) exactly once no matter how many flushers race.
+        self._pending_lock = threading.Lock()
         # Evicted pods deleted from the API but whose replacement create
         # failed: the fresh copy lives only here, so it is retried every
         # tick until it lands (deleting it again can't bring it back).
@@ -135,7 +148,9 @@ class NodeLifecycle:
         # Sweep gating: orphans can only appear around node loss, so the
         # full-cluster sweep runs while loss activity is recent (plus a
         # periodic backstop) instead of on every steady-state tick.
+        # racer: single-writer -- tick()-thread-owned pass counter
         self._ticks = 0
+        # racer: single-writer -- tick()-thread-owned sweep gate
         self._sweep_hot = 1  # sweep on the first tick (fresh controller)
         self.evicted_total = 0
         self._stop = threading.Event()
@@ -225,8 +240,11 @@ class NodeLifecycle:
             if drained:
                 self._draining.discard(name)
         evicted.extend(self._flush_pending_evicts())
-        if (self._sweep_hot > 0 or self._draining or self._pending_evict
-                or self._pending_requeue or self._ticks % 10 == 0):
+        with self._pending_lock:
+            pending_flush = bool(self._pending_evict or
+                                 self._pending_requeue)
+        if (self._sweep_hot > 0 or self._draining or pending_flush
+                or self._ticks % 10 == 0):
             self._sweep_hot = max(0, self._sweep_hot - 1)
             evicted.extend(self._sweep_orphans(set(states)))
         evicted.extend(self._flush_pending_requeues())
@@ -321,18 +339,22 @@ class NodeLifecycle:
             if status == "evicted":
                 evicted.append(name)
                 metrics.EVICTIONS.inc()
-                self.evicted_total += 1
-                self._pending_evict.pop(name, None)
+                with self._pending_lock:
+                    self.evicted_total += 1
+                    self._pending_evict.pop(name, None)
             elif status == "gone":
                 # externally deleted: not our eviction, nothing pending
-                self._pending_evict.pop(name, None)
+                with self._pending_lock:
+                    self._pending_evict.pop(name, None)
             else:
                 drained = False
-                if name not in self._pending_requeue:
-                    # delete failed, pod still bound: the drain listing
-                    # only re-covers the LOST node, so a widened gang
-                    # member on a surviving node must be retried by name
-                    self._pending_evict[name] = lost_node
+                with self._pending_lock:
+                    if name not in self._pending_requeue:
+                        # delete failed, pod still bound: the drain
+                        # listing only re-covers the LOST node, so a
+                        # widened gang member on a surviving node must
+                        # be retried by name
+                        self._pending_evict[name] = lost_node
         return evicted, drained
 
     def _retry_write(self, call) -> tuple[str, bool]:
@@ -389,7 +411,8 @@ class NodeLifecycle:
             return "evicted"
         # the pod is deleted and its replacement exists only in memory
         # now: park it for per-tick retry rather than dropping it
-        self._pending_requeue[name] = fresh
+        with self._pending_lock:
+            self._pending_requeue[name] = fresh
         log.warning("eviction: pod %s deleted but re-create failed; "
                     "parked for retry", name)
         return "failed"
@@ -402,45 +425,70 @@ class NodeLifecycle:
     def _flush_pending_evicts(self) -> list:
         """Retry victims whose delete failed. The per-node drain listing
         only re-covers the LOST node, so a gang member widened in from a
-        surviving node (whose own node never drains) lands here."""
+        surviving node (whose own node never drains) lands here. The
+        ledger is snapshotted, and every mutation holds the pending
+        lock (API round trips stay outside it)."""
         landed = []
-        for name in sorted(self._pending_evict):
-            lost_node = self._pending_evict[name]
+        with self._pending_lock:
+            pending = dict(self._pending_evict)
+        for name in sorted(pending):
+            lost_node = pending[name]
             try:
                 pod = self.api.get_pod(name)
             except KeyError:
-                self._pending_evict.pop(name, None)  # already gone
+                with self._pending_lock:
+                    self._pending_evict.pop(name, None)  # already gone
                 continue
             except Exception:
                 log.debug("pending evict: get_pod(%s) failed; retrying "
                           "next tick", name, exc_info=True)
                 continue
             if not (pod.get("spec") or {}).get("nodeName"):
-                self._pending_evict.pop(name, None)  # already pending
+                with self._pending_lock:
+                    self._pending_evict.pop(name, None)  # already pending
                 continue
             status = self._evict_and_requeue(pod, lost_node)
             if status == "evicted":
                 landed.append(name)
                 metrics.EVICTIONS.inc()
-                self.evicted_total += 1
-                self._pending_evict.pop(name, None)
-            elif status == "gone" or name in self._pending_requeue:
-                # externally deleted — or the delete landed this time and
-                # the requeue path owns it now
-                self._pending_evict.pop(name, None)
+                with self._pending_lock:
+                    self.evicted_total += 1
+                    self._pending_evict.pop(name, None)
+            else:
+                with self._pending_lock:
+                    if status == "gone" or name in self._pending_requeue:
+                        # externally deleted — or the delete landed this
+                        # time and the requeue path owns it now
+                        self._pending_evict.pop(name, None)
         return landed
 
     def _flush_pending_requeues(self) -> list:
         """Retry replacement creates whose pods are already deleted —
-        the one eviction state that cannot be recomputed from the API."""
+        the one eviction state that cannot be recomputed from the API.
+
+        The batch is CLAIMED atomically: stop()'s last-chance drain can
+        run while a wedged tick (the stop() join is timed) is still
+        flushing, and without the claim both flushers would walk the
+        same map and create+count the same replacement twice — the race
+        the explorer's mutant twin pins deterministically. Failed
+        creates are parked again; a create that succeeded under a racing
+        tick's claim stays gone (setdefault, never overwrite)."""
+        probe("lifecycle.flush_requeues")
+        with self._pending_lock:
+            claimed = dict(self._pending_requeue)
+            self._pending_requeue.clear()
         landed = []
-        for name in sorted(self._pending_requeue):
-            if self._create_requeued(name, self._pending_requeue[name]):
+        failed: dict = {}
+        for name in sorted(claimed):
+            if self._create_requeued(name, claimed[name]):
                 landed.append(name)
                 metrics.EVICTIONS.inc()
-                self.evicted_total += 1
-        for name in landed:
-            self._pending_requeue.pop(name, None)
+            else:
+                failed[name] = claimed[name]
+        with self._pending_lock:
+            self.evicted_total += len(landed)
+            for name, fresh in failed.items():
+                self._pending_requeue.setdefault(name, fresh)
         return landed
 
     def _delete_node(self, name: str) -> None:
@@ -468,6 +516,8 @@ class NodeLifecycle:
         # Elector cycles start/stop as leadership moves between scheduler
         # replicas), so a fresh stop event per start lets a demoted
         # replica promote again later.
+        # racer: single-writer -- start()/stop() are owner-thread calls
+        # (the elector serializes promote/demote)
         self._stop = threading.Event()
 
         def loop():
@@ -478,6 +528,7 @@ class NodeLifecycle:
                     log.exception("node lifecycle tick failed")
                 self._stop.wait(interval)
 
+        # racer: single-writer -- stop() joins the loop before clearing
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="node-lifecycle")
         self._thread.start()
@@ -489,10 +540,16 @@ class NodeLifecycle:
         # Last-chance drain: a pod in _pending_requeue is already deleted
         # from the API and its replacement exists only in this process —
         # the one eviction state that cannot be recomputed. Dropping it
-        # on demotion/shutdown would lose the workload silently.
-        if self._pending_requeue:
+        # on demotion/shutdown would lose the workload silently. (The
+        # join above is timed, so a wedged tick may still be flushing —
+        # the claim in _flush_pending_requeues keeps the drains disjoint.)
+        with self._pending_lock:
+            parked = bool(self._pending_requeue)
+        if parked:
             self._flush_pending_requeues()
-        for name in sorted(self._pending_requeue):
+        with self._pending_lock:
+            leftover = sorted(self._pending_requeue)
+        for name in leftover:
             log.error("stopping with evicted pod %s not requeued — its "
                       "replacement create kept failing; workload intent "
                       "is lost with this process", name)

@@ -9,7 +9,10 @@ still has the negative-direction link can only mean the axis wraps.
 
 from __future__ import annotations
 
+from typing import Dict, List, Tuple
+
 from kubegpu_tpu.core import grammar
+from kubegpu_tpu.core.types import NodeInfo
 from kubegpu_tpu.topology.mesh import ICIMesh
 
 # LINK_DIRS bit positions for the negative direction of each axis
@@ -21,8 +24,9 @@ class ChipEntry:
     __slots__ = ("coords", "prefix", "node_name", "free", "links",
                  "hbm_free", "hbm_total")
 
-    def __init__(self, coords, prefix, node_name, free, links, hbm_free,
-                 hbm_total=0):
+    def __init__(self, coords: Tuple[int, ...], prefix: str,
+                 node_name: str, free: bool, links: int, hbm_free: int,
+                 hbm_total: int = 0) -> None:
         self.coords = coords
         self.prefix = prefix        # resource path prefix (.../tpu/<id>)
         self.node_name = node_name
@@ -32,7 +36,7 @@ class ChipEntry:
         self.hbm_total = hbm_total  # allocatable HBM (what eviction frees)
 
 
-def collect_chips(node_infos: dict) -> list:
+def collect_chips(node_infos: Dict[str, NodeInfo]) -> List[ChipEntry]:
     """All advertised chips across ``{node_name: NodeInfo}`` with
     coordinates, freeness, link masks, and free HBM."""
     chips = []
@@ -57,7 +61,8 @@ def collect_chips(node_infos: dict) -> list:
     return chips
 
 
-def mesh_from_chips(chips: list) -> tuple:
+def mesh_from_chips(
+        chips: List[ChipEntry]) -> Tuple[ICIMesh, Tuple[int, ...]]:
     """(ICIMesh, origin) spanning all advertised chips.
 
     Extent comes from the bounding box of *all* chips (not just free ones);

@@ -1,0 +1,45 @@
+"""Races the racer rule must flag: an unguarded counter bumped from two
+thread roots, a field guarded at one write site but bare at another
+(empty lockset intersection), and a ``# guarded-by:`` annotation naming
+a lock its owner does not define."""
+
+import threading
+
+
+class RacyService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0    # bumped with no lock from two roots
+        self.mostly = 0  # guarded in one writer, bare in the other
+
+    def start(self):
+        for _ in range(4):
+            threading.Thread(target=self._worker, daemon=True).start()
+        threading.Thread(target=self._reporter, daemon=True).start()
+
+    def _worker(self):
+        self.hits += 1
+        self._lock.acquire()
+        self.mostly += 1
+        self._lock.release()
+
+    def _reporter(self):
+        self.hits += 1
+        self.mostly += 1  # missing the lock: no common guard remains
+
+
+class MislabeledGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: self._other_lock -- typo: no such lock exists
+        self.count = 0
+
+    def spawn(self):
+        threading.Thread(target=self._bump, daemon=True).start()
+        threading.Thread(target=self._bump_again, daemon=True).start()
+
+    def _bump(self):
+        self.count += 1
+
+    def _bump_again(self):
+        self.count += 1

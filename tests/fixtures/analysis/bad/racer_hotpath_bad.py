@@ -1,0 +1,27 @@
+"""Hot-path purity violations: a function inside the filter->score->
+allocate closure is contracted ``# hot-path: pure`` but acquires a
+lock, logs, and exceeds its allocation budget."""
+
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+class MiniScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.nodes = {}
+
+    def find_nodes_that_fit(self, pod):
+        return [n for n in self.nodes if self._score_node(pod, n) > 0]
+
+    # hot-path: pure alloc=2
+    def _score_node(self, pod, node):
+        with self._lock:
+            known = node in self.nodes
+        log.info("scoring %s", node)
+        parts = [pod, node, known]
+        pairs = {"pod": pod, "node": node}
+        flags = {True, known}
+        return len(parts) + len(pairs) + len(flags)

@@ -1,0 +1,79 @@
+"""Clean twins for the racer rule: a consistently guarded counter (the
+lock handed through a ``_locked`` helper), a declared single-writer
+field, and a monitor member guarded by its own class's internal lock."""
+
+import threading
+
+
+class GuardedService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+
+    def start(self):
+        for _ in range(4):
+            threading.Thread(target=self._worker, daemon=True).start()
+        threading.Thread(target=self._reporter, daemon=True).start()
+
+    def _worker(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _reporter(self):
+        with self._lock:
+            self.hits += 1
+
+    def _bump_locked(self):
+        # the caller holds the lock: the entry lockset carries it here
+        self.hits += 1
+
+
+class SingleWriterLoop:
+    def __init__(self):
+        # racer: single-writer -- the loop thread owns this counter;
+        # the side entry only runs in single-threaded shutdown
+        self.ticks = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def drain(self):
+        threading.Thread(target=self._final_drain, daemon=True).start()
+
+    def _loop(self):
+        self.ticks += 1
+
+    def _final_drain(self):
+        self.ticks += 1
+
+
+class MonitorQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def drain(self):
+        with self._lock:
+            items, self._items = self._items, []
+            return items
+
+
+class MonitorOwner:
+    def __init__(self):
+        # guarded-by: MonitorQueue._lock -- monitor member: the queue
+        # takes its own lock inside every mutator
+        self.queue = MonitorQueue()
+
+    def start(self):
+        threading.Thread(target=self._producer, daemon=True).start()
+        threading.Thread(target=self._consumer, daemon=True).start()
+
+    def _producer(self):
+        self.queue.push("item")
+
+    def _consumer(self):
+        self.queue.pop()

@@ -1,0 +1,14 @@
+"""Clean twin: the contracted hot-path scorer is pure — no locks, no
+I/O or logging, allocations within budget."""
+
+
+class MiniScheduler:
+    def __init__(self):
+        self.nodes = {}
+
+    def find_nodes_that_fit(self, pod):
+        return [n for n in self.nodes if self._score_node(pod, n) > 0]
+
+    # hot-path: pure
+    def _score_node(self, pod, node):
+        return 1 if node in self.nodes else 0

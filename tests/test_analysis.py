@@ -23,7 +23,7 @@ TESTS_DIR = os.path.join(REPO, "tests")
 RULES = ["lock-discipline", "no-blocking-under-lock", "transitive-locks",
          "monotonic-time", "codec-pairing", "no-swallowed-exceptions",
          "metric-registration", "charge-pairing", "resource-lifecycle",
-         "wire-contract", "unused-suppression"]
+         "wire-contract", "racer", "hot-path", "unused-suppression"]
 
 
 # ---- static rules: bad fixtures flag, good twins pass ----------------------

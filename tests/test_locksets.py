@@ -1,0 +1,419 @@
+"""Unit tests for the lockset model (analysis/locksets.py) and the two
+rules built on it (racer, hot-path): lockset-join semantics (reentrant
+RLock, conditional acquire, lock handed through a helper), thread-root
+discovery over the real package including the ``cmd/`` entry points,
+the guarded-by/single-writer conventions, and the hot-path purity
+budget with its ranked vectorization-blockers report."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubegpu_tpu.analysis import run_analysis
+from kubegpu_tpu.analysis.engine import load_sources
+from kubegpu_tpu.analysis.locksets import build_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "kubegpu_tpu")
+
+HEADER = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.{factory}()
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._a, daemon=True).start()
+        threading.Thread(target=self._b, daemon=True).start()
+
+    def _b(self):
+        with self._lock:
+            self.n += 1
+"""
+
+
+def _racer(tmp_path, body, factory="Lock"):
+    mod = tmp_path / "mod.py"
+    mod.write_text(HEADER.format(factory=factory) + body)
+    return run_analysis([str(mod)], select=["racer"])
+
+
+# ---- lockset joins ----------------------------------------------------------
+
+
+def test_reentrant_rlock_nesting_keeps_the_lock_held(tmp_path):
+    hits = _racer(tmp_path, """
+    def _a(self):
+        with self._lock:
+            self._inner()
+
+    def _inner(self):
+        with self._lock:
+            self.n += 1
+""", factory="RLock")
+    assert hits == []
+
+
+def test_nested_reentrant_with_does_not_release_the_outer_hold(tmp_path):
+    # the inner `with self._lock` exits before the writes below it —
+    # but the OUTER with still holds the lock, so nothing races
+    hits = _racer(tmp_path, """
+    def _a(self):
+        with self._lock:
+            with self._lock:
+                self.n += 1
+            self.n += 1
+""", factory="RLock")
+    assert hits == []
+
+
+def test_conditional_acquire_does_not_survive_the_branch_join(tmp_path):
+    hits = _racer(tmp_path, """
+    def _a(self, fast=False):
+        if fast:
+            self._lock.acquire()
+        self.n += 1
+        if fast:
+            self._lock.release()
+""")
+    assert len(hits) == 1 and "C.n" in hits[0].message
+    # the finding anchors at the bare write and names the partial guard
+    assert "self._lock" in hits[0].message
+
+
+def test_unconditional_acquire_release_counts_as_held(tmp_path):
+    hits = _racer(tmp_path, """
+    def _a(self):
+        self._lock.acquire()
+        self.n += 1
+        self._lock.release()
+""")
+    assert hits == []
+
+
+def test_lock_handed_through_a_helper_guards_the_helper(tmp_path):
+    hits = _racer(tmp_path, """
+    def _a(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        self.n += 1
+""")
+    assert hits == []
+
+
+def test_helper_with_one_unlocked_caller_loses_the_entry_lockset(tmp_path):
+    hits = _racer(tmp_path, """
+    def _a(self):
+        with self._lock:
+            self._bump()
+
+    def _b(self):
+        self._bump()
+
+    def _bump(self):
+        self.n += 1
+""")
+    # entry lockset = meet over call sites = {} -> the write races
+    assert len(hits) == 1 and "C.n" in hits[0].message
+
+
+def test_locked_suffix_contract_supplies_the_entry_lockset(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._a, daemon=True).start()
+        threading.Thread(target=self._b, daemon=True).start()
+
+    def _a(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _b(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self.n += 1
+""")
+    assert run_analysis([str(mod)], select=["racer"]) == []
+
+
+def test_pool_spawn_is_self_racing(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+class P:
+    def __init__(self):
+        self.c = 0
+
+    def start(self):
+        for _ in range(3):
+            threading.Thread(target=self._w, daemon=True).start()
+
+    def _w(self):
+        self.c += 1
+""")
+    hits = run_analysis([str(mod)], select=["racer"])
+    assert len(hits) == 1 and "(xN)" in hits[0].message
+
+
+def test_single_spawn_of_one_target_is_not_a_race(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+class P:
+    def __init__(self):
+        self.c = 0
+
+    def start(self):
+        threading.Thread(target=self._w, daemon=True).start()
+
+    def _w(self):
+        self.c += 1
+""")
+    assert run_analysis([str(mod)], select=["racer"]) == []
+
+
+def test_module_global_written_from_two_roots_flags(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+total = 0
+
+def start():
+    threading.Thread(target=_a, daemon=True).start()
+    threading.Thread(target=_b, daemon=True).start()
+
+def _a():
+    global total
+    total += 1
+
+def _b():
+    global total
+    total += 1
+""")
+    hits = run_analysis([str(mod)], select=["racer"])
+    assert len(hits) == 1 and "total" in hits[0].message
+
+
+def test_module_lock_guards_module_global(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+total = 0
+_mu = threading.Lock()
+
+def start():
+    threading.Thread(target=_a, daemon=True).start()
+    threading.Thread(target=_b, daemon=True).start()
+
+def _a():
+    global total
+    with _mu:
+        total += 1
+
+def _b():
+    global total
+    with _mu:
+        total += 1
+""")
+    assert run_analysis([str(mod)], select=["racer"]) == []
+
+
+# ---- guard conventions ------------------------------------------------------
+
+
+def test_single_writer_note_suppresses_and_binds_to_its_field_only(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+class C:
+    def __init__(self):
+        # racer: single-writer -- handoff protocol
+        self.a = 0
+        self.b = 0
+
+    def start(self):
+        threading.Thread(target=self._x, daemon=True).start()
+        threading.Thread(target=self._y, daemon=True).start()
+
+    def _x(self):
+        self.a += 1
+        self.b += 1
+
+    def _y(self):
+        self.a += 1
+        self.b += 1
+""")
+    hits = run_analysis([str(mod)], select=["racer"])
+    assert len(hits) == 1 and "C.b" in hits[0].message
+
+
+def test_guarded_by_unknown_lock_is_itself_a_finding(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+class C:
+    def __init__(self):
+        # guarded-by: self._nope -- no such lock
+        self.a = 0
+""")
+    hits = run_analysis([str(mod)], select=["racer"])
+    assert len(hits) == 1 and "does not define" in hits[0].message
+
+
+def test_guarded_by_monitor_class_form_is_validated(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+class Monitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+class Owner:
+    def __init__(self):
+        # guarded-by: Monitor._lock -- internally locked member
+        self.q = Monitor()
+
+    def start(self):
+        threading.Thread(target=self._a, daemon=True).start()
+        threading.Thread(target=self._b, daemon=True).start()
+
+    def _a(self):
+        self.q.pop()
+
+    def _b(self):
+        self.q.pop()
+""")
+    assert run_analysis([str(mod)], select=["racer"]) == []
+
+
+# ---- thread-root discovery over the real package ---------------------------
+
+
+@pytest.fixture(scope="module")
+def package_model():
+    return build_model(load_sources([PKG]))
+
+
+def test_cmd_entry_points_are_roots(package_model):
+    entry = {r.target for r in package_model.roots if r.kind == "entry"}
+    for binary in ("scheduler_main", "apiserver_main", "node_agent",
+                   "simulate", "cri_hook"):
+        assert any(binary in t for t in entry), \
+            f"cmd/{binary}.py main not discovered as a root: {entry}"
+
+
+def test_thread_and_pool_roots_are_discovered(package_model):
+    targets = {r.target for r in package_model.roots}
+    assert "BindWorkerPool._worker" in targets     # spawned in a loop
+    assert "NodeLifecycle.start.loop" in targets   # nested thread body
+    assert "Scheduler.run_forever" in targets      # Thread(target=self.…)
+    assert package_model.root_multiplicity("BindWorkerPool._worker") == 2
+
+
+def test_fit_pool_fanout_is_a_self_racing_root(package_model):
+    # _parallel_map hands its lambda to the 16-worker fit pool: the
+    # called function must be a multiplicity-2 root
+    targets = {r.target: r for r in package_model.roots}
+    assert "GenericScheduler._fits_on_node" in targets
+    assert targets["GenericScheduler._fits_on_node"].multiplicity == 2
+
+
+def test_entry_locksets_carry_the_cache_lock(package_model):
+    # SchedulerCache._charge_locked is only ever called with the cache
+    # lock held — the meet over its call sites must say so
+    entry = package_model.entry_locks.get("SchedulerCache._charge_locked")
+    assert entry == frozenset({"self._lock"})
+
+
+# ---- hot-path purity budget -------------------------------------------------
+
+
+def test_hot_path_report_ranks_the_device_lock_first():
+    reports: dict = {}
+    findings = run_analysis([PKG], select=["hot-path"], reports=reports)
+    assert findings == []  # no contracted function violates its purity
+    report = reports["hot-path"]
+    assert report["roots"] == ["find_nodes_that_fit", "prioritize_nodes",
+                               "allocate_devices"]
+    assert report["closure_size"] > 50
+    assert report["blockers"], "the closure has known blockers today"
+    top = report["blockers"][0]
+    # ROADMAP item 1's diagnosis, reproduced statically: the device-
+    # verdict lock inside _run_predicates is the #1 vectorization blocker
+    assert "_run_predicates" in top["function"]
+    assert any("_device_lock" in entry for entry in top["locks"])
+
+
+def test_hot_path_contract_findings(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def find_nodes_that_fit(self):
+        return self._score()
+
+    # hot-path: pure
+    def _score(self):
+        with self._lock:
+            return 1
+""")
+    hits = run_analysis([str(mod)], select=["hot-path"])
+    assert len(hits) == 1 and "acquires self._lock" in hits[0].message
+
+
+def test_hot_path_alloc_budget_override(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("""
+def find_nodes_that_fit():
+    return _score()
+
+# hot-path: pure alloc=1
+def _score():
+    a = [1]
+    b = {2}
+    return a, b
+""")
+    hits = run_analysis([str(mod)], select=["hot-path"])
+    assert len(hits) == 1 and "allocation budget of 1" in hits[0].message
+    assert "2 allocation sites" in hits[0].message
+
+
+def test_cli_report_flag_prints_the_ranked_inventory():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.analysis", "--rule", "hot-path",
+         "--report", PKG],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "hot-path report:" in proc.stdout
+    assert "_run_predicates" in proc.stdout

@@ -1,0 +1,221 @@
+"""Mutant-twin regressions for the races the racer rule surfaced.
+
+Each true positive the static lockset pass found gets the PR 8
+treatment: the fix is mutated back out as a minimal subclass, the
+interleaving explorer REDISCOVERS the race deterministically within a
+bounded schedule budget, and the fixed class passes the identical
+scenario on every schedule. The three races:
+
+1. ``HTTPAPIClient.retry_count`` — an unguarded ``+= 1`` from every
+   thread with a keep-alive connection (fit workers, binder workers,
+   the watch loop all retry through one client) loses updates.
+2. ``Elector.transitions`` — ``stop()`` on the owner thread can bump
+   concurrently with a ``tick()`` still finishing on the elector
+   thread.
+3. ``NodeLifecycle._flush_pending_requeues`` — stop()'s last-chance
+   drain runs after a TIMED join, so a wedged tick can still be
+   flushing: without the claim-under-lock both flushers walk the same
+   map and create+count the same replacement pod twice.
+"""
+
+import pytest
+
+from kubegpu_tpu.analysis import explore as ex
+from kubegpu_tpu.analysis import schedules as sch
+from kubegpu_tpu.cluster.apiserver import Conflict
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
+from kubegpu_tpu.cluster.lease import Elector
+from kubegpu_tpu.scheduler.lifecycle import NodeLifecycle
+
+BUDGET = 400
+
+
+# ---- 1. client retry counter ------------------------------------------------
+
+
+class UnguardedRetryClient(HTTPAPIClient):
+    """The pre-fix bump: read-modify-write with no lock. The probe marks
+    the preemption window an unguarded ``+=`` leaves open."""
+
+    def _count_retry(self):
+        v = self.retry_count
+        ex.probe("retry-gap")
+        self.retry_count = v + 1
+
+
+def _retry_scenario(cls):
+    def scenario():
+        client = cls("http://127.0.0.1:9")  # never dialed
+
+        def bump():
+            client._count_retry()
+
+        def invariant():
+            assert client.retry_count == 2, \
+                f"lost retry count: {client.retry_count}"
+
+        return [bump, bump], invariant
+
+    return scenario
+
+
+def test_unguarded_retry_count_race_rediscovered():
+    res = sch.explore(_retry_scenario(UnguardedRetryClient),
+                      max_schedules=BUDGET, seed=0)
+    assert res.failure is not None, "mutant race not found"
+    assert "lost retry count" in res.failure.summary
+    # the recorded schedule replays to the same failing decisions (the
+    # summary embeds object reprs, which differ per construction)
+    again = sch.replay(_retry_scenario(UnguardedRetryClient), res.failure)
+    assert again.decisions == res.failure.decisions
+    assert "lost retry count" in again.summary
+
+
+def test_guarded_retry_count_is_clean_every_schedule():
+    res = sch.explore(_retry_scenario(HTTPAPIClient),
+                      max_schedules=BUDGET, seed=0)
+    assert res.ok, res.failure and res.failure.summary
+    assert res.exhausted
+
+
+# ---- 2. elector transition counter -----------------------------------------
+
+
+class UnguardedTransitionElector(Elector):
+    def _count_transition(self):
+        v = self.transitions
+        ex.probe("transition-gap")
+        self.transitions = v + 1
+
+
+def _transition_scenario(cls):
+    def scenario():
+        elector = cls(lambda name, holder, ttl: True, "lease", "me", 5.0)
+
+        def bump():
+            elector._count_transition()
+
+        def invariant():
+            assert elector.transitions == 2, \
+                f"lost transition count: {elector.transitions}"
+
+        return [bump, bump], invariant
+
+    return scenario
+
+
+def test_unguarded_transitions_race_rediscovered():
+    res = sch.explore(_transition_scenario(UnguardedTransitionElector),
+                      max_schedules=BUDGET, seed=0)
+    assert res.failure is not None
+    assert "lost transition count" in res.failure.summary
+
+
+def test_guarded_transitions_clean_every_schedule():
+    res = sch.explore(_transition_scenario(Elector),
+                      max_schedules=BUDGET, seed=0)
+    assert res.ok, res.failure and res.failure.summary
+    assert res.exhausted
+
+
+# ---- 3. lifecycle pending-requeue double drain -----------------------------
+
+
+class _CountingAPI:
+    """create_pod counts arrivals and refuses duplicates like the real
+    apiserver; the probe is the sync point between a flusher's read of
+    the pending map and its create landing."""
+
+    def __init__(self):
+        self.created = {}
+
+    def create_pod(self, pod):
+        name = pod["metadata"]["name"]
+        ex.probe("api.create_pod")
+        if name in self.created:
+            raise Conflict(f"pod {name} already exists")
+        self.created[name] = pod
+
+
+class UnclaimedFlushLifecycle(NodeLifecycle):
+    """The pre-fix flush: iterate the shared map in place, count every
+    landed create — including a Conflict, which the retry helper treats
+    as 'already landed'. Two concurrent flushers each create+count."""
+
+    def _flush_pending_requeues(self):
+        landed = []
+        for name in sorted(self._pending_requeue):
+            ex.probe("flush-gap")
+            if self._create_requeued(name, self._pending_requeue[name]):
+                landed.append(name)
+                self.evicted_total += 1
+        for name in landed:
+            self._pending_requeue.pop(name, None)
+        return landed
+
+
+def _double_drain_scenario(cls):
+    def scenario():
+        api = _CountingAPI()
+        controller = cls(api)
+        controller._pending_requeue["pod-a"] = {
+            "metadata": {"name": "pod-a"}, "spec": {}}
+
+        def flush():
+            controller._flush_pending_requeues()
+
+        def invariant():
+            assert controller.evicted_total == 1, \
+                f"requeue counted {controller.evicted_total} times"
+            assert len(api.created) == 1
+
+        return [flush, flush], invariant
+
+    return scenario
+
+
+def test_unclaimed_double_drain_race_rediscovered():
+    res = sch.explore(_double_drain_scenario(UnclaimedFlushLifecycle),
+                      max_schedules=BUDGET, seed=0)
+    assert res.failure is not None, "mutant double-drain not found"
+    # the race manifests as a double-counted requeue OR as the shared
+    # map mutating under a concurrent flusher's feet (KeyError) —
+    # whichever schedule the explorer hits first
+    assert "counted 2 times" in res.failure.summary or \
+        "KeyError" in res.failure.summary
+
+
+def test_claimed_drain_is_exactly_once_every_schedule():
+    res = sch.explore(_double_drain_scenario(NodeLifecycle),
+                      max_schedules=BUDGET, seed=0)
+    assert res.ok, res.failure and res.failure.summary
+    assert res.exhausted
+
+
+# ---- the static rule agrees with the dynamic twins -------------------------
+
+
+@pytest.mark.parametrize("source, field", [
+    ("""
+import threading
+
+class Client:
+    def __init__(self):
+        self._conn_lock = threading.Lock()
+        self.retry_count = 0
+
+    def start(self):
+        for _ in range(4):
+            threading.Thread(target=self._req, daemon=True).start()
+
+    def _req(self):
+        self.retry_count += 1
+""", "Client.retry_count"),
+])
+def test_racer_flags_the_shape_the_twin_pins(tmp_path, source, field):
+    from kubegpu_tpu.analysis import run_analysis
+
+    mod = tmp_path / "mod.py"
+    mod.write_text(source)
+    hits = run_analysis([str(mod)], select=["racer"])
+    assert len(hits) == 1 and field in hits[0].message
