@@ -539,11 +539,77 @@ def wire_parity_check() -> list:
                                getattr(e, "per_pod", None))
             if errs["json"] != errs["stream"] or errs["json"] is None:
                 diffs.append(name)
+        diffs.extend(_front_door_parity_check())
         return diffs
     finally:
         for c in clients.values():
             c.close()
         server.shutdown()
+
+
+def _front_door_parity_check() -> list:
+    """Parity for the multi-tenant front door's typed errors: a shut
+    workload band must yield the SAME TooManyRequests (429 on the JSON
+    wire, a REJECT frame on the stream wire — retry_after_s included),
+    and a hard-capped tenant the same QuotaExceeded (403), on both
+    wires."""
+    from kubegpu_tpu.cluster.apf import (APFDispatcher, BandConfig,
+                                         BAND_WORKLOAD, TooManyRequests)
+    from kubegpu_tpu.cluster.apiserver import QuotaExceeded
+    from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+
+    diffs = []
+    api = InMemoryAPIServer()
+    api.set_quota("capped", {"hard_chips": 0})
+    apf = APFDispatcher(bands={
+        BAND_WORKLOAD: BandConfig(seats=0, queues=1, queue_len=0,
+                                  queue_wait_s=0.05)})
+    server, url = serve_api(api, apf=apf)
+    clients = {"json": HTTPAPIClient(url, wire="json"),
+               "stream": HTTPAPIClient(url, wire="stream")}
+    try:
+        errs = {}
+        for w, c in clients.items():
+            try:
+                # per-wire names: if the front door fails OPEN, both
+                # creates land and the diff reports — a shared name
+                # would make the second create's Conflict abort the
+                # whole parity run instead
+                c.create_pod(make_pod(f"fd-x-{w}", 1))
+                errs[w] = None
+            except TooManyRequests as e:
+                errs[w] = (type(e).__name__,
+                           str(e).replace(f"fd-x-{w}", "fd-x"),
+                           round(e.retry_after_s, 3))
+        if errs["json"] != errs["stream"] or errs["json"] is None:
+            diffs.append("too_many_requests")
+    finally:
+        for c in clients.values():
+            c.close()
+        server.shutdown()
+    # QuotaExceeded parity needs the create to REACH admission: same
+    # hard-capped store, no front door in the way
+    server2, url2 = serve_api(api)
+    clients2 = {"json": HTTPAPIClient(url2, wire="json"),
+                "stream": HTTPAPIClient(url2, wire="stream")}
+    try:
+        errs = {}
+        for w, c in clients2.items():
+            capped_pod = make_pod(f"fd-capped-{w}", 2)
+            capped_pod["metadata"]["labels"] = \
+                {"kgtpu.io/tenant": "capped"}
+            try:
+                c.create_pod(capped_pod)
+                errs[w] = None
+            except QuotaExceeded as e:
+                errs[w] = (type(e).__name__, str(e))
+        if errs["json"] != errs["stream"] or errs["json"] is None:
+            diffs.append("quota_exceeded")
+    finally:
+        for c in clients2.values():
+            c.close()
+        server2.shutdown()
+    return diffs
 
 
 def config_gang_preempt():
@@ -1599,6 +1665,29 @@ def main():
             run_chaos_scenario(seed=0)["recovery_ms"]
     except Exception as e:  # noqa: BLE001
         per_config["node_loss_recovery_error"] = f"{type(e).__name__}: {e}"
+    # Multi-tenant front door: mixed tenants churning while one abusive
+    # tenant floods creates through the APF layer + DRF chip gate —
+    # well-behaved p99 must hold within 2x of quiet (asserted inside
+    # the scenario) and the per-tenant numbers join the trajectory.
+    try:
+        from kubegpu_tpu.cmd.simulate import run_tenant_flood_scenario
+
+        tf = run_tenant_flood_scenario(churn_pods=16)
+        per_config["multitenant_wellbehaved_quiet_p99_ms"] = \
+            tf["wellbehaved_quiet_p99_ms"]
+        per_config["multitenant_wellbehaved_flood_p99_ms"] = \
+            tf["wellbehaved_flood_p99_ms"]
+        per_config["multitenant_p99_ratio"] = tf["p99_ratio"]
+        per_config["multitenant_abuser_bound_chips"] = \
+            tf["abuser_bound_chips"]
+        per_config["apf_queue_wait_p99_ms"] = \
+            tf["front_door"]["apf_queue_wait_p99_ms"]
+        per_config["apf_rejects_total"] = \
+            sum(tf["front_door"]["apf_rejects_total"].values())
+        per_config["quota_parked_total"] = \
+            tf["front_door"]["quota_parked_total"]
+    except Exception as e:  # noqa: BLE001
+        per_config["multitenant_churn_error"] = f"{type(e).__name__}: {e}"
     while _LIVE_CLUSTERS:
         _LIVE_CLUSTERS.pop().close()
     if not os.environ.get("KGTPU_BENCH_SKIP_WORKLOAD"):
@@ -1636,6 +1725,17 @@ def smoke():
     # optimistic replicas + shard leases + conflict arbitration
     ha = config_scale_ha(n_hosts=32, n_pods=16, replicas=2,
                          deadline_s=60.0)
+    # the multi-tenant front door end to end at tiny N: APF + DRF gate
+    # under a real (short) abusive flood; the scenario asserts the p99
+    # hold, zero lease losses, zero evictions, and the abuser's chip
+    # cap internally — a smoke failure IS a front-door regression
+    from kubegpu_tpu.cmd.simulate import run_tenant_flood_scenario
+
+    tf = run_tenant_flood_scenario(tenants=2, churn_pods=6,
+                                   flood_threads=2)
+    assert tf["quota_parked"] > 0 or tf["flood"]["rejected"] > 0, \
+        "tenant flood ran but neither the DRF gate nor the front " \
+        "door ever engaged"
     while _LIVE_CLUSTERS:
         _LIVE_CLUSTERS.pop().close()
     hits = metrics.FIT_CACHE_HITS.value
@@ -1686,6 +1786,10 @@ def smoke():
         "bind_pipeline_http_vs_mem": bp["http_vs_mem"],
         "scale_1k_node_smoke_p50_ms": round(
             statistics.median(ha) * 1e3, 3),
+        "multitenant_p99_ratio": tf["p99_ratio"],
+        "quota_parked_total": tf["front_door"]["quota_parked_total"],
+        "apf_rejects_total": sum(
+            tf["front_door"]["apf_rejects_total"].values()),
         "sched_conflicts_total": metrics.SCHED_CONFLICTS.value,
         "lease_transitions_total": metrics.LEASE_TRANSITIONS.value,
         "fit_cache_hits_total": hits,

@@ -28,7 +28,16 @@ pairing is broken). Four paired surfaces are checked:
   ``404``) must carry the SAME mapping set as every other dispatch
   site (the JSON handler and the stream handler are two wires over one
   contract), and the client must reconstruct exactly those pairs
-  (``status == 404`` -> ``raise NotFound``).
+  (``status == 404`` -> ``raise NotFound``). The front door's flow
+  control rides this check too: ``TooManyRequests -> 429`` (REJECT
+  frame on the stream wire) and ``QuotaExceeded -> 403`` must be
+  exhaustive across both dispatch sites and client-reconstructed.
+* **error-detail keys** — every key the server's ``_error_body()``
+  writes into the typed-error payload must be READ somewhere on the
+  client side of the module: a detail key the server sends that no
+  client code consumes is a one-sided surface (exactly the
+  retry-after bug class — the server advises ``retry_after_s``, the
+  client's retry policy silently ignores it).
 
 Everything is matched by name and structure over the AST — no imports,
 no execution — so the fixtures and the real tree are judged alike.
@@ -43,6 +52,7 @@ from kubegpu_tpu.analysis.engine import Context, Finding, SourceFile
 
 ROUTE_TABLE_FN = "_route_request"
 CLIENT_REQ = "_req"
+ERROR_BODY_FN = "_error_body"
 FRAME_REGISTRY = "_FRAME_TYPES"
 SEND_FNS = frozenset({"send_frame", "encode_frame", "send_raw"})
 TAG_PREFIX = "_T_"
@@ -65,6 +75,7 @@ class WireContract:
             if route_fns:
                 yield from self._check_routes(src, route_fns)
                 yield from self._check_error_maps(src)
+                yield from self._check_error_detail(src)
             yield from self._check_codec_tags(src)
         yield from self._check_frame_types(sources)
 
@@ -236,6 +247,60 @@ class WireContract:
                     f"client reconstructs {exc} from status {status} "
                     f"but no dispatch site ever maps it — dead client "
                     f"surface or a missing server mapping")
+
+    # ---- error-detail keys --------------------------------------------------
+
+    def _check_error_detail(self, src: SourceFile) -> Iterator[Finding]:
+        """Every key ``_error_body()`` writes into the typed-error
+        payload must be read somewhere OUTSIDE it in the same module
+        (``doc.get("key")`` / ``doc["key"]``) — a detail key only the
+        server knows is advice the client silently drops."""
+        body_fns = [node for node in ast.walk(src.tree)
+                    if isinstance(node, ast.FunctionDef)
+                    and node.name == ERROR_BODY_FN]
+        if not body_fns:
+            return
+        written: Dict[str, int] = {}
+        inside: Set[int] = set()
+        for fn in body_fns:
+            for node in ast.walk(fn):
+                inside.add(id(node))
+                if isinstance(node, ast.Dict):
+                    for key in node.keys:
+                        if isinstance(key, ast.Constant) and \
+                                isinstance(key.value, str):
+                            written.setdefault(key.value, node.lineno)
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        if isinstance(target, ast.Subscript) and \
+                                isinstance(target.slice, ast.Constant) \
+                                and isinstance(target.slice.value, str):
+                            written.setdefault(target.slice.value,
+                                               target.lineno)
+        read: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if id(node) in inside:
+                continue
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "get" and node.args:
+                arg0 = node.args[0]
+                if isinstance(arg0, ast.Constant) and \
+                        isinstance(arg0.value, str):
+                    read.add(arg0.value)
+            if isinstance(node, ast.Subscript) and \
+                    not isinstance(node.ctx, ast.Store) and \
+                    isinstance(node.slice, ast.Constant) and \
+                    isinstance(node.slice.value, str):
+                read.add(node.slice.value)
+        for key, lineno in sorted(written.items(), key=lambda kv: kv[1]):
+            if key not in read:
+                yield Finding(
+                    self.name, src.path, lineno,
+                    f"error-detail key {key!r} is written by "
+                    f"{ERROR_BODY_FN}() but nothing in this module "
+                    f"reads it back — server-sent advice the client "
+                    f"silently drops (the retry-after bug class)")
 
 
 # ---- helpers ----------------------------------------------------------------

@@ -16,6 +16,7 @@ import time
 
 from kubegpu_tpu import obs
 from kubegpu_tpu.analysis.explore import probe
+from kubegpu_tpu.cluster import apf
 from kubegpu_tpu.cluster.lease import LeaseTable
 from kubegpu_tpu.core import codec, grammar
 
@@ -49,6 +50,20 @@ class Conflict(RuntimeError):
     binder uses it to forget+requeue exactly the losers and commit the
     rest, and to distinguish this definitive server answer from a
     transient transport failure (which retries in place)."""
+
+    def __init__(self, message: str = "", per_pod: dict | None = None):
+        super().__init__(message)
+        self.per_pod = dict(per_pod or {})
+
+
+class QuotaExceeded(RuntimeError):
+    """A tenant is over its chip quota. Two raisers, one type: the
+    apiserver's create-time admission when a configured HARD cap
+    (``set_quota(tenant, hard_chips=...)``) would be exceeded — mapped
+    to HTTP 403 on both wires like real Kubernetes ResourceQuota — and
+    the scheduler's dominant-resource fair-share gate at pod-pop time
+    (``scheduler/quota.py``), where it is the typed unschedulable
+    reason a parked pod shows in ``/debug/pod/<name>``."""
 
     def __init__(self, message: str = "", per_pod: dict | None = None):
         super().__init__(message)
@@ -134,6 +149,13 @@ class InMemoryAPIServer:
         # scheduler replicas commit through one shared store safely.
         self._chip_claims: dict = {}   # (node, chip prefix) -> pod name
         self._coord_claims: dict = {}  # (node, port) -> [gang id, {pods}]
+        # Tenant quota config (tenant -> {"weight", "hard_chips"}) and
+        # the incremental created-chips ledger admission checks against:
+        # per-pod entries so bind-time re-indexing and WAL replay stay
+        # idempotent, maintained by the same index/deindex discipline.
+        self._quotas: dict = {}
+        self._tenant_chips: dict = {}      # tenant -> chips created
+        self._pod_tenant_chips: dict = {}  # pod name -> (tenant, chips)
         # Leader-election / shard-ownership leases, served uniformly by
         # every client surface (in-process here, HTTP via httpapi).
         self._leases = LeaseTable()
@@ -150,6 +172,84 @@ class InMemoryAPIServer:
         return self._leases.release(name, holder)
 
     MAX_EVENTS = 5000
+
+    # ---- tenant quotas -----------------------------------------------------
+
+    def set_quota(self, tenant: str, spec: dict) -> dict:
+        """Configure one tenant's quota: ``weight`` (fair-share weight
+        the scheduler-side DRF gate consumes) and/or ``hard_chips`` (a
+        create-time admission cap this server enforces itself)."""
+        out = {}
+        if "weight" in spec and spec["weight"] is not None:
+            out["weight"] = float(spec["weight"])
+        if "hard_chips" in spec and spec["hard_chips"] is not None:
+            out["hard_chips"] = int(spec["hard_chips"])
+        with self._lock:
+            self._quotas[tenant] = out
+            self._notify_locked("quota", "modified",
+                                {"metadata": {"name": tenant},
+                                 "spec": dict(out)})
+            return dict(out)
+
+    def delete_quota(self, tenant: str) -> None:
+        with self._lock:
+            if tenant not in self._quotas:
+                raise NotFound(f"quota {tenant}")
+            spec = self._quotas.pop(tenant)
+            self._notify_locked("quota", "deleted",
+                                {"metadata": {"name": tenant},
+                                 "spec": dict(spec)})
+
+    def list_quotas(self) -> dict:
+        """{tenant: quota spec + live ``chips_created`` usage} — the
+        admin/debug view of the tenant ledger."""
+        with self._lock:
+            tenants = set(self._quotas) | set(self._tenant_chips)
+            return {t: {**(self._quotas.get(t) or {}),
+                        "chips_created":
+                            round(self._tenant_chips.get(t, 0.0), 3)}
+                    for t in sorted(tenants)}
+
+    def _check_hard_quota_locked(self, pod: dict) -> None:
+        """Create-time admission: refuse a pod that would push its
+        tenant past a configured hard chip cap (HTTP 403 on the wire,
+        like real ResourceQuota). No cap configured = no gate; WAL
+        replay bypasses this path entirely (restore_object)."""
+        tenant = apf.tenant_of_pod(pod)
+        if tenant is None:
+            return
+        cap = (self._quotas.get(tenant) or {}).get("hard_chips")
+        if cap is None:
+            return
+        want = apf.pod_chip_request(pod)
+        used = self._tenant_chips.get(tenant, 0.0)
+        if used + want > cap:
+            raise QuotaExceeded(
+                f"tenant {tenant!r} over hard chip cap: "
+                f"{used:.0f} created + {want} requested > {cap}")
+
+    def _charge_tenant_locked(self, pod: dict) -> None:
+        name = pod["metadata"]["name"]
+        if name in self._pod_tenant_chips:
+            return
+        tenant = apf.tenant_of_pod(pod)
+        if tenant is None:
+            return
+        chips = float(apf.pod_chip_request(pod))
+        self._pod_tenant_chips[name] = (tenant, chips)
+        self._tenant_chips[tenant] = \
+            self._tenant_chips.get(tenant, 0.0) + chips
+
+    def _discharge_tenant_locked(self, pod: dict) -> None:
+        entry = self._pod_tenant_chips.pop(pod["metadata"]["name"], None)
+        if entry is None:
+            return
+        tenant, chips = entry
+        left = self._tenant_chips.get(tenant, 0.0) - chips
+        if left > 1e-9:
+            self._tenant_chips[tenant] = left
+        else:
+            self._tenant_chips.pop(tenant, None)
 
     # ---- nodes -------------------------------------------------------------
 
@@ -205,6 +305,7 @@ class InMemoryAPIServer:
         name = pod["metadata"]["name"]
         node = (pod.get("spec") or {}).get("nodeName")
         phase = (pod.get("status") or {}).get("phase")
+        self._charge_tenant_locked(pod)
         if node:
             self._pods_by_node.setdefault(node, set()).add(name)
             chips, coord = _pod_claims(
@@ -225,6 +326,7 @@ class InMemoryAPIServer:
         name = pod["metadata"]["name"]
         node = (pod.get("spec") or {}).get("nodeName")
         phase = (pod.get("status") or {}).get("phase")
+        self._discharge_tenant_locked(pod)
         if node:
             bucket = self._pods_by_node.get(node)
             if bucket is not None:
@@ -325,6 +427,7 @@ class InMemoryAPIServer:
             name = pod["metadata"]["name"]
             if name in self._pods:
                 raise Conflict(f"pod {name} exists")
+            self._check_hard_quota_locked(pod)
             stored = copy.deepcopy(pod)
             stored.setdefault("spec", {})
             stored.setdefault("status", {"phase": "Pending"})
@@ -818,7 +921,7 @@ class InMemoryAPIServer:
 
     # ---- durability (cluster/wal.py) ---------------------------------------
 
-    _STORES = ("nodes", "pods", "pdbs", "pvcs", "pvs")
+    _STORES = ("nodes", "pods", "pdbs", "pvcs", "pvs", "quotas")
 
     def dump_state(self) -> dict:
         """JSON-serializable full object state for WAL snapshots.
@@ -890,6 +993,13 @@ class InMemoryAPIServer:
                     self._pods[name] = stored
                     self._index_pod_locked(stored)
                 return
+            if kind == "quota":
+                if event == "deleted":
+                    self._quotas.pop(name, None)
+                else:
+                    self._quotas[name] = copy.deepcopy(
+                        obj.get("spec") or {})
+                return
             store = {"node": self._nodes, "pdb": self._pdbs,
                      "pvc": self._pvcs, "pv": self._pvs}.get(kind)
             if store is None:
@@ -909,6 +1019,8 @@ class InMemoryAPIServer:
         self._pods_by_phase = {}
         self._chip_claims = {}
         self._coord_claims = {}
+        self._tenant_chips = {}
+        self._pod_tenant_chips = {}
         for pod in self._pods.values():
             self._index_pod_locked(pod)
 

@@ -28,9 +28,13 @@ not a request.
 
 from __future__ import annotations
 
+import itertools
 import random
 import threading
 import time
+
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import ContainerInfo, PodInfo
 
 # Verbs never faulted: local wiring, not requests on the wire.
 _PASSTHROUGH = {"add_watcher", "close"}
@@ -126,6 +130,101 @@ class ChaosNetwork:
                 self._count(component, "duplicate")
                 duplicate = True
             return delay_s, duplicate
+
+
+class TenantFlood:
+    """The abusive-tenant fault: N threads hammer pod creates for ONE
+    tenant as fast as the transport answers, deliberately ignoring the
+    server's advised retry-after (a well-behaved client would defer; an
+    abuser by definition does not). The driver behind the
+    ``tenant-flood`` chaos scenario (`cmd/simulate.py`): start it
+    against a front-doored apiserver, churn well-behaved tenants
+    alongside, and the priority-&-fairness layer plus the DRF chip
+    gate must hold their p99 while this runs.
+
+    ``pace_s`` models the floor a real network puts under even an
+    abusive client (one RTT per request); 0 is an infinitely fast
+    attacker. Counts are returned by :meth:`stop`:
+    ``accepted``/``rejected`` (typed 429s)/``errored``.
+    """
+
+    def __init__(self, client_factory, tenant: str = "abuser",
+                 threads: int = 4, chips: int = 1,
+                 pace_s: float = 0.001):
+        self._factory = client_factory
+        self.tenant = tenant
+        self.threads = threads
+        self.chips = chips
+        self.pace_s = pace_s
+        self._stop = threading.Event()
+        # racer: single-writer -- start()/stop() are the driver
+        # thread's lifecycle calls; flood workers never touch these
+        self._workers: list = []
+        # racer: single-writer -- same owner-thread lifecycle contract
+        self._clients: list = []
+        self._lock = threading.Lock()
+        self.accepted = 0
+        self.rejected = 0
+        self.errored = 0
+        self._seq = itertools.count()
+
+    def _flood_pod(self, name: str) -> dict:
+        pi = PodInfo(name=name)
+        pi.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: self.chips})
+        meta = {"name": name,
+                "labels": {"kgtpu.io/tenant": self.tenant}}
+        codec.pod_info_to_annotation(meta, pi)
+        return {"metadata": meta,
+                "spec": {"containers": [
+                    {"name": "main",
+                     "resources": {"requests": {"cpu": "1"}}}]}}
+
+    def _run(self, client) -> None:
+        from kubegpu_tpu.cluster.apf import TooManyRequests
+
+        while not self._stop.is_set():
+            name = f"{self.tenant}-flood-{next(self._seq)}"
+            try:
+                client.create_pod(self._flood_pod(name))
+                with self._lock:
+                    self.accepted += 1
+            except TooManyRequests:
+                # the front door shed us; an abuser retries immediately
+                with self._lock:
+                    self.rejected += 1
+            except Exception:
+                with self._lock:
+                    self.errored += 1
+            if self.pace_s > 0:
+                self._stop.wait(self.pace_s)
+
+    def start(self) -> "TenantFlood":
+        for _ in range(self.threads):
+            client = self._factory()
+            self._clients.append(client)
+            worker = threading.Thread(target=self._run, args=(client,),
+                                      daemon=True, name="tenant-flood")
+            self._workers.append(worker)
+            worker.start()
+        return self
+
+    def stop(self) -> dict:
+        """Stop the flood, join the workers, close their clients, and
+        return the accounting."""
+        self._stop.set()
+        for worker in self._workers:
+            worker.join(timeout=10.0)
+        self._workers = []
+        for client in self._clients:
+            close = getattr(client, "close", None)
+            if close is not None:
+                close()
+        self._clients = []
+        with self._lock:
+            return {"accepted": self.accepted,
+                    "rejected": self.rejected,
+                    "errored": self.errored}
 
 
 class ChaosProxy:

@@ -59,7 +59,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.analysis.explore import probe
 from kubegpu_tpu.cluster import stream
-from kubegpu_tpu.cluster.apiserver import Conflict, InMemoryAPIServer, NotFound
+from kubegpu_tpu.cluster.apf import APFDispatcher, TooManyRequests
+from kubegpu_tpu.cluster.apiserver import (Conflict, InMemoryAPIServer,
+                                           NotFound, QuotaExceeded)
 from kubegpu_tpu.cluster.lease import LeaseTable  # noqa: F401  (re-export:
 # the lease primitive moved to cluster/lease.py; the API server owns its
 # own table now and the routes below delegate to it)
@@ -554,12 +556,18 @@ def _split_path(path: str) -> tuple:
 
 
 def _error_body(e: Exception) -> dict:
-    """The error payload both wires send for NotFound/Conflict —
-    per-pod conflict/bind detail included (the binder's conflict
-    handling reconstructs the typed error from it)."""
+    """The error payload both wires send for typed errors (NotFound /
+    Conflict / QuotaExceeded / TooManyRequests) — per-pod conflict/bind
+    detail and the front door's advised retry_after_s included, so the
+    client reconstructs the identical typed error either wire carried
+    (the binder's conflict handling and the retry policy's advised
+    backoff both depend on it)."""
     body = {"error": str(e)}
     if getattr(e, "per_pod", None):
         body["per_pod"] = e.per_pod
+    retry_after = getattr(e, "retry_after_s", None)
+    if retry_after:
+        body["retry_after_s"] = retry_after
     return body
 
 
@@ -658,6 +666,14 @@ def _route_request(api: InMemoryAPIServer, log: _EventLog, method: str,
     if parts == ["bindvolume"] and method == "POST":
         api.bind_volume(body["pv"], body["pvc"])
         return 200, {}
+    if parts and parts[0] == "quotas":
+        if method == "GET" and len(parts) == 1:
+            return 200, {"items": api.list_quotas()}
+        if method == "PUT" and len(parts) == 2:
+            return 200, api.set_quota(parts[1], body)
+        if method == "DELETE" and len(parts) == 2:
+            api.delete_quota(parts[1])
+            return 200, {}
     if parts and parts[0] == "pdbs":
         if method == "GET" and len(parts) == 1:
             return 200, {"items": api.list_pdbs()}
@@ -697,7 +713,8 @@ def _route_request(api: InMemoryAPIServer, log: _EventLog, method: str,
 
 
 def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
-              wal=None, stream_wire: bool = True):
+              wal=None, stream_wire: bool = True,
+              apf: "APFDispatcher | None" = None):
     """Start serving; returns (ThreadingHTTPServer, base_url). The server
     runs on a daemon thread; ``server.shutdown()`` stops it COMPLETELY —
     live connections severed, the stream fan-out joined, the WAL handle
@@ -707,8 +724,24 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
     log are recovered from disk before the first request is served, and
     every subsequent event is logged write-ahead — watch resume
     (``since=seq``) survives a crash. ``stream_wire=False`` refuses the
-    ``kgtpu-stream`` upgrade (clients negotiate down to JSON)."""
+    ``kgtpu-stream`` upgrade (clients negotiate down to JSON). With
+    ``apf`` (a ``cluster.apf.APFDispatcher``), every request on BOTH
+    wires passes the priority-&-fairness front door before it reaches
+    the route table: system traffic is exempt, tenant flows queue
+    fairly, and shed work gets a typed 429 / REJECT frame carrying
+    retry-after."""
     log = _EventLog(api, wal=wal)
+
+    def _dispatch(method: str, parts: list, query: dict, body,
+                  peer: str):
+        """The ONE admission + routing path both wires share: a change
+        to how requests pass the front door lands here once, or the
+        wires drift."""
+        if apf is not None:
+            with apf.admit(method, parts, query, body, peer=peer):
+                return _route_request(api, log, method, parts, query,
+                                      body)
+        return _route_request(api, log, method, parts, query, body)
 
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 so keep-alive works: every _send sets Content-Length,
@@ -745,13 +778,18 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
         def _route(self, method: str):
             parts, query = _split_path(self.path)
             try:
+                body = self._body()
                 # re-install the caller's span context (if any) so the
                 # arbiter's and WAL's spans continue the caller's trace
                 # across the process boundary
                 with obs.remote_context(self.headers.get(obs.TRACE_HEADER)):
-                    status, obj = _route_request(api, log, method, parts,
-                                                 query, self._body())
+                    status, obj = _dispatch(method, parts, query, body,
+                                            self.client_address[0])
                 self._send(status, obj)
+            except TooManyRequests as e:
+                self._send(429, _error_body(e))
+            except QuotaExceeded as e:
+                self._send(403, _error_body(e))
             except NotFound as e:
                 self._send(404, _error_body(e))
             except Conflict as e:
@@ -853,8 +891,21 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
                     parts, query = _split_path(path)
                     try:
                         with obs.remote_context(trace):
-                            status, obj = _route_request(
-                                api, log, method, parts, query, body)
+                            status, obj = _dispatch(
+                                method, parts, query, body,
+                                self.client_address[0])
+                    except TooManyRequests as e:
+                        # flow control is a first-class frame, not a
+                        # response: the 429 body (with retry_after_s)
+                        # rides a REJECT echoing the request id
+                        status, obj = 429, _error_body(e)
+                        stream.send_frame(conn, wlock, stream.REJECT,
+                                          rid,
+                                          codec.encode_response(status,
+                                                                obj))
+                        continue
+                    except QuotaExceeded as e:
+                        status, obj = 403, _error_body(e)
                     except NotFound as e:
                         status, obj = 404, _error_body(e)
                     except Conflict as e:
@@ -1025,6 +1076,9 @@ class HTTPAPIClient:
         # transport-level retries performed; bumped under _conn_lock —
         # every thread with a keep-alive connection retries through here
         self.retry_count = 0
+        # 429/REJECT flow-control answers honored (the retry deferred
+        # by the server-advised retry_after_s); same guard discipline
+        self.throttled_count = 0
         self.watch_errors = 0  # failed watch polls survived
         self.relist_count = 0  # watch resume gaps that forced a relist
 
@@ -1148,7 +1202,11 @@ class HTTPAPIClient:
         failures (connection reset, refused, timeout, torn/corrupt
         frames) with capped exponential backoff + jitter; a *response* —
         any status, either wire — is the server speaking and is never
-        retried here."""
+        blind-retried here. The one exception is flow control: a 429 /
+        REJECT carries the server's advised ``retry_after_s``, and the
+        idempotent-retry policy HONORS it (the advised delay replaces
+        the computed backoff) before resending; POSTs stay single-shot
+        and surface the typed :class:`TooManyRequests` to the caller."""
         attempts = self.RETRY_ATTEMPTS \
             if method in self.IDEMPOTENT_METHODS else 1
         for attempt in range(attempts):
@@ -1167,6 +1225,26 @@ class HTTPAPIClient:
                 continue
             if status < 400:
                 return doc if isinstance(doc, dict) else {}
+            if status == 429:
+                if attempt + 1 < attempts:
+                    advised = float(doc.get("retry_after_s") or 0.0) \
+                        if isinstance(doc, dict) else 0.0
+                    self._count_throttle()
+                    backoff = min(self.RETRY_CAP_S,
+                                  self.RETRY_BASE_S * 2 ** attempt)
+                    # server-advised backoff wins over the computed
+                    # one: the front door knows its queue depth, we
+                    # don't (a fleet resending early is exactly the
+                    # flood APF sheds). Jitter spreads resends ABOVE
+                    # the advised floor — resending early would defeat
+                    # the advice.
+                    delay = advised if advised > 0 else backoff
+                    self._stop.wait(delay *
+                                    (1.0 + random.random() / 4.0))
+                    continue
+                raise self._server_error(TooManyRequests, doc)
+            if status == 403:
+                raise self._server_error(QuotaExceeded, doc)
             if status == 404:
                 if method == "DELETE" and attempt > 0:
                     # Our earlier attempt may have landed and lost its
@@ -1185,15 +1263,28 @@ class HTTPAPIClient:
 
     @staticmethod
     def _server_error(cls, doc):
-        """Reconstruct a NotFound/Conflict from the error document,
-        per-pod detail included — the binder's conflict handling needs
-        the same ``per_pod`` the in-memory server raises with."""
+        """Reconstruct a typed server error from the error document —
+        per-pod detail (the binder's conflict handling needs the same
+        ``per_pod`` the in-memory server raises with) and the front
+        door's advised ``retry_after_s`` (which the retry policy
+        honors) both survive the wire."""
         per_pod = None
         text = str(doc)
+        retry_after = None
         if isinstance(doc, dict):
             per_pod = doc.get("per_pod")
             text = doc.get("error", text)
+            retry_after = doc.get("retry_after_s")
+        if cls is TooManyRequests:
+            return cls(text, per_pod=per_pod,
+                       retry_after_s=float(retry_after or 0.0))
         return cls(text, per_pod=per_pod)
+
+    def _count_throttle(self) -> None:
+        """Count one honored flow-control rejection, guarded like
+        ``_count_retry`` (any thread's request can be shed)."""
+        with self._conn_lock:
+            self.throttled_count += 1
 
     # -- node/pod surface ---------------------------------------------------
 
@@ -1319,6 +1410,21 @@ class HTTPAPIClient:
     def bind_volume(self, pv_name, claim_name):
         return self._req("POST", "/bindvolume",
                          {"pv": pv_name, "pvc": claim_name})
+
+    # -- tenant quotas -------------------------------------------------------
+
+    def list_quotas(self):
+        """{tenant: quota spec + live chips_created} — the admin view
+        of the tenant ledger."""
+        return self._req("GET", "/quotas")["items"]
+
+    def set_quota(self, tenant, spec):
+        """Configure a tenant's fair-share ``weight`` and/or create-time
+        ``hard_chips`` cap."""
+        return self._req("PUT", f"/quotas/{tenant}", spec)
+
+    def delete_quota(self, tenant):
+        return self._req("DELETE", f"/quotas/{tenant}")
 
     def record_event(self, kind, name, event_type, reason, message):
         return self._req("POST", "/events",

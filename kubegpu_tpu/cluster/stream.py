@@ -25,6 +25,10 @@ Types::
                              unsolicited; this is what retires the
                              long-poll re-request per batch
     PING  either direction   liveness; empty payload, never acked
+    REJECT server -> client  flow control: the priority-&-fairness
+                             front door shed the request; the payload
+                             is a 429 response carrying retry_after_s,
+                             echoed with the REQ's id
 
 A torn, corrupt, oversized, or out-of-protocol frame poisons exactly ONE
 connection: the reader raises :class:`FrameError` (a ``ConnectionError``,
@@ -57,8 +61,14 @@ RESP = 2
 SUB = 3
 PUSH = 4
 PING = 5
+# Flow control: the front door (cluster/apf.py) shed this request. The
+# payload is an encode_response(429, body) whose body carries the
+# advised retry_after_s — a first-class frame type (not a RESP) so
+# back-pressure is distinguishable at the framing layer, mirroring the
+# HTTP 429 the JSON wire sends.
+REJECT = 6
 
-_FRAME_TYPES = frozenset({REQ, RESP, SUB, PUSH, PING})
+_FRAME_TYPES = frozenset({REQ, RESP, SUB, PUSH, PING, REJECT})
 
 # One frame must fit a full list response for a 4k-node fleet with slack;
 # anything larger is a protocol violation, not a workload.
@@ -215,6 +225,14 @@ class StreamConn:
                 ftype, got_rid, data = read_frame(self._rfile)
                 if ftype == PING:
                     continue
+                if ftype == REJECT and got_rid == rid:
+                    # flow control: the front door shed this request;
+                    # the payload is a (429, body) response whose body
+                    # advises retry_after_s — surfaced through the same
+                    # status path as the JSON wire so the caller's
+                    # typed-error reconstruction is wire-agnostic
+                    return _timed(metrics.FRAME_DECODE_MS, _decode,
+                                  codec.decode_response, data)
                 if ftype != RESP or got_rid != rid:
                     raise FrameError(
                         f"unexpected frame type {ftype} rid {got_rid} "

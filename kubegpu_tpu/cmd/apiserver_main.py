@@ -41,6 +41,13 @@ def main(argv=None) -> int:
                              "process crashes, not power loss)")
     parser.add_argument("--wal-snapshot-every", type=int, default=4096,
                         help="events between snapshot+compaction passes")
+    parser.add_argument("--apf", action="store_true",
+                        help="enable the priority-&-fairness front "
+                             "door: per-flow shuffle-sharded fair "
+                             "queuing with bounded concurrency on both "
+                             "wires; system traffic (heartbeats, "
+                             "leases, watch) is exempt and shed work "
+                             "gets a typed 429/REJECT with retry-after")
     args = parser.parse_args(argv)
 
     api = InMemoryAPIServer()
@@ -50,10 +57,16 @@ def main(argv=None) -> int:
 
         wal = WriteAheadLog(args.wal_dir, fsync=not args.wal_no_fsync,
                             snapshot_every=args.wal_snapshot_every)
+    apf = None
+    if args.apf:
+        from kubegpu_tpu.cluster.apf import APFDispatcher
+
+        apf = APFDispatcher()
     server, url = serve_api(api, args.host, args.port, wal=wal,
-                            stream_wire=args.wire == "stream")
+                            stream_wire=args.wire == "stream", apf=apf)
     print(f"apiserver listening at {url} (wire: {args.wire}+json)"
-          + (f" (WAL at {args.wal_dir})" if wal else ""), flush=True)
+          + (f" (WAL at {args.wal_dir})" if wal else "")
+          + (" (APF front door on)" if apf else ""), flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())

@@ -70,13 +70,20 @@ def build_scheduler(client, args, config: dict | None = None,
     extenders = load_extenders(config)
     if policy and policy.get("extenders"):
         extenders += load_extenders({"extenders": policy["extenders"]})
+    quota = None
+    if getattr(args, "tenant_quota", False):
+        from kubegpu_tpu.scheduler.quota import DRFQuotaGate
+
+        # per-tenant fair-share weights ride the config file:
+        # {"tenantWeights": {"acme": 2.0, ...}}
+        quota = DRFQuotaGate(weights=config.get("tenantWeights"))
     sched = Scheduler(client, ds, bind_async=bool(args.bind_async),
                       parallelism=args.parallelism,
                       extenders=extenders,
                       priority_weights=config.get("priorityWeights"),
                       algorithm=algorithm,
                       bind_workers=getattr(args, "bind_workers", 4),
-                      shard_owned=shard_owned, name=name)
+                      shard_owned=shard_owned, name=name, quota=quota)
     sched.preemption_enabled = not args.disable_preemption
     return sched
 
@@ -129,6 +136,13 @@ def main(argv=None) -> int:
                              "first-event latency for fuller, coalesced "
                              "event batches")
     parser.add_argument("--disable-preemption", action="store_true")
+    parser.add_argument("--tenant-quota", action="store_true",
+                        help="dominant-resource fair-share chip quotas "
+                             "across tenants (pods labeled "
+                             "kgtpu.io/tenant): over-share tenants park "
+                             "with a typed QuotaExceeded reason at pod-"
+                             "pop time and re-admit on chip release; "
+                             "weights via config tenantWeights")
     parser.add_argument("--leader-elect", action="store_true",
                         help="active/standby HA: contend for one lease; "
                              "only the holder schedules")
@@ -170,11 +184,13 @@ def main(argv=None) -> int:
                                       "bind_workers", "watch_batch_ms",
                                       "replicas", "shard"])
 
-    # kind-filtered watch: the scheduler consumes node/pod/pv/pvc events
-    # only, so Event records never pay encode/decode on this stream
+    # kind-filtered watch: the scheduler consumes node/pod/pv/pvc (and
+    # tenant-quota config) events only, so Event records never pay
+    # encode/decode on this stream
     client = HTTPAPIClient(args.api,
                            watch_batch_s=args.watch_batch_ms / 1e3,
-                           watch_kinds=("node", "pod", "pv", "pvc"),
+                           watch_kinds=("node", "pod", "pv", "pvc",
+                                        "quota"),
                            wire=args.wire)
     holder = f"{os.uname().nodename}-{os.getpid()}"
     stop = threading.Event()

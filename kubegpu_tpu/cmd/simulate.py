@@ -97,6 +97,20 @@ def _ha_summary() -> dict:
             "wal_snapshot_bytes": metrics.WAL_SNAPSHOT_BYTES.value}
 
 
+def _apf_summary() -> dict:
+    """Multi-tenant front-door health (metrics.py): queue wait of
+    admitted requests, rejects per band (system must stay zero — it is
+    exempt by construction), and pods the DRF chip gate parked."""
+    return {"apf_queue_wait_p50_ms": round(
+                metrics.APF_QUEUE_WAIT_MS.percentile(0.5), 4),
+            "apf_queue_wait_p99_ms": round(
+                metrics.APF_QUEUE_WAIT_MS.percentile(0.99), 4),
+            "apf_rejects_total": {
+                band: child.value for (band,), child
+                in metrics.APF_REJECTS.children()},
+            "quota_parked_total": metrics.QUOTA_PARKED.value}
+
+
 def _gang_chips(api, name):
     """Chip-id list a bound pod's allocation annotation pins — the raw
     persisted decision, read back via the codec's decode half."""
@@ -403,6 +417,292 @@ def run_ha_chaos_scenario(pods_before: int = 6, pods_mid: int = 3,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def tenant_pod(name, tenant, numchips=1):
+    """A tenant-labeled workload pod for the multi-tenant scenarios."""
+    pod = make_pod(name, numchips)
+    pod["metadata"].setdefault("labels", {})["kgtpu.io/tenant"] = tenant
+    return pod
+
+
+def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
+                              flood_threads: int = 3,
+                              flood_pace_s: float = 0.005,
+                              p99_ratio_limit: float = 2.0,
+                              deadline_s: float = 60.0,
+                              wire: str = "stream"):
+    """The ``tenant-flood`` chaos scenario: one abusive tenant floods
+    pod creates through the priority-&-fairness front door while N
+    well-behaved tenants churn 1-chip pods, heartbeats flow, a lease
+    renews, and the node lifecycle controller watches for stale nodes.
+
+    Measured quiet first (same cluster, no flood), then under flood.
+    Raises unless ALL of:
+
+    * every well-behaved pod still places, and the well-behaved
+      create->bound p99 holds within ``p99_ratio_limit`` of quiet;
+    * zero lease losses (renewals ride the exempt system band);
+    * zero heartbeat-driven node evictions or Lost transitions;
+    * the system band rejected nothing;
+    * the DRF gate actually engaged (the abuser parked) and its bound
+      chips stayed at/below its fair share (+1 pod of slack for an
+      admit racing the last release);
+    * the flood never starved the front door shut for well-behaved
+      tenants (their churn completed before the deadline).
+
+    Returns the accounting: per-phase p99s, flood counts, front-door
+    and quota summaries."""
+    import threading
+
+    from kubegpu_tpu.cluster.apf import (APFDispatcher, BandConfig,
+                                         BAND_SYSTEM, BAND_WORKLOAD,
+                                         TooManyRequests)
+    from kubegpu_tpu.cluster.chaos import TenantFlood
+    from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+    from kubegpu_tpu.cluster.lease import Elector
+    from kubegpu_tpu.scheduler.lifecycle import NodeLifecycle
+    from kubegpu_tpu.scheduler.quota import DRFQuotaGate
+
+    api = InMemoryAPIServer()
+    # a deliberately tight workload band: the flood must queue and shed
+    # there while system traffic bypasses the front door entirely
+    apf = APFDispatcher(bands={
+        BAND_WORKLOAD: BandConfig(seats=6, queues=16, queue_len=16,
+                                  queue_wait_s=0.5, hand=4)})
+    server, url = serve_api(api, apf=apf)
+    admin = HTTPAPIClient(url, wire=wire)
+    mgrs = []
+    advs = []
+    closers = []
+    elector = lifecycle = sched = None
+    try:
+        origins = [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)]
+        for i, origin in enumerate(origins):
+            name = f"host{i}"
+            admin.create_node({"metadata": {"name": name},
+                               "status": {"allocatable": {"cpu": "64",
+                                                          "pods": 10000}}})
+            mgr = DevicesManager()
+            mgr.add_device(TPUDeviceManager(FakeTPUBackend(
+                v5p_host_inventory(host_origin=origin,
+                                   mesh_dims=(4, 4, 1)))))
+            mgr.start()
+            mgrs.append(mgr)
+            adv_client = HTTPAPIClient(url, wire=wire)
+            closers.append(adv_client)
+            adv = DeviceAdvertiser(adv_client, mgr, name)
+            adv.start(interval_s=0.15, retry_s=0.05)
+            advs.append(adv)
+
+        ds = DevicesScheduler()
+        ds.add_device(TPUScheduler())
+        gate = DRFQuotaGate(hungry_grace_s=2.0)
+        sched_client = HTTPAPIClient(url, watch_batch_s=0.002,
+                                     watch_kinds=("node", "pod", "pv",
+                                                  "pvc", "quota"),
+                                     wire=wire)
+        closers.append(sched_client)
+        sched = Scheduler(sched_client, ds, bind_async=True, quota=gate)
+        sched.start()
+
+        life_client = HTTPAPIClient(url, wire=wire)
+        closers.append(life_client)
+        lifecycle = NodeLifecycle(life_client, stale_after_s=0.6,
+                                  lost_after_s=2.0)
+        lifecycle.start(interval_s=0.1)
+
+        lease_client = HTTPAPIClient(url, wire=wire)
+        closers.append(lease_client)
+        elector = Elector(lease_client.acquire_lease, "flood-lease",
+                          "survivor", ttl_s=0.6)
+        elector.start(interval_s=0.15)
+
+        # bound/deleted completion straight off the admin watch stream
+        bound_seen: dict = {}
+        deleted_seen: dict = {}
+
+        def track(kind, event, obj):
+            if kind != "pod":
+                return
+            pname = obj["metadata"]["name"]
+            if event in ("added", "modified") and \
+                    (obj.get("spec") or {}).get("nodeName"):
+                ev = bound_seen.get(pname)
+                if ev is not None:
+                    ev.set()
+            elif event == "deleted":
+                ev = deleted_seen.get(pname)
+                if ev is not None:
+                    ev.set()
+
+        admin.add_watcher(track)
+
+        tenant_names = [f"tenant-{i}" for i in range(tenants)]
+
+        def churn(tenant, phase, latencies, errors):
+            """One well-behaved tenant: sequential create -> bound ->
+            delete churn, honoring any front-door retry-after like a
+            good citizen. Latency is the full user-visible
+            create->bound span, throttle waits included."""
+            client = HTTPAPIClient(url, wire=wire)
+            try:
+                for k in range(churn_pods):
+                    pname = f"{tenant}-{phase}-{k}"
+                    bound_seen[pname] = threading.Event()
+                    t0 = time.perf_counter()
+                    for _attempt in range(200):
+                        try:
+                            client.create_pod(
+                                tenant_pod(pname, tenant))
+                            break
+                        except TooManyRequests as e:
+                            time.sleep(max(0.01, e.retry_after_s))
+                    else:
+                        errors.append(f"{pname}: create never admitted")
+                        return
+                    if not bound_seen[pname].wait(deadline_s):
+                        errors.append(f"{pname}: never bound")
+                        return
+                    latencies.append(time.perf_counter() - t0)
+                    deleted_seen[pname] = threading.Event()
+                    for _attempt in range(200):
+                        try:
+                            client.delete_pod(pname)
+                            break
+                        except TooManyRequests as e:
+                            # the DELETE's own idempotent retries
+                            # exhausted under flood: keep being a good
+                            # citizen rather than dying silently
+                            time.sleep(max(0.01, e.retry_after_s))
+                    else:
+                        errors.append(f"{pname}: delete never admitted")
+                        return
+                    deleted_seen[pname].wait(10.0)
+            finally:
+                client.close()
+
+        def run_phase(phase):
+            latencies: list = []
+            errors: list = []
+            threads = [threading.Thread(target=churn,
+                                        args=(t, phase, latencies,
+                                              errors),
+                                        daemon=True)
+                       for t in tenant_names]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=deadline_s * 2)
+            hung = sum(1 for t in threads if t.is_alive())
+            if hung:
+                # a join timeout is not success: a wedged churn thread
+                # would otherwise slip past the placement invariants
+                # with partial latency data
+                errors.append(f"{hung} churn thread(s) still running "
+                              f"after {deadline_s * 2:.0f}s")
+            if errors:
+                raise RuntimeError(
+                    f"{phase} churn failed: {errors[:4]} "
+                    f"(faults so far: front_door={_apf_summary()})")
+            return latencies
+
+        def p99(lat):
+            s = sorted(lat)
+            return s[int(0.99 * (len(s) - 1))] * 1e3
+
+        quiet_lat = run_phase("quiet")
+
+        lease_transitions_before = elector.transitions
+        node_lost_before = metrics.NODE_LOST.value
+        evicted_before = lifecycle.evicted_total
+        quota_parked_before = metrics.QUOTA_PARKED.value
+
+        flood = TenantFlood(
+            lambda: HTTPAPIClient(url, wire=wire),
+            tenant="abuser", threads=flood_threads,
+            pace_s=flood_pace_s).start()
+        try:
+            flood_lat = run_phase("flood")
+        finally:
+            flood_counts = flood.stop()
+
+        quiet_p99 = p99(quiet_lat)
+        flood_p99 = p99(flood_lat)
+        ratio = flood_p99 / quiet_p99 if quiet_p99 > 0 else 0.0
+
+        # the abuser's bound chips must sit at/below its DRF fair share
+        # (tenants+1 actors; +1 pod slack for an admit that raced the
+        # final release). Capacity is derived from the nodes actually
+        # advertised, never assumed from the topology constants above.
+        from kubegpu_tpu.cluster.apf import pod_chip_request
+        from kubegpu_tpu.scheduler.quota import node_resource_totals
+
+        abuser_bound = sum(
+            pod_chip_request(p) for p in admin.list_pods(bound=True)
+            if ((p["metadata"].get("labels") or {})
+                .get("kgtpu.io/tenant")) == "abuser")
+        total_chips = sum(node_resource_totals(n)["chips"]
+                          for n in admin.list_nodes())
+        fair_chips = total_chips / (tenants + 1)
+
+        front_door = _apf_summary()
+        failures = []
+        if ratio > p99_ratio_limit:
+            failures.append(
+                f"well-behaved p99 degraded {ratio:.2f}x under flood "
+                f"({quiet_p99:.1f} -> {flood_p99:.1f} ms, limit "
+                f"{p99_ratio_limit}x)")
+        if elector.transitions != lease_transitions_before:
+            failures.append(
+                f"lease lost during flood ({elector.transitions - lease_transitions_before} transition(s))")
+        if metrics.NODE_LOST.value != node_lost_before or \
+                lifecycle.evicted_total != evicted_before:
+            failures.append("heartbeat-driven node loss/eviction "
+                            "during flood")
+        if front_door["apf_rejects_total"].get(BAND_SYSTEM, 0):
+            failures.append("system band traffic was rejected")
+        if sched_client.relist_count != 0:
+            failures.append(
+                f"scheduler watch lost its resume window under flood "
+                f"({sched_client.relist_count} relist(s))")
+        quota_parked_during = \
+            metrics.QUOTA_PARKED.value - quota_parked_before
+        if gate.parked_count() == 0 and quota_parked_during == 0:
+            # the DELTA, not the process-global counter: earlier runs
+            # in the same process must not mask a no-op gate
+            failures.append("DRF gate never engaged against the flood")
+        if abuser_bound > fair_chips + 1:
+            failures.append(
+                f"abuser bound {abuser_bound} chips, over its fair "
+                f"share of {fair_chips:.1f}")
+        if failures:
+            raise RuntimeError("tenant-flood invariants violated: "
+                               + "; ".join(failures))
+        return {"wellbehaved_quiet_p99_ms": round(quiet_p99, 2),
+                "wellbehaved_flood_p99_ms": round(flood_p99, 2),
+                "p99_ratio": round(ratio, 2),
+                "flood": flood_counts,
+                "abuser_bound_chips": abuser_bound,
+                "abuser_fair_chips": round(fair_chips, 1),
+                "quota_parked": quota_parked_during,
+                "front_door": front_door,
+                "lease_transitions": elector.transitions,
+                "watch_relists": sched_client.relist_count,
+                "evictions": lifecycle.evicted_total}
+    finally:
+        if elector is not None:
+            elector.stop()
+        if lifecycle is not None:
+            lifecycle.stop()
+        for adv in advs:
+            adv.stop()
+        if sched is not None:
+            sched.stop()
+        for closer in closers:
+            closer.close()
+        admin.close()
+        server.shutdown()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--hosts", type=int, default=4)
@@ -417,6 +717,13 @@ def main(argv=None) -> int:
                         help="run the HA scenario: scheduler-kill + "
                              "WAL-backed apiserver restart under 2 "
                              "replicas")
+    parser.add_argument("--chaos-tenant-flood", action="store_true",
+                        help="run the multi-tenant overload scenario: "
+                             "one abusive tenant floods creates through "
+                             "the priority-&-fairness front door while "
+                             "well-behaved tenants churn; asserts p99 "
+                             "isolation, zero lease losses, zero "
+                             "heartbeat evictions")
     parser.add_argument("--seed", type=int, default=0,
                         help="chaos transport seed")
     parser.add_argument("--wire", choices=("stream", "json"),
@@ -450,6 +757,25 @@ def main(argv=None) -> int:
                   f"{result['recovery_ms']:.0f} ms "
                   f"({result['first_placement']} -> "
                   f"{result['final_placement']})")
+        return 0
+
+    if args.chaos_tenant_flood:
+        result = run_tenant_flood_scenario(wire=args.wire)
+        result["wire_protocol"] = args.wire
+        dump_trace()
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            print(f"tenant flood: well-behaved p99 "
+                  f"{result['wellbehaved_quiet_p99_ms']} -> "
+                  f"{result['wellbehaved_flood_p99_ms']} ms "
+                  f"({result['p99_ratio']}x) while the abuser had "
+                  f"{result['flood']['accepted']} creates admitted / "
+                  f"{result['flood']['rejected']} shed, "
+                  f"{result['quota_parked']} pods quota-parked, "
+                  f"abuser bound {result['abuser_bound_chips']} of "
+                  f"{result['abuser_fair_chips']} fair chips; "
+                  f"0 lease losses, 0 evictions")
         return 0
 
     if args.chaos_ha:

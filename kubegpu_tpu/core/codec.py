@@ -231,6 +231,10 @@ _STATIC_STRINGS: "tuple[str, ...]" = (
     "events", "seq", "coalesced", "relist", "epoch", "items",
     # error detail
     "error", "per_pod", "bindings", "holder", "ttl",
+    # multi-tenant front door (appended last: static ids are wire
+    # protocol, so existing indexes must never shift)
+    "retry_after_s", "tenant", "kgtpu.io/tenant", "quota", "weight",
+    "hard_chips", "chips_created",
 )
 _STATIC_INDEX = {s: i for i, s in enumerate(_STATIC_STRINGS)}
 

@@ -246,6 +246,16 @@ TRANSPORT_BYTES = LabeledCounter("transport_bytes_total", ("wire", "dir"))
 FRAME_ENCODE_MS = Histogram("frame_encode_ms", start_us=0.002)
 FRAME_DECODE_MS = Histogram("frame_decode_ms", start_us=0.002)
 WATCH_PUSH_LAG_MS = Histogram("watch_push_lag_ms", start_us=0.01)
+# Multi-tenant front door (cluster/apf.py + scheduler/quota.py):
+# apf_queue_wait_ms is how long admitted requests waited for a band
+# seat; apf_rejects_total{band} counts requests shed with a typed 429 /
+# REJECT frame (the system band is exempt, so a nonzero system child is
+# a front-door bug); quota_parked_total counts pods the dominant-
+# resource fair-share gate parked at pop time (re-admitted on chip
+# release, never dropped).
+APF_QUEUE_WAIT_MS = Histogram("apf_queue_wait_ms", start_us=0.01)
+APF_REJECTS = LabeledCounter("apf_rejects_total", ("band",))
+QUOTA_PARKED = Counter("quota_parked_total")
 
 
 def all_metrics() -> list:

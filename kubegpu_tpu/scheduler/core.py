@@ -1195,7 +1195,8 @@ class Scheduler:
                  algorithm: factory.AlgorithmConfig | None = None,
                  bind_workers: int = 4,
                  shard_owned: Callable[[str], bool] | None = None,
-                 name: str | None = None) -> None:
+                 name: str | None = None,
+                 quota: Any | None = None) -> None:
         from kubegpu_tpu.scheduler.gang import GangBuffer, GangPlanner
 
         self.api = api
@@ -1261,6 +1262,15 @@ class Scheduler:
         self._conflict_lock = threading.Lock()
         self._conflict_streak: dict = {}
         self.resync_count = 0  # full relists performed (apiserver restart)
+        # Dominant-resource fair-share chip quota gate
+        # (scheduler/quota.py), consulted at pod-pop time BEFORE any
+        # allocation work: tenants over their fair share park in the
+        # gate (typed QuotaExceeded reason) and re-queue promptly when
+        # chips release. None = no tenancy enforcement (the default —
+        # single-tenant deployments pay nothing).
+        self.quota = quota
+        if quota is not None:
+            quota.requeue = self.queue.push
         self._stop = threading.Event()
         # A transport exposing batched watch delivery (HTTPAPIClient)
         # gets the whole batch applied under one cache lock; the
@@ -1304,11 +1314,19 @@ class Scheduler:
     def _sync_existing(self) -> None:
         """Cold start / restart: rebuild state from the API server — the
         annotations are the checkpoint."""
+        self._sync_quota_weights()
         for node in self.api.list_nodes():
             self.cache.set_node(node)
+            if self.quota is not None:
+                self.quota.set_node(node)
         for pod in self.api.list_pods():
             self._view_store(pod)
             node_name = (pod.get("spec") or {}).get("nodeName")
+            if self.quota is not None:
+                if node_name:
+                    self.quota.pod_bound(pod)
+                else:
+                    self.quota.pod_pending(pod)
             if node_name:
                 self.cache.add_pod(pod, node_name)
             else:
@@ -1367,6 +1385,9 @@ class Scheduler:
             if node_name:
                 ops.append((self.cache.remove_pod, (obj, node_name)))
         self.cache.apply_batch(ops)
+        if self.quota is not None:
+            self.quota.resync(nodes, pods)
+            self._sync_quota_weights()
         for pod in pods:
             if not (pod.get("spec") or {}).get("nodeName"):
                 self.queue.push(pod)
@@ -1380,13 +1401,24 @@ class Scheduler:
             name = obj["metadata"]["name"]
             if event in ("added", "modified"):
                 self.cache.set_node(obj)
+                if self.quota is not None:
+                    self.quota.set_node(obj)
                 self.queue.move_all_to_active()
             elif event == "deleted":
                 self.cache.remove_node(name)
+                if self.quota is not None:
+                    self.quota.drop_node(name)
         elif kind == "pod":
             node_name = (obj.get("spec") or {}).get("nodeName")
             if event in ("added", "modified"):
                 self._view_store(obj)
+            if self.quota is not None:
+                if event == "deleted":
+                    self.quota.pod_gone(obj)
+                elif node_name:
+                    self.quota.pod_bound(obj)
+                else:
+                    self.quota.pod_pending(obj)
             if event == "added" and not node_name:
                 self.queue.push(obj)
             elif event in ("added", "modified") and node_name:
@@ -1413,10 +1445,54 @@ class Scheduler:
                 if node_name:
                     self.cache.remove_pod(obj, node_name)
                 self.queue.move_all_to_active()
+        elif kind == "quota" and self.quota is not None:
+            self._apply_quota_event(event, obj)
         elif kind in ("pv", "pvc"):
             # a new/changed volume can make an unschedulable PVC pod
             # feasible (unbound-PVC pods wait for a matching PV)
             self.queue.move_all_to_active()
+
+    def _sync_quota_weights(self) -> None:
+        """Cold start / relist: load the persisted tenant weights so a
+        restarted (or watch-gapped) replica computes the same fair
+        shares as one that saw every quota event — deltas alone would
+        leave it on the default weight."""
+        if self.quota is None:
+            return
+        list_quotas = getattr(self.api, "list_quotas", None)
+        if list_quotas is None:
+            return  # transport without a quota surface
+        try:
+            quotas = list_quotas()
+        except Exception:
+            log.warning("quota weight sync failed; weights follow "
+                        "watch events until the next resync",
+                        exc_info=True)
+            return
+        # wholesale replacement: a quota deleted during a watch gap
+        # must revert to the default weight, not survive a merge
+        self.quota.set_weights(
+            {tenant: float((spec or {}).get("weight") or 1.0)
+             for tenant, spec in quotas.items()})
+
+    def _apply_quota_event(self, event: str, obj: dict) -> None:
+        """Quota config changed on the apiserver: feed the tenant's
+        fair-share weight to the DRF gate (a deleted quota reverts to
+        the default weight). The apiserver emits these as ``quota``
+        watch records; clients that should react must include the kind
+        in their watch filter."""
+        tenant = (obj.get("metadata") or {}).get("name")
+        if not tenant:
+            return
+        if event == "deleted":
+            self.quota.set_weight(tenant, 1.0)
+            return
+        # set_quota replaces the spec wholesale, so a spec WITHOUT a
+        # weight means "default", not "keep the old one" — otherwise a
+        # running replica and a restarted one would diverge
+        weight = (obj.get("spec") or {}).get("weight")
+        self.quota.set_weight(
+            tenant, float(weight) if weight is not None else 1.0)
 
     def _on_event_batch(self, events: list) -> None:
         """Batched informer apply (HTTP transport): the whole watch batch
@@ -1433,15 +1509,27 @@ class Scheduler:
             if kind == "node":
                 if event in ("added", "modified"):
                     ops.append((self.cache.set_node, (obj,)))
+                    if self.quota is not None:
+                        post.append((self.quota.set_node, (obj,)))
                     wake = True
                 elif event == "deleted":
                     ops.append((self.cache.remove_node,
                                 (obj["metadata"]["name"],)))
+                    if self.quota is not None:
+                        post.append((self.quota.drop_node,
+                                     (obj["metadata"]["name"],)))
             elif kind == "pod":
                 name = obj["metadata"]["name"]
                 node_name = (obj.get("spec") or {}).get("nodeName")
                 if event in ("added", "modified"):
                     self._view_store(obj)
+                if self.quota is not None:
+                    if event == "deleted":
+                        post.append((self.quota.pod_gone, (obj,)))
+                    elif node_name:
+                        post.append((self.quota.pod_bound, (obj,)))
+                    else:
+                        post.append((self.quota.pod_pending, (obj,)))
                 if event == "added" and not node_name:
                     post.append((self.queue.push, (obj,)))
                 elif event in ("added", "modified") and node_name:
@@ -1465,6 +1553,8 @@ class Scheduler:
                     if node_name:
                         ops.append((self.cache.remove_pod, (obj, node_name)))
                     wake = True
+            elif kind == "quota" and self.quota is not None:
+                post.append((self._apply_quota_event, (event, obj)))
             elif kind in ("pv", "pvc"):
                 wake = True
         if ops:
@@ -1518,6 +1608,10 @@ class Scheduler:
             self._handle_gang_pod(kube_pod, *gang)
             return True
 
+        if self.quota is not None and \
+                not self._quota_admit([kube_pod], kube_pod):
+            return True  # over fair share: parked in the gate
+
         metrics.SCHEDULE_ATTEMPTS.inc()
         t0 = time.perf_counter()
         self.cache.expire_assumed()
@@ -1529,6 +1623,7 @@ class Scheduler:
                     # selection (another pod grabbed the PV): requeue, the
                     # next pass recomputes against fresh PV state
                     metrics.SCHEDULE_FAILURES.inc()
+                    self._quota_forget(kube_pod)
                     self._event(name, "Warning", "FailedScheduling",
                                 f"volume binding lost race on {host}")
                     self.queue.add_unschedulable(kube_pod)
@@ -1541,6 +1636,7 @@ class Scheduler:
             except FitError as err:
                 self.volume_binder.forget(name)
                 metrics.SCHEDULE_FAILURES.inc()
+                self._quota_forget(kube_pod)
                 summary = self._summarize_failures(err.failures)
                 cyc.attrs["outcome"] = "unschedulable"
                 # the "why is this pod Pending" record /debug/pod serves:
@@ -1567,6 +1663,7 @@ class Scheduler:
                 # state).
                 self.volume_binder.forget(name)
                 metrics.INTERNAL_ERRORS.inc()
+                self._quota_forget(kube_pod)
                 cyc.attrs["outcome"] = "internal_error"
                 logging.getLogger(__name__).exception(
                     "internal scheduler error while scheduling %s", name)
@@ -1587,6 +1684,38 @@ class Scheduler:
             else:
                 self._bind(kube_pod, host, t0, parent=cyc.context())
         return True
+
+    def _quota_forget(self, *pods: dict) -> None:
+        """Discharge quota in-flight charges for pods whose scheduling
+        cycle failed AFTER admission (FitError, volume race, internal
+        error, gang refusal): they re-admit on their next pop, and a
+        lingering charge would phantom-bill the tenant meanwhile."""
+        if self.quota is None:
+            return
+        for pod in pods:
+            self.quota.forget(pod["metadata"]["name"])
+
+    def _quota_admit(self, members: list, park_pod: dict) -> bool:
+        """All-or-nothing DRF quota gate for one pod or one assembled
+        gang, run BEFORE any filter/allocate work. False = the tenant
+        is over its dominant-resource fair share while others are
+        hungry: the popped pod parks in the GATE (zero queue churn
+        while over share — chip releases re-queue it promptly) and the
+        typed QuotaExceeded reason lands in the pod's event stream and
+        ``/debug/pod/<name>`` timeline."""
+        from kubegpu_tpu.cluster.apiserver import QuotaExceeded
+
+        try:
+            self.quota.admit(members)
+            return True
+        except QuotaExceeded as err:
+            name = park_pod["metadata"]["name"]
+            obs.event("unschedulable", pod=name, proc=self.obs_name,
+                      reason="QuotaExceeded",
+                      message=f"QuotaExceeded: {err}")
+            self._event(name, "Warning", "QuotaExceeded", str(err))
+            self.quota.park(park_pod, members)
+            return False
 
     @staticmethod
     def _shard_key(kube_pod: dict) -> str:
@@ -1845,6 +1974,13 @@ class Scheduler:
         members = self.gang_buffer.add(kube_pod, gang, size)
         if members is None:
             return  # waiting for the rest of the gang
+        if self.quota is not None and \
+                not self._quota_admit(members, kube_pod):
+            # admitted whole or not at all: the gate saw every member's
+            # demand in one call and refused; siblings stay buffered,
+            # the popped member parks in the gate and its re-queue
+            # re-triggers the whole gang
+            return
         metrics.SCHEDULE_ATTEMPTS.inc()
         t0 = time.perf_counter()
         self.cache.expire_assumed()
@@ -1871,6 +2007,7 @@ class Scheduler:
                 # members stay buffered; requeue one so a later pop
                 # retries the whole gang once the cluster changes
                 metrics.SCHEDULE_FAILURES.inc()
+                self._quota_forget(*members)
                 self.queue.add_unschedulable(kube_pod)
                 return
         # any member nominations did their job (the planner just placed
@@ -1912,6 +2049,7 @@ class Scheduler:
                                                     meta=meta)
             if not fits:
                 metrics.SCHEDULE_FAILURES.inc()
+                self._quota_forget(*members)
                 self._release_gang_port(gang)
                 self.queue.add_unschedulable(kube_pod)
                 return
@@ -1927,6 +2065,7 @@ class Scheduler:
                 for done in vol_assumed:
                     self.volume_binder.forget(done)
                 metrics.SCHEDULE_FAILURES.inc()
+                self._quota_forget(*members)
                 self._release_gang_port(gang)
                 self.queue.add_unschedulable(kube_pod)
                 return
@@ -1954,6 +2093,7 @@ class Scheduler:
                 self.cache.forget_pod(pinned)
             for name, _, _ in pinned_members:
                 self.volume_binder.forget(name)
+            self._quota_forget(*members)
             self._release_gang_port(gang)
             for member in members:
                 self.queue.add_unschedulable(member)
@@ -2440,6 +2580,10 @@ class Scheduler:
                 continue
             if self._binder is not None and self._binder.flush():
                 continue
+            if self.quota is not None and self.quota.release_due():
+                # quota-parked pods became affordable (chips released,
+                # grace lapsed): they re-queued, so drain again
+                continue
             break
         return n
 
@@ -2447,6 +2591,11 @@ class Scheduler:
         while not self._stop.is_set():
             try:
                 if not self.schedule_one(timeout=poll_s):
+                    if self.quota is not None:
+                        # idle nudge: a lapsed hungry-grace window makes
+                        # parked tenants affordable without any watch
+                        # event announcing it
+                        self.quota.release_due()
                     time.sleep(0)
             except Exception:
                 # One bad pod or a racing node deletion must not kill the

@@ -15,6 +15,10 @@ class Conflict(Exception):
     pass
 
 
+class TooManyRequests(Exception):
+    pass
+
+
 # ---- frame types: BYE is sent but no reader ever dispatches on it ----------
 
 REQ = 1
@@ -86,11 +90,22 @@ def _route_request(api, method, parts, query, body):
     return 404, {"error": "no route"}
 
 
-# ---- error maps: the stream dispatcher forgot the Conflict mapping ---------
+# ---- error maps: the stream dispatcher forgot the Conflict AND the
+# ---- flow-control (TooManyRequests -> 429) mappings ------------------------
+
+def _error_body(e):
+    # writes retry_after_s, but no client code ever reads it back:
+    # server-advised backoff the retry policy silently drops
+    body = {"error": str(e)}
+    body["retry_after_s"] = getattr(e, "retry_after_s", 0.0)
+    return body
+
 
 def _serve_json(api, method, parts, query, body, send):
     try:
         send(*_route_request(api, method, parts, query, body))
+    except TooManyRequests as e:
+        send(429, _error_body(e))
     except NotFound as e:
         send(404, {"error": str(e)})
     except Conflict as e:
@@ -102,8 +117,9 @@ def _serve_stream(api, method, parts, query, body, send):
         send(*_route_request(api, method, parts, query, body))
     except NotFound as e:
         send(404, {"error": str(e)})
-    # MISSING: Conflict -> 409; on this wire a lost bind race comes
-    # back as a generic failure and the binder blind-retries
+    # MISSING: Conflict -> 409 and TooManyRequests -> 429; on this wire
+    # a lost bind race or a shed request comes back as a generic
+    # failure and the client blind-retries
 
 
 class Client:
@@ -113,9 +129,11 @@ class Client:
     def _req(self, method, path, body=None):
         status, doc = self._transport(method, path, body)
         if status == 404:
-            raise NotFound(doc)
+            raise NotFound(doc.get("error"))
         if status == 409:
-            raise Conflict(doc)
+            raise Conflict(doc.get("error"))
+        if status == 429:
+            raise TooManyRequests(doc.get("error"))
         return doc
 
     def list_pods(self):

@@ -9,12 +9,18 @@ class Conflict(Exception):
     pass
 
 
-# ---- frame types: every registered type is sent AND dispatched -------------
+class TooManyRequests(Exception):
+    pass
+
+
+# ---- frame types: every registered type is sent AND dispatched
+# ---- (REJECT included: flow control is first-class protocol) ---------------
 
 REQ = 1
 RESP = 2
+REJECT = 3
 
-_FRAME_TYPES = frozenset({REQ, RESP})
+_FRAME_TYPES = frozenset({REQ, RESP, REJECT})
 
 
 def send_frame(sock, ftype, payload):
@@ -29,13 +35,19 @@ def send_response(sock, payload):
     send_frame(sock, RESP, payload)
 
 
-def read_loop(rfile, on_request, on_response):
+def send_reject(sock, payload):
+    send_frame(sock, REJECT, payload)
+
+
+def read_loop(rfile, on_request, on_response, on_reject):
     while True:
         ftype, payload = rfile.read_one()
         if ftype == REQ:
             on_request(payload)
         elif ftype == RESP:
             on_response(payload)
+        elif ftype == REJECT:
+            on_reject(payload)
 
 
 # ---- codec tags: both tags known to encoder AND decoder --------------------
@@ -73,11 +85,20 @@ def _route_request(api, method, parts, query, body):
     return 404, {"error": "no route"}
 
 
-# ---- error maps: both dispatch sites carry the full mapping set ------------
+# ---- error maps: both dispatch sites carry the full mapping set, and
+# ---- every _error_body detail key is read back client-side -----------------
+
+def _error_body(e):
+    body = {"error": str(e)}
+    body["retry_after_s"] = getattr(e, "retry_after_s", 0.0)
+    return body
+
 
 def _serve_json(api, method, parts, query, body, send):
     try:
         send(*_route_request(api, method, parts, query, body))
+    except TooManyRequests as e:
+        send(429, _error_body(e))
     except NotFound as e:
         send(404, {"error": str(e)})
     except Conflict as e:
@@ -87,6 +108,8 @@ def _serve_json(api, method, parts, query, body, send):
 def _serve_stream(api, method, parts, query, body, send):
     try:
         send(*_route_request(api, method, parts, query, body))
+    except TooManyRequests as e:
+        send(429, _error_body(e))
     except NotFound as e:
         send(404, {"error": str(e)})
     except Conflict as e:
@@ -96,13 +119,18 @@ def _serve_stream(api, method, parts, query, body, send):
 class Client:
     def __init__(self, transport):
         self._transport = transport
+        self.backoff_s = 0.0
 
     def _req(self, method, path, body=None):
         status, doc = self._transport(method, path, body)
         if status == 404:
-            raise NotFound(doc)
+            raise NotFound(doc.get("error"))
         if status == 409:
-            raise Conflict(doc)
+            raise Conflict(doc.get("error"))
+        if status == 429:
+            # the advised backoff is consumed, not dropped
+            self.backoff_s = doc.get("retry_after_s") or 0.0
+            raise TooManyRequests(doc.get("error"))
         return doc
 
     def list_pods(self):

@@ -163,7 +163,12 @@ def test_wire_contract_flags_each_one_sided_surface():
     assert "serves no /frobs route" in msgs            # missing route
     assert "missing from dispatch site _serve_stream()" in msgs  # one-wire
     assert "no client caller" in msgs                  # unconsumed route
-    assert len(hits) == 5
+    # flow control: TooManyRequests -> 429 mapped on one wire only
+    assert "TooManyRequests -> 429 is missing" in msgs
+    # error-detail key the server writes but no client reads (the
+    # retry-after bug class)
+    assert "'retry_after_s' is written by _error_body()" in msgs
+    assert len(hits) == 7
 
 
 def test_wire_contract_good_twin_is_clean():

@@ -1,0 +1,699 @@
+"""Multi-tenant front door: priority & fairness + DRF chip quotas.
+
+Fairness invariants exercised deliberately: shuffle-shard determinism,
+system-band immunity to a saturated workload band, typed 429/REJECT
+flow control on both wires with honored retry-after, gang-atomic DRF
+admission, prompt re-admit of parked tenants on chip release, and an
+interleaving-explorer scenario for the reject-during-drain race at the
+new queue seams.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.analysis import schedules as sch
+from kubegpu_tpu.cluster import apf
+from kubegpu_tpu.cluster.apf import (APFDispatcher, BandConfig,
+                                     BAND_CONTROLLER, BAND_SYSTEM,
+                                     BAND_WORKLOAD, TooManyRequests)
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer, QuotaExceeded
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+from kubegpu_tpu.core import codec
+from kubegpu_tpu.core.types import ContainerInfo, NodeInfo, PodInfo
+from kubegpu_tpu.scheduler.quota import (DRFQuotaGate,
+                                         node_resource_totals,
+                                         pod_resource_demand)
+
+TENANT = "kgtpu.io/tenant"
+
+
+def tenant_pod(name, tenant, chips=1, gang=None, gang_size=0):
+    from kubegpu_tpu.core import grammar
+
+    pi = PodInfo(name=name)
+    reqs = {grammar.RESOURCE_NUM_CHIPS: chips}
+    pod_reqs = {}
+    if gang is not None:
+        from kubegpu_tpu.scheduler.gang import (RESOURCE_GANG,
+                                                RESOURCE_GANG_SIZE)
+
+        pod_reqs = {RESOURCE_GANG: gang, RESOURCE_GANG_SIZE: gang_size}
+    pi.requests = pod_reqs
+    pi.running_containers["main"] = ContainerInfo(requests=reqs)
+    meta = {"name": name}
+    if tenant:
+        meta["labels"] = {TENANT: tenant}
+    codec.pod_info_to_annotation(meta, pi)
+    return {"metadata": meta,
+            "spec": {"containers": [{"name": "main",
+                                     "resources": {"requests":
+                                                   {"cpu": "1"}}}]}}
+
+
+def fake_node(name, chips=8, cpu=64):
+    info = NodeInfo()
+    for i in range(chips):
+        info.allocatable[
+            f"alpha/grpresource/tpugrp1/0/tpugrp0/0/tpu/{i}.0.0/chips"] = 1
+    meta = {"name": name}
+    codec.node_info_to_annotation(meta, info)
+    return {"metadata": meta,
+            "status": {"allocatable": {"cpu": str(cpu), "pods": 100}}}
+
+
+# ---- classification ---------------------------------------------------------
+
+def test_classify_bands_and_flows():
+    # system: health, watch, leases, debug, heartbeat patches
+    for method, parts in (("GET", ["healthz"]), ("GET", ["watch"]),
+                          ("POST", ["leases", "x"]),
+                          ("GET", ["debug", "pod", "p"]),
+                          ("PATCH", ["nodes", "n1", "metadata"])):
+        assert apf.classify(method, parts, {}, None, "peer")[0] == \
+            BAND_SYSTEM, (method, parts)
+    # controller: binds, annotation stamps, events, node/volume writes
+    for method, parts in (("POST", ["bindmany"]),
+                          ("POST", ["pods", "p", "bind"]),
+                          ("PUT", ["pods", "p", "annotations"]),
+                          ("PUT", ["podannotations"]),
+                          ("POST", ["events"]),
+                          ("POST", ["nodes"]),
+                          ("PUT", ["quotas", "t"])):
+        assert apf.classify(method, parts, {}, None, "peer")[0] == \
+            BAND_CONTROLLER, (method, parts)
+    # workload: pod create carries its tenant as the flow
+    band, flow = apf.classify(
+        "POST", ["pods"], {}, tenant_pod("p", "acme"), "peer")
+    assert (band, flow) == (BAND_WORKLOAD, "acme")
+    # tenantless workload traffic flows by peer identity
+    band, flow = apf.classify("GET", ["pods"], {}, None, "10.0.0.7")
+    assert (band, flow) == (BAND_WORKLOAD, "10.0.0.7")
+
+
+def test_shuffle_shard_deterministic_per_flow_and_band():
+    a = apf.shuffle_shard(BAND_WORKLOAD, "acme", 16, 4)
+    assert a == apf.shuffle_shard(BAND_WORKLOAD, "acme", 16, 4)
+    assert len(a) == 4 and len(set(a)) == 4
+    assert all(0 <= q < 16 for q in a)
+    # a different flow (and a different band) deals a different hand
+    assert a != apf.shuffle_shard(BAND_WORKLOAD, "evil", 16, 4) or \
+        a != apf.shuffle_shard(BAND_WORKLOAD, "other", 16, 4)
+    assert a != apf.shuffle_shard(BAND_CONTROLLER, "acme", 16, 4)
+
+
+# ---- the dispatcher ---------------------------------------------------------
+
+def saturate(dispatcher, band, n):
+    """Occupy ``n`` seats of ``band`` with admitted-but-unreleased
+    requests; returns a release callable."""
+    entered = []
+    for i in range(n):
+        cm = dispatcher.admit("POST", ["pods"], {},
+                              tenant_pod(f"sat-{i}", "hog"), "hog")
+        cm.__enter__()
+        entered.append(cm)
+
+    def release():
+        for cm in entered:
+            cm.__exit__(None, None, None)
+    return release
+
+
+def test_queue_full_rejects_typed_with_retry_after():
+    metrics.APF_REJECTS.reset()
+    d = APFDispatcher(bands={BAND_WORKLOAD: BandConfig(
+        seats=1, queues=1, queue_len=0, queue_wait_s=0.2)})
+    release = saturate(d, BAND_WORKLOAD, 1)
+    try:
+        with pytest.raises(TooManyRequests) as exc:
+            with d.admit("POST", ["pods"], {}, tenant_pod("p", "t"), "t"):
+                pass
+        assert exc.value.retry_after_s == pytest.approx(0.2)
+        assert metrics.APF_REJECTS.labels(BAND_WORKLOAD).value == 1
+    finally:
+        release()
+    in_use, queued = d.inflight(BAND_WORKLOAD)
+    assert (in_use, queued) == (0, 0)
+
+
+def test_queue_wait_deadline_rejects_and_leaves_no_waiter():
+    d = APFDispatcher(bands={BAND_WORKLOAD: BandConfig(
+        seats=1, queues=4, queue_len=8, queue_wait_s=0.05)})
+    release = saturate(d, BAND_WORKLOAD, 1)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TooManyRequests):
+            with d.admit("POST", ["pods"], {}, tenant_pod("p", "t"), "t"):
+                pass
+        assert time.monotonic() - t0 >= 0.04
+    finally:
+        release()
+    assert d.inflight(BAND_WORKLOAD) == (0, 0)
+
+
+def test_release_promotes_queued_waiter():
+    d = APFDispatcher(bands={BAND_WORKLOAD: BandConfig(
+        seats=1, queues=4, queue_len=8, queue_wait_s=5.0)})
+    release = saturate(d, BAND_WORKLOAD, 1)
+    admitted = threading.Event()
+
+    def waiter():
+        with d.admit("POST", ["pods"], {}, tenant_pod("w", "t"), "t"):
+            admitted.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while d.inflight(BAND_WORKLOAD)[1] == 0 and \
+            time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert not admitted.is_set()  # seat still held
+    release()
+    assert admitted.wait(5.0), "released seat was not handed off"
+    t.join(timeout=5.0)
+    assert d.inflight(BAND_WORKLOAD) == (0, 0)
+
+
+def test_saturated_workload_band_never_starves_system_band():
+    """The core isolation invariant: with every workload seat held and
+    its queues rejecting, system traffic admits instantly."""
+    d = APFDispatcher(bands={BAND_WORKLOAD: BandConfig(
+        seats=2, queues=2, queue_len=0, queue_wait_s=0.1)})
+    release = saturate(d, BAND_WORKLOAD, 2)
+    try:
+        with pytest.raises(TooManyRequests):
+            with d.admit("POST", ["pods"], {}, tenant_pod("p", "t"), "t"):
+                pass
+        t0 = time.monotonic()
+        for parts in (["healthz"], ["leases", "x"], ["watch"]):
+            with d.admit("GET", parts, {}, None, "sys") as band:
+                assert band == BAND_SYSTEM
+        assert time.monotonic() - t0 < 0.05  # exempt: no queuing at all
+    finally:
+        release()
+
+
+def test_round_robin_drain_serves_other_flows_past_a_deep_queue():
+    """An abusive flow with a deep queue must not monopolize freed
+    seats: promotion drains round-robin ACROSS queues."""
+    # two queues, hand 1: find two flows dealt DIFFERENT single queues
+    flow_a = "abuser"
+    flow_b = next(f"t{i}" for i in range(64)
+                  if apf.shuffle_shard(BAND_WORKLOAD, f"t{i}", 2, 1) !=
+                  apf.shuffle_shard(BAND_WORKLOAD, flow_a, 2, 1))
+    d = APFDispatcher(bands={BAND_WORKLOAD: BandConfig(
+        seats=1, queues=2, queue_len=16, queue_wait_s=10.0, hand=1)})
+    release = saturate(d, BAND_WORKLOAD, 1)
+    order = []
+    threads = []
+
+    def enqueue(flow, tag):
+        def run():
+            with d.admit("POST", ["pods"], {},
+                         tenant_pod(tag, flow), flow):
+                order.append(flow)
+                time.sleep(0.002)
+        t = threading.Thread(target=run, daemon=True)
+        threads.append(t)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        want = len(threads)
+        while d.inflight(BAND_WORKLOAD)[1] < want and \
+                time.monotonic() < deadline:
+            time.sleep(0.002)
+
+    for i in range(6):  # the abuser queues deep first
+        enqueue(flow_a, f"a{i}")
+    enqueue(flow_b, "b0")
+    release()
+    for t in threads:
+        t.join(timeout=10.0)
+    # b0 was served long before the abuser's queue drained
+    assert flow_b in order[:2], order
+
+
+# ---- both wires: typed flow control + honored retry-after -------------------
+
+@pytest.mark.parametrize("wire", ["json", "stream"])
+def test_http_front_door_rejects_typed_on_both_wires(wire):
+    api = InMemoryAPIServer()
+    d = APFDispatcher(bands={BAND_WORKLOAD: BandConfig(
+        seats=0, queues=1, queue_len=0, queue_wait_s=0.3)})
+    server, url = serve_api(api, apf=d)
+    client = HTTPAPIClient(url, wire=wire)
+    try:
+        with pytest.raises(TooManyRequests) as exc:
+            client.create_pod(tenant_pod("p1", "acme"))
+        assert exc.value.retry_after_s == pytest.approx(0.3)
+        # system band untouched: leases renew through the shut door
+        assert client.acquire_lease("l1", "holder", 5.0)
+        # controller band untouched: node writes flow
+        client.create_node(fake_node("n1"))
+    finally:
+        client.close()
+        server.shutdown()
+
+
+def test_idempotent_retry_honors_server_advised_retry_after(monkeypatch):
+    """Satellite regression: the old policy used fixed backoff+jitter
+    only; an advised retry_after_s must DEFER the retry (and the final
+    rejection must surface typed)."""
+    client = HTTPAPIClient("http://127.0.0.1:9")  # never dialed
+    calls = {"n": 0}
+
+    def fake_roundtrip(method, path, body, timeout):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return 429, {"error": "shed", "retry_after_s": 0.3}
+        return 200, {"ok": True}
+
+    monkeypatch.setattr(client, "_wire_roundtrip", fake_roundtrip)
+    t0 = time.monotonic()
+    assert client.get_node("n1") == {"ok": True}
+    elapsed = time.monotonic() - t0
+    # jitter scales the advised delay into [0.75x, 1.0x]
+    assert elapsed >= 0.2, f"advised retry-after not honored ({elapsed})"
+    assert calls["n"] == 2
+    assert client.throttled_count == 1
+    client.close()
+
+
+def test_post_is_single_shot_on_429(monkeypatch):
+    client = HTTPAPIClient("http://127.0.0.1:9")
+    calls = {"n": 0}
+
+    def fake_roundtrip(method, path, body, timeout):
+        calls["n"] += 1
+        return 429, {"error": "shed", "retry_after_s": 0.05}
+
+    monkeypatch.setattr(client, "_wire_roundtrip", fake_roundtrip)
+    with pytest.raises(TooManyRequests):
+        client.create_pod(tenant_pod("p", "t"))
+    assert calls["n"] == 1  # a create is never blind-resent
+    client.close()
+
+
+# ---- apiserver hard caps + quota config -------------------------------------
+
+def test_hard_cap_admission_and_quota_routes():
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    client = HTTPAPIClient(url, wire="stream")
+    try:
+        client.set_quota("capped", {"hard_chips": 2, "weight": 2.0})
+        client.create_pod(tenant_pod("ok-1", "capped", chips=2))
+        with pytest.raises(QuotaExceeded):
+            client.create_pod(tenant_pod("no-1", "capped", chips=1))
+        # deleting the pod releases the ledger; admission reopens
+        client.delete_pod("ok-1")
+        client.create_pod(tenant_pod("ok-2", "capped", chips=2))
+        quotas = client.list_quotas()
+        assert quotas["capped"]["hard_chips"] == 2
+        assert quotas["capped"]["chips_created"] == 2.0
+        client.delete_quota("capped")
+        # no cap left: over the old cap is fine now
+        client.create_pod(tenant_pod("ok-3", "capped", chips=4))
+    finally:
+        client.close()
+        server.shutdown()
+
+
+# ---- DRF gate ---------------------------------------------------------------
+
+def make_gate(chips=16, weights=None, grace=5.0):
+    gate = DRFQuotaGate(weights=weights, hungry_grace_s=grace)
+    gate.set_node(fake_node("n0", chips=chips))
+    return gate
+
+
+def test_gate_resource_parsing():
+    assert node_resource_totals(fake_node("n", chips=8, cpu=64)) == \
+        {"chips": 8.0, "cpu": 64.0}
+    assert pod_resource_demand(tenant_pod("p", "t", chips=3)) == \
+        {"chips": 3.0, "cpu": 1.0}
+
+
+def test_gate_parks_over_share_tenant_only_when_others_demand():
+    gate = make_gate(chips=8)
+    # sole tenant: work conservation admits the whole cluster
+    for i in range(8):
+        gate.admit([tenant_pod(f"a-{i}", "A")])
+    # a second tenant starts demanding: A is now over its 1/2 share
+    gate.pod_pending(tenant_pod("b-0", "B"))
+    with pytest.raises(QuotaExceeded) as exc:
+        gate.admit([tenant_pod("a-8", "A")])
+    assert "fair" in str(exc.value)
+    # B itself admits freely (far under its share)
+    gate.admit([tenant_pod("b-0", "B")])
+
+
+def test_gate_admits_and_parks_gangs_atomically():
+    gate = make_gate(chips=16)
+    gate.pod_pending(tenant_pod("b-0", "B"))  # another demander
+    members_ok = [tenant_pod(f"g-{i}", "A", chips=2, gang=7,
+                             gang_size=4) for i in range(4)]
+    gate.admit(members_ok)  # 8 chips = exactly the 1/2 fair share
+    members_over = [tenant_pod(f"h-{i}", "A", chips=2, gang=8,
+                               gang_size=4) for i in range(4)]
+    with pytest.raises(QuotaExceeded):
+        gate.admit(members_over)  # refused WHOLE: 16 > 8 fair
+    # no partial charge leaked: a 1-chip pod of A is also refused
+    # (A sits exactly at its fair share already)
+    with pytest.raises(QuotaExceeded):
+        gate.admit([tenant_pod("a-x", "A", chips=1)])
+    # ...while B still admits
+    gate.admit([tenant_pod("b-0", "B", chips=1)])
+
+
+def test_gate_weighted_fair_shares():
+    gate = make_gate(chips=12, weights={"A": 2.0, "B": 1.0})
+    gate.pod_pending(tenant_pod("b-0", "B"))
+    # A's weighted share is 2/3 of 12 = 8 chips
+    for i in range(8):
+        gate.admit([tenant_pod(f"a-{i}", "A")])
+    with pytest.raises(QuotaExceeded):
+        gate.admit([tenant_pod("a-8", "A")])
+
+
+def test_first_allocation_guarantee_beats_task_granularity():
+    """A pod (or gang) bigger than the tenant's fair fraction must
+    still schedule once from zero usage — strict fair-share math would
+    deadlock it forever."""
+    gate = make_gate(chips=8)
+    gate.pod_pending(tenant_pod("b-0", "B"))
+    big = [tenant_pod(f"g-{i}", "A", chips=2, gang=3, gang_size=3)
+           for i in range(3)]  # 6 chips > A's fair 4
+    gate.admit(big)  # first allocation: admitted whole
+    with pytest.raises(QuotaExceeded):
+        gate.admit([tenant_pod("a-x", "A")])  # now over, others hungry
+
+
+def test_parked_pods_requeue_on_chip_release():
+    gate = make_gate(chips=4, grace=0.0)
+    pushed = []
+    gate.requeue = pushed.append
+    bound = []
+    for i in range(4):
+        pod = tenant_pod(f"a-{i}", "A")
+        gate.admit([pod])
+        pod["spec"]["nodeName"] = "n0"
+        gate.pod_bound(pod)
+        bound.append(pod)
+    gate.pod_pending(tenant_pod("b-0", "B"))
+    over = tenant_pod("a-4", "A")
+    with pytest.raises(QuotaExceeded):
+        gate.admit([over])
+    gate.park(over)
+    assert gate.parked_count() == 1
+    # B binds + a chip releases: B no longer hungry, A's share frees up
+    bpod = tenant_pod("b-0", "B")
+    gate.admit([bpod])
+    bpod["spec"]["nodeName"] = "n0"
+    gate.pod_bound(bpod)
+    gate.pod_gone(bound[0])  # chip released -> prompt re-queue
+    assert pushed and pushed[0]["metadata"]["name"] == "a-4"
+    assert gate.parked_count() == 0
+
+
+def test_at_share_demanders_never_deadlock_over_an_idle_holder():
+    """Work conservation: two tenants AT their fair share, both with
+    pending pods, must not block each other from an idle third
+    tenant's unused headroom — 'hungry' means demanding AND below
+    one's own share, not merely demanding."""
+    gate = make_gate(chips=9, grace=0.0)
+
+    def fill(tenant, n):
+        for i in range(n):
+            pod = tenant_pod(f"{tenant.lower()}-{i}", tenant)
+            gate.admit([pod])
+            pod["spec"]["nodeName"] = "n0"
+            gate.pod_bound(pod)
+
+    fill("A", 3)  # A holds a third and goes idle (no pending)
+    fill("B", 3)
+    fill("C", 3)
+    gate.pod_pending(tenant_pod("b-more", "B"))
+    gate.pod_pending(tenant_pod("c-more", "C"))
+    # fair share is 3 chips each; B and C are both at share and both
+    # demanding — neither is "hungry", so either may take A's idle
+    # headroom instead of deadlocking
+    gate.admit([tenant_pod("b-more", "B")])
+
+
+def test_quota_parked_metric_counts():
+    before = metrics.QUOTA_PARKED.value
+    gate = make_gate(chips=2)
+    gate.pod_pending(tenant_pod("b", "B"))
+    gate.admit([tenant_pod("a-0", "A")])
+    over = tenant_pod("a-1", "A")
+    with pytest.raises(QuotaExceeded):
+        gate.admit([over])
+    gate.park(over)
+    assert metrics.QUOTA_PARKED.value == before + 1
+
+
+# ---- scheduler integration --------------------------------------------------
+
+def build_cluster(gate, hosts=2):
+    from kubegpu_tpu.node.advertiser import DeviceAdvertiser
+    from kubegpu_tpu.node.fake import FakeTPUBackend, v5p_host_inventory
+    from kubegpu_tpu.node.manager import DevicesManager, TPUDeviceManager
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    api = InMemoryAPIServer()
+    origins = [(0, 0, 0), (2, 0, 0)][:hosts]
+    for i, origin in enumerate(origins):
+        api.create_node({"metadata": {"name": f"host{i}"},
+                         "status": {"allocatable": {"cpu": "64",
+                                                    "pods": 100}}})
+        mgr = DevicesManager()
+        mgr.add_device(TPUDeviceManager(FakeTPUBackend(
+            v5p_host_inventory(host_origin=origin, mesh_dims=(4, 4, 1)))))
+        mgr.start()
+        DeviceAdvertiser(api, mgr, f"host{i}").advertise_once()
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    return api, Scheduler(api, ds, quota=gate)
+
+
+def bound_names(api):
+    return {p["metadata"]["name"] for p in api.list_pods()
+            if (p.get("spec") or {}).get("nodeName")}
+
+
+def test_scheduler_enforces_fair_share_and_readmits_on_release():
+    """End to end over a live cluster (8 chips): a flooding tenant is
+    held to its fair share while a second tenant demands; deleting the
+    second tenant's pods releases chips and (after the hysteresis
+    window) the parked flood re-admits — chips never idle forever."""
+    gate = DRFQuotaGate(hungry_grace_s=0.2)
+    api, sched = build_cluster(gate)
+    parked_before = metrics.QUOTA_PARKED.value
+    try:
+        for i in range(8):
+            api.create_pod(tenant_pod(f"a-{i}", "A"))
+        for i in range(4):
+            api.create_pod(tenant_pod(f"b-{i}", "B"))
+        sched.run_until_idle()
+        got = bound_names(api)
+        a_bound = {n for n in got if n.startswith("a-")}
+        b_bound = {n for n in got if n.startswith("b-")}
+        assert len(b_bound) == 4, "the demanding tenant was starved"
+        assert len(a_bound) == 4, \
+            f"flooding tenant got {len(a_bound)} chips, fair share is 4"
+        # the gate engaged against the flood (once B is satisfied AT
+        # its share, work conservation may re-release the overflow
+        # into ordinary FitError backoff — parked_count can be 0 here)
+        assert metrics.QUOTA_PARKED.value > parked_before
+        # B finishes: its chips release; after the grace window the
+        # flood's overflow re-admits and fills the cluster
+        for name in sorted(b_bound):
+            api.delete_pod(name)
+        time.sleep(0.25)  # the 0.2s hysteresis window lapses
+        sched.run_until_idle()
+        assert len(bound_names(api)) == 8
+        assert gate.parked_count() == 0
+    finally:
+        sched.stop()
+
+
+def test_quota_park_is_visible_in_debug_pod_explanation():
+    from kubegpu_tpu import obs
+
+    gate = DRFQuotaGate()
+    api, sched = build_cluster(gate, hosts=1)
+    try:
+        for i in range(4):
+            api.create_pod(tenant_pod(f"qa-{i}", "QA"))
+        api.create_pod(tenant_pod("qb-0", "QB"))
+        sched.run_until_idle()
+        with pytest.raises(Exception):
+            api.get_pod("nonexistent")  # sanity: api raises NotFound
+        # QA flooded past its share while QB demanded: some QA pod
+        # parked with the typed reason in its timeline
+        parked = [f"qa-{i}" for i in range(4)
+                  if f"qa-{i}" not in bound_names(api)]
+        assert parked, "expected at least one quota-parked pod"
+        explained = [obs.explain_pod(n) for n in parked]
+        hits = [e for e in explained
+                if "QuotaExceeded" in str(e.get("last_failure", ""))]
+        assert hits, f"no QuotaExceeded in {explained}"
+    finally:
+        sched.stop()
+
+
+def test_quota_weight_config_reaches_the_gate_via_watch():
+    """PUT /quotas/<tenant> {"weight": …} must actually change the DRF
+    gate's fair-share math — the config knob is live, not write-only."""
+    gate = DRFQuotaGate()
+    api, sched = build_cluster(gate)  # 8 chips
+    try:
+        api.set_quota("heavy", {"weight": 3.0})
+        api.create_pod(tenant_pod("light-0", "light"))
+        for i in range(8):
+            api.create_pod(tenant_pod(f"heavy-{i}", "heavy"))
+        sched.run_until_idle()
+        got = bound_names(api)
+        heavy = [n for n in got if n.startswith("heavy-")]
+        # weighted fair share: 3/4 of 8 chips = 6, not the unweighted 4
+        assert len(heavy) == 6, got
+        assert "light-0" in got
+        # a spec REPLACED without a weight means default, not "keep
+        # the old one" (a restarted replica would otherwise diverge)
+        api.set_quota("heavy", {"hard_chips": 99})
+        assert gate.shares()["heavy"]["fair_fraction"] == \
+            pytest.approx(0.5)
+        # deleting the quota also reverts the weight to 1.0
+        api.set_quota("heavy", {"weight": 3.0})
+        api.delete_quota("heavy")
+        assert gate.shares()["heavy"]["fair_fraction"] == \
+            pytest.approx(0.5)
+    finally:
+        sched.stop()
+
+
+def test_quota_weights_load_at_scheduler_cold_start():
+    """A restarted replica must compute the same fair shares as one
+    that saw every quota watch event: weights are listed from the
+    apiserver at cold start, not reconstructed from deltas."""
+    from kubegpu_tpu.scheduler.core import Scheduler
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    gate = DRFQuotaGate()
+    api, sched = build_cluster(gate)
+    sched.stop()
+    api.set_quota("heavy", {"weight": 3.0})
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    gate2 = DRFQuotaGate()
+    sched2 = Scheduler(api, ds, quota=gate2)  # the "restart"
+    try:
+        api.create_pod(tenant_pod("cs-h", "heavy"))
+        api.create_pod(tenant_pod("cs-l", "light"))
+        assert gate2.shares()["heavy"]["fair_fraction"] == \
+            pytest.approx(0.75)
+    finally:
+        sched2.stop()
+
+
+def test_failed_cycle_discharges_the_inflight_quota_charge():
+    """A pod that admits and then FitErrors must not phantom-bill its
+    tenant: with the charge left up, an unfittable 16-chip pod would
+    park every other pod of its tenant until the TTL (and re-pops
+    would refresh it forever)."""
+    gate = DRFQuotaGate()
+    api, sched = build_cluster(gate, hosts=1)  # 4 chips
+    try:
+        api.create_pod(tenant_pod("fb-0", "B"))
+        api.create_pod(tenant_pod("fa-big", "A", chips=16))  # unfittable
+        api.create_pod(tenant_pod("fa-small", "A", chips=1))
+        sched.run_until_idle()
+        got = bound_names(api)
+        assert "fa-small" in got, \
+            f"phantom in-flight charge parked the tenant: {got}"
+        assert "fb-0" in got
+    finally:
+        sched.stop()
+
+
+def test_gang_is_quota_gated_atomically_through_the_scheduler():
+    gate = DRFQuotaGate()
+    api, sched = build_cluster(gate)  # 8 chips
+    try:
+        # tenant B demands so A cannot work-conserve past its share
+        api.create_pod(tenant_pod("gb-0", "B"))
+        # A's 4x2-chip gang = 8 chips > A's fair 4: must park WHOLE
+        for i in range(4):
+            api.create_pod(tenant_pod(f"ga-{i}", "A", chips=2, gang=9,
+                                      gang_size=4))
+        sched.run_until_idle()
+        got = bound_names(api)
+        assert not any(n.startswith("ga-") for n in got), \
+            f"gang partially admitted past quota: {got}"
+        assert "gb-0" in got
+    finally:
+        sched.stop()
+
+
+# ---- explorer: reject-during-drain at the new queue seams -------------------
+
+def apf_reject_during_drain_scenario():
+    """One seat, one queue: a holder releasing races a waiter's
+    queue-wait deadline. Every interleaving must end with no seat or
+    waiter leaked and the waiter observing EXACTLY one outcome
+    (admitted or typed-rejected, never both/neither)."""
+    d = APFDispatcher(bands={BAND_WORKLOAD: BandConfig(
+        seats=1, queues=1, queue_len=4, queue_wait_s=0.05, hand=1)})
+    outcomes = []
+
+    def holder():
+        with d.admit("POST", ["pods"], {}, None, "holder"):
+            pass
+
+    def waiter():
+        try:
+            with d.admit("POST", ["pods"], {}, None, "waiter"):
+                outcomes.append("admitted")
+        except TooManyRequests:
+            outcomes.append("rejected")
+
+    def invariant():
+        assert len(outcomes) == 1, f"waiter outcomes: {outcomes}"
+        in_use, queued = d.inflight(BAND_WORKLOAD)
+        assert (in_use, queued) == (0, 0), \
+            f"seat/waiter leak after drain: {in_use} in use, " \
+            f"{queued} queued"
+
+    return [holder, waiter], invariant
+
+
+def test_explorer_reject_during_drain_never_leaks_a_seat():
+    res = sch.explore(apf_reject_during_drain_scenario,
+                      max_schedules=400, seed=0)
+    assert res.ok, res.failure.render()
+
+
+# ---- the chaos scenario -----------------------------------------------------
+
+@pytest.mark.chaos
+def test_tenant_flood_scenario_holds_all_invariants():
+    """The tenant-flood chaos run, scaled for CI: the scenario itself
+    asserts the p99 hold, zero lease losses, zero heartbeat evictions,
+    system-band immunity, and the abuser's chip cap — a clean return IS
+    the assertion set passing."""
+    from kubegpu_tpu.cmd.simulate import run_tenant_flood_scenario
+
+    metrics.reset_all()
+    result = run_tenant_flood_scenario(tenants=2, churn_pods=5,
+                                       flood_threads=2,
+                                       p99_ratio_limit=3.0)
+    assert result["flood"]["accepted"] > 0
+    assert result["quota_parked"] > 0 or result["flood"]["rejected"] > 0
+    assert result["evictions"] == 0
+    assert result["watch_relists"] == 0
