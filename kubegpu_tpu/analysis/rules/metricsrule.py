@@ -26,7 +26,8 @@ from kubegpu_tpu.analysis.engine import (Context, Finding, SourceFile,
                                          dotted_name)
 
 _METRIC_TYPES = frozenset({"Counter", "Gauge", "Histogram",
-                           "LabeledHistogram", "LabeledCounter"})
+                           "LabeledHistogram", "LabeledCounter",
+                           "LabeledGauge"})
 _SNAKE_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _HISTOGRAM_UNITS = ("_microseconds", "_milliseconds", "_seconds", "_us",
                     "_ms", "_bytes", "_total")

@@ -56,8 +56,11 @@ BAND_CONTROLLER = "controller"
 BAND_WORKLOAD = "workload"
 
 # First path segments that are system traffic regardless of verb:
-# health, watch long-polls, lease acquire/renew/release, debug surfaces.
-_SYSTEM_SEGMENTS = frozenset({"healthz", "watch", "leases", "debug"})
+# health, watch long-polls, lease acquire/renew/release, debug and
+# metrics/profiling surfaces (observability must survive the floods it
+# exists to explain).
+_SYSTEM_SEGMENTS = frozenset({"healthz", "watch", "leases", "debug",
+                              "metrics"})
 # Control-loop write surfaces (scheduler binders, lifecycle, advertiser
 # node registration, volume controllers, quota admin): above tenant
 # workload, below system.

@@ -36,6 +36,9 @@ Routes (shared by both wires):
     POST   /bindvolume                  {"pv": ..., "pvc": ...}
     GET    /watch?since=<seq>           -> {"events": [[seq, kind, event, obj]...]}
     POST   /leases/<name>               {"holder":..., "ttl":...} -> 200/409
+    GET    /metrics                     (Prometheus exposition, text/plain)
+    GET    /metrics/history?window_s=N  (windowed metric deltas/percentiles)
+    GET    /debug/traces | /debug/pod/<name> | /debug/profile
 
 Leases implement the scheduler's HA leader election (reference:
 `cmd/app/server.go:396-403,437-461`).
@@ -213,6 +216,7 @@ class _StreamSubscriber:
                 pass  # the connection may already be gone
 
     def _writer_loop(self):
+        obs.register_thread("stream-pump")
         while True:
             with self._lock:
                 while (not self._queue or self._inflight) \
@@ -445,6 +449,7 @@ class _EventLog:
             pump.join(timeout=5.0)
 
     def _pump_loop(self):
+        obs.register_thread("stream-pump")
         while True:
             with self._lock:
                 if self._pump_stop:
@@ -542,6 +547,15 @@ class _EventLog:
         return sent
 
 
+# Raw-text response envelope: a route returning
+# {RAW_CONTENT_TYPE: ..., RAW_TEXT: ...} is unwrapped by the JSON-wire
+# HTTP handler into a plain text body with that content type (the
+# Prometheus exposition must be scrapeable, not JSON-wrapped); the
+# stream wire delivers the envelope dict unchanged.
+RAW_CONTENT_TYPE = "__content_type__"
+RAW_TEXT = "__text__"
+
+
 def _split_path(path: str) -> tuple:
     """``"/pods?node=n1" -> (["pods"], {"node": "n1"})`` — one parser
     for both wires' route strings."""
@@ -582,6 +596,24 @@ def _route_request(api: InMemoryAPIServer, log: _EventLog, method: str,
     if parts == ["debug", "traces"] and method == "GET":  # analysis: disable=wire-contract -- operator debug surface (curl/Perfetto), deliberately client-less
         # this process's span ring, Perfetto-loadable
         return 200, obs.chrome_trace()
+    if parts == ["debug", "profile"] and method == "GET":
+        # the sampling profiler's attribution table + collapsed stacks
+        # (curl-only, waived with the rest of /debug above)
+        return 200, obs.profile_status()
+    if parts == ["metrics", "history"] and method == "GET":  # analysis: disable=wire-contract -- operator/monitoring surface (curl), deliberately client-less
+        # the metrics time-series' windowed summary (counter rates,
+        # windowed histogram percentiles, gauge envelopes)
+        return 200, obs.metrics_history(
+            window_s=float(query.get("window_s", 300.0)),
+            limit=int(query.get("limit", 0)))
+    if parts == ["metrics"] and method == "GET":
+        # first-class Prometheus exposition (the /metrics segment's
+        # curl-only waiver rides the /metrics/history route above): the
+        # HTTP handler unwraps
+        # the raw-text envelope into a text/plain body for scrapers;
+        # stream-wire callers receive the envelope dict as-is
+        return 200, {RAW_CONTENT_TYPE: "text/plain; version=0.0.4",
+                     RAW_TEXT: metrics.prometheus_text()}
     if parts[:2] == ["debug", "pod"] and len(parts) == 3 \
             and method == "GET":
         return 200, obs.explain_pod(urllib.parse.unquote(parts[2]))
@@ -768,9 +800,14 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
             return json.loads(self.rfile.read(n).decode()) if n else {}
 
         def _send(self, code: int, obj=None):
-            data = json.dumps(obj if obj is not None else {}).encode()
+            content_type = "application/json"
+            if isinstance(obj, dict) and RAW_CONTENT_TYPE in obj:
+                content_type = obj[RAW_CONTENT_TYPE]
+                data = str(obj.get(RAW_TEXT, "")).encode()
+            else:
+                data = json.dumps(obj if obj is not None else {}).encode()
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -1500,6 +1537,7 @@ class HTTPAPIClient:
         framed connection and the server PUSHES each coalesced batch.
         The wire can flip stream->json mid-loop (negotiated fallback) —
         the cursor survives the flip."""
+        obs.register_thread("informer")
         log = logging.getLogger(__name__)
         st = {"seq": 0, "epoch": None, "failures": 0}
         while not self._stop.is_set():

@@ -207,6 +207,7 @@ class Elector:
         interval = interval_s if interval_s is not None else self.ttl_s / 3.0
 
         def loop() -> None:
+            obs.register_thread("elector")
             while not self._stop.is_set():
                 try:
                     self.tick()
@@ -322,6 +323,7 @@ class ShardCoordinator:
         interval = interval_s if interval_s is not None else self.ttl_s / 3.0
 
         def loop() -> None:
+            obs.register_thread("elector")
             while not self._stop.is_set():
                 try:
                     self.tick()

@@ -15,6 +15,7 @@ import threading
 
 from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
 from kubegpu_tpu.cluster.httpapi import serve_api
+from kubegpu_tpu.cmd import common
 
 
 def main(argv=None) -> int:
@@ -48,8 +49,12 @@ def main(argv=None) -> int:
                              "wires; system traffic (heartbeats, "
                              "leases, watch) is exempt and shed work "
                              "gets a typed 429/REJECT with retry-after")
+    common.add_observability_flags(parser)
     args = parser.parse_args(argv)
 
+    # profiler + metrics time-series before any server object exists, so
+    # the lock probe wraps the event log / WAL / fan-out locks
+    stop_obs = common.start_observability(args)
     api = InMemoryAPIServer()
     wal = None
     if args.wal_dir:
@@ -75,6 +80,7 @@ def main(argv=None) -> int:
     server.server_close()
     if wal is not None:
         wal.close()
+    stop_obs()
     return 0
 
 

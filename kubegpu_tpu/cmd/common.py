@@ -43,59 +43,22 @@ def merge_flags(args, config: dict, keys: list) -> None:
             setattr(args, key, config[key])
 
 
-def _histogram_lines(h, labels: str = "") -> list:
-    """One histogram's exposition lines; ``labels`` is a pre-rendered
-    ``key="value",`` prefix for labeled children."""
-    lines = []
-    cumulative = 0
-    for bound, count in zip(h.buckets, h.counts):
-        cumulative += count
-        lines.append(f'{h.name}_bucket{{{labels}le="{bound:g}"}} '
-                     f"{cumulative}")
-    lines.append(f'{h.name}_bucket{{{labels}le="+Inf"}} {h.n}')
-    suffix = f"{{{labels[:-1]}}}" if labels else ""
-    lines.append(f"{h.name}_sum{suffix} {h.total:.6g}")
-    lines.append(f"{h.name}_count{suffix} {h.n}")
-    return lines
-
-
-def prometheus_text() -> str:
-    """Render the process's metrics in Prometheus exposition format.
-    Registry-driven: iterates ``metrics.all_metrics()``, so every
-    declared metric is exported — registration and exposition cannot
-    drift (the omission class the metric-registration analysis rule now
-    closes statically)."""
-    lines = []
-    for m in metrics.all_metrics():
-        if isinstance(m, metrics.LabeledHistogram):
-            lines.append(f"# TYPE {m.name} histogram")
-            for value, child in m.children():
-                lines.extend(_histogram_lines(
-                    child, f'{m.label}="{value}",'))
-        elif isinstance(m, metrics.Histogram):
-            lines.append(f"# TYPE {m.name} histogram")
-            lines.extend(_histogram_lines(m))
-        elif isinstance(m, metrics.LabeledCounter):
-            lines.append(f"# TYPE {m.name} counter")
-            for values, child in m.children():
-                rendered = ",".join(
-                    f'{k}="{v}"' for k, v in zip(m.label_names, values))
-                lines.append(f"{m.name}{{{rendered}}} {child.value}")
-        elif isinstance(m, metrics.Counter):
-            lines.append(f"# TYPE {m.name} counter")
-            lines.append(f"{m.name} {m.value}")
-        elif isinstance(m, metrics.Gauge):
-            lines.append(f"# TYPE {m.name} gauge")
-            lines.append(f"{m.name} {m.value}")
-    return "\n".join(lines) + "\n"
+# The exposition itself lives in metrics.py now (so the apiserver route
+# table can serve /metrics without importing the CLI layer); this alias
+# keeps the historic import path working.
+prometheus_text = metrics.prometheus_text
 
 
 def serve_health(port: int, extra_status=None, recorder=None):
-    """healthz + /metrics + trace-debug server; returns the server
-    (daemon thread), or None when port <= 0. ``/debug/traces`` serves
-    the process's span ring as Perfetto-loadable Chrome trace JSON;
-    ``/debug/pod/<name>`` answers "why is this pod Pending/slow" from
-    the same ring (``recorder`` defaults to the process-global one)."""
+    """healthz + /metrics + /metrics/history + trace/profile debug
+    server; returns the server (daemon thread), or None when port <= 0.
+    ``/debug/traces`` serves the process's span ring as
+    Perfetto-loadable Chrome trace JSON; ``/debug/pod/<name>`` answers
+    "why is this pod Pending/slow" from the same ring (``recorder``
+    defaults to the process-global one); ``/debug/profile`` serves the
+    sampling profiler's attribution table + collapsed stacks;
+    ``/metrics/history?window_s=300`` serves the metrics time-series'
+    windowed summary."""
     if port is None or port <= 0:
         return None
     from kubegpu_tpu import obs
@@ -113,7 +76,12 @@ def serve_health(port: int, extra_status=None, recorder=None):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            from urllib.parse import parse_qs, unquote, urlsplit
+
+            parts = urlsplit(self.path)
+            path = parts.path
+            query = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+            if path == "/healthz":
                 ok = True
                 if extra_status is not None:
                     ok = bool(extra_status())
@@ -122,19 +90,23 @@ def serve_health(port: int, extra_status=None, recorder=None):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 body = prometheus_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-            elif self.path == "/debug/traces":
+            elif path == "/metrics/history":
+                self._json(obs.metrics_history(
+                    window_s=float(query.get("window_s", 300.0)),
+                    limit=int(query.get("limit", 0))))
+            elif path == "/debug/profile":
+                self._json(obs.profile_status())
+            elif path == "/debug/traces":
                 self._json(obs.chrome_trace(recorder=recorder))
-            elif self.path.startswith("/debug/pod/"):
-                from urllib.parse import unquote
-
-                name = unquote(self.path[len("/debug/pod/"):])
+            elif path.startswith("/debug/pod/"):
+                name = unquote(path[len("/debug/pod/"):])
                 self._json(obs.explain_pod(name, recorder=recorder))
             else:
                 self.send_response(404)
@@ -144,6 +116,65 @@ def serve_health(port: int, extra_status=None, recorder=None):
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="health").start()
     return server
+
+
+def add_observability_flags(parser) -> None:
+    """The continuous-profiling + metrics-history flags every binary
+    (scheduler_main / apiserver_main / simulate) shares."""
+    parser.add_argument("--profile-dir", default=None,
+                        help="run the sampling profiler (~125 Hz stack "
+                             "sampler with role/phase/lock-wait "
+                             "attribution) and dump collapsed-stack + "
+                             "attribution JSON here on exit; "
+                             "KGTPU_PROFILE=0 disables")
+    parser.add_argument("--profile-hz", type=float, default=0.0,
+                        help="sampler frequency (default 125, or "
+                             "$KGTPU_PROFILE_HZ)")
+    parser.add_argument("--metrics-interval-s", type=float, default=0.0,
+                        help="snapshot every registered metric into a "
+                             "bounded in-process ring at this interval "
+                             "(serves /metrics/history; runs the "
+                             "anomaly watchdog over it); 0 disables")
+
+
+def start_observability(args):
+    """Wire --profile-dir / --metrics-interval-s: start the sampler and
+    the metrics time-series (with the anomaly watchdog attached).
+    Returns an idempotent ``stop()`` that tears both down and writes
+    the profile dump."""
+    from kubegpu_tpu.obs import profile, timeseries
+
+    profile_dir = getattr(args, "profile_dir", None)
+    interval = getattr(args, "metrics_interval_s", 0.0) or 0.0
+    sampler = None
+    series = None
+    installed_probe = False
+    if profile_dir and profile.enabled():
+        # remember whether THIS call flipped the factories: stop() must
+        # restore raw locks then (an in-process caller keeps profiling-
+        # free locks after the window), but never uninstall a probe an
+        # enclosing profiled section still owns
+        installed_probe = (not profile.lock_probe_installed()
+                           and profile.install_lock_probe())
+        sampler = profile.start_profiler(
+            hz=getattr(args, "profile_hz", 0.0) or None)
+    if interval > 0:
+        series = timeseries.start_timeseries(
+            interval, watchdog=timeseries.Watchdog())
+    state = {"done": False}
+
+    def stop():
+        if state["done"]:
+            return
+        state["done"] = True
+        if sampler is not None:
+            profile.stop_and_dump(profile_dir)
+        if installed_probe:
+            profile.uninstall_lock_probe()
+        if series is not None:
+            timeseries.stop_timeseries()
+
+    return stop
 
 
 def build_backend(kind: str, sysfs_root: str):

@@ -176,6 +176,7 @@ def main(argv=None) -> int:
                              "exporting create_device_scheduler_plugin)")
     parser.add_argument("--config", default=None,
                         help="JSON/YAML file; explicit flags win")
+    common.add_observability_flags(parser)
     args = parser.parse_args(argv)
     config = common.load_config(args.config)
     common.merge_flags(args, config, ["api", "wire", "parallelism",
@@ -183,6 +184,11 @@ def main(argv=None) -> int:
                                       "node_grace_s", "node_stale_s",
                                       "bind_workers", "watch_batch_ms",
                                       "replicas", "shard"])
+    # continuous profiling + metrics time-series (--profile-dir /
+    # --metrics-interval-s): started before ANY package object exists so
+    # the lock probe wraps every lock the client/scheduler construct —
+    # contention is only attributable on locks created after install
+    stop_obs = common.start_observability(args)
 
     # kind-filtered watch: the scheduler consumes node/pod/pv/pvc (and
     # tenant-quota config) events only, so Event records never pay
@@ -227,6 +233,7 @@ def main(argv=None) -> int:
         if lifecycle_elector is not None:
             lifecycle_elector.stop()
         sched.stop()
+        stop_obs()
         return 0
 
     if not args.leader_elect:
@@ -237,6 +244,7 @@ def main(argv=None) -> int:
         if lifecycle_elector is not None:
             lifecycle_elector.stop()
         sched.stop()
+        stop_obs()
         return 0
 
     # Leader election (active/standby) through the shared Elector:
@@ -266,6 +274,7 @@ def main(argv=None) -> int:
     if lifecycle_elector is not None:
         lifecycle_elector.stop()
     elector.stop()  # demotes (stops the scheduler) if still leading
+    stop_obs()
     return 0
 
 

@@ -736,9 +736,18 @@ def main(argv=None) -> int:
                              "trace-event JSON (open in Perfetto); "
                              "covers every in-process component — "
                              "scheduler replicas AND the apiserver")
-    args = parser.parse_args(argv)
+    from kubegpu_tpu.cmd import common
 
-    def dump_trace():
+    common.add_observability_flags(parser)
+    args = parser.parse_args(argv)
+    # sampler + metrics time-series cover the whole run (chaos scenarios
+    # included). A chaos scenario's failed in-scenario assert is exactly
+    # when the trace + profile matter most, so both writers run in a
+    # finally — every exit path, not just the clean returns.
+    stop_obs = common.start_observability(args)
+    try:
+        return _run_simulation(args)
+    finally:
         if args.trace_out:
             import sys
 
@@ -746,10 +755,12 @@ def main(argv=None) -> int:
             # stderr: --json consumers parse stdout as one document
             print(f"trace: {n} spans -> {args.trace_out}",
                   file=sys.stderr, flush=True)
+        stop_obs()
 
+
+def _run_simulation(args) -> int:
     if args.chaos:
         result = run_chaos_scenario(seed=args.seed)
-        dump_trace()
         if args.json:
             print(json.dumps(result, indent=2))
         else:
@@ -762,7 +773,6 @@ def main(argv=None) -> int:
     if args.chaos_tenant_flood:
         result = run_tenant_flood_scenario(wire=args.wire)
         result["wire_protocol"] = args.wire
-        dump_trace()
         if args.json:
             print(json.dumps(result, indent=2))
         else:
@@ -781,7 +791,6 @@ def main(argv=None) -> int:
     if args.chaos_ha:
         result = run_ha_chaos_scenario(wire=args.wire)
         result["wire_protocol"] = args.wire
-        dump_trace()
         if args.json:
             print(json.dumps(result, indent=2))
         else:
@@ -931,7 +940,6 @@ def main(argv=None) -> int:
         s.stop()
     for coord in coords:
         coord.stop()
-    dump_trace()
     return 0
 
 

@@ -21,6 +21,30 @@ import threading
 log = logging.getLogger("kubegpu_tpu")
 
 
+def bucket_percentile(bounds: list, counts: list, n: int,
+                      q: float) -> float:
+    """Percentile from per-bucket counts, linearly interpolated within
+    the landing bucket (rank position over the bucket's count, between
+    its lower and upper bound). ``counts`` carries one trailing overflow
+    bucket beyond ``bounds``; it has no upper bound, so answers landing
+    there stay the last finite bound. The ONE interpolation algorithm —
+    ``Histogram.percentile`` (live counts) and the metrics time-series'
+    windowed percentiles (snapshot bucket deltas) both call it, so
+    /metrics and /metrics/history can never disagree on the math."""
+    if n == 0:
+        return 0.0
+    target = q * n
+    seen = 0
+    lo = 0.0
+    for i, c in enumerate(counts[:-1]):
+        if c and seen + c >= target:
+            hi = bounds[i]
+            return lo + (hi - lo) * (target - seen) / c
+        seen += c
+        lo = bounds[i]
+    return bounds[-1]
+
+
 class Histogram:
     """Exponential-bucket latency histogram, microsecond-valued like the
     reference's (1ms..~16s buckets)."""
@@ -47,29 +71,25 @@ class Histogram:
             self.counts[-1] += 1
 
     def percentile(self, q: float) -> float:
-        """Approximate percentile from bucket counts, linearly
-        interpolated within the landing bucket (rank position over the
-        bucket's count, between its lower and upper bound) — so
-        /metrics-derived p50/p95 move smoothly instead of stepping
-        between bucket upper bounds. The overflow bucket has no upper
-        bound; its answer stays the last finite bound."""
+        """Approximate percentile from bucket counts (see
+        :func:`bucket_percentile`) — so /metrics-derived p50/p95 move
+        smoothly instead of stepping between bucket upper bounds."""
         with self._lock:
-            if self.n == 0:
-                return 0.0
-            target = q * self.n
-            seen = 0
-            lo = 0.0
-            for i, c in enumerate(self.counts[:-1]):
-                if c and seen + c >= target:
-                    hi = self.buckets[i]
-                    return lo + (hi - lo) * (target - seen) / c
-                seen += c
-                lo = self.buckets[i]
-            return self.buckets[-1]
+            return bucket_percentile(self.buckets, self.counts,
+                                     self.n, q)
 
     def mean(self) -> float:
         with self._lock:
             return self.total / self.n if self.n else 0.0
+
+    def snapshot(self) -> dict:
+        """Point-in-time capture (bucket counts included, so the
+        metrics time-series can compute *windowed* percentiles from
+        snapshot-to-snapshot bucket deltas)."""
+        with self._lock:
+            return {"type": "hist", "n": self.n, "sum": self.total,
+                    "buckets": list(self.buckets),
+                    "counts": list(self.counts)}
 
     def reset(self) -> None:
         with self._lock:
@@ -88,6 +108,10 @@ class Counter:
         with self._lock:
             self.value += by
 
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"type": "counter", "v": self.value}
+
     def reset(self) -> None:
         with self._lock:
             self.value = 0
@@ -104,6 +128,10 @@ class Gauge:
     def set(self, value) -> None:
         with self._lock:
             self.value = value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"type": "gauge", "v": self.value}
 
     def reset(self) -> None:
         with self._lock:
@@ -137,6 +165,48 @@ class LabeledCounter:
         with self._lock:
             return sorted(self._children.items())
 
+    def snapshot(self) -> dict:
+        return {"type": "counter_family",
+                "children": {",".join(values): child.value
+                             for values, child in self.children()}}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._children = {}
+
+
+class LabeledGauge:
+    """A gauge family keyed by one label (Prometheus
+    ``name{label="value"}``): children are created on first use.
+    Exists so per-instance levels (one scheduling queue's depth per
+    replica) don't clobber each other through a single process-global
+    gauge — last-writer-wins across replicas would make monotone-growth
+    detection (the anomaly watchdog) unreliable."""
+
+    def __init__(self, name: str, label: str):
+        self.name = name
+        self.label = label
+        self._lock = threading.Lock()
+        self._children: dict = {}
+
+    def labels(self, value: str) -> Gauge:
+        with self._lock:
+            child = self._children.get(value)
+            if child is None:
+                child = Gauge(self.name)
+                self._children[value] = child
+            return child
+
+    def children(self) -> list:
+        """[(label value, child gauge)] sorted by label value."""
+        with self._lock:
+            return sorted(self._children.items())
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge_family",
+                "children": {value: child.value
+                             for value, child in self.children()}}
+
     def reset(self) -> None:
         with self._lock:
             self._children = {}
@@ -169,6 +239,11 @@ class LabeledHistogram:
         """[(label value, child histogram)] sorted by label value."""
         with self._lock:
             return sorted(self._children.items())
+
+    def snapshot(self) -> dict:
+        return {"type": "hist_family",
+                "children": {value: child.snapshot()
+                             for value, child in self.children()}}
 
     def reset(self) -> None:
         with self._lock:
@@ -256,6 +331,15 @@ WATCH_PUSH_LAG_MS = Histogram("watch_push_lag_ms", start_us=0.01)
 APF_QUEUE_WAIT_MS = Histogram("apf_queue_wait_ms", start_us=0.01)
 APF_REJECTS = LabeledCounter("apf_rejects_total", ("band",))
 QUOTA_PARKED = Counter("quota_parked_total")
+# Continuous profiling + metrics history (kubegpu_tpu/obs/profile.py +
+# obs/timeseries.py): sched_queue_depth{queue=<replica>} is each
+# scheduling queue's live depth (active + parked), labeled per replica
+# so multi-replica processes don't clobber one another — monotone
+# growth per child is the anomaly watchdog's "scheduler falling
+# behind" signal; profile_samples_total counts sampler ticks so a
+# wedged sampler thread is visible from /metrics.
+SCHED_QUEUE_DEPTH = LabeledGauge("sched_queue_depth", "queue")
+PROFILE_SAMPLES = Counter("profile_samples_total")
 
 
 def all_metrics() -> list:
@@ -266,7 +350,7 @@ def all_metrics() -> list:
     for name in sorted(globals()):
         obj = globals()[name]
         if isinstance(obj, (Histogram, Counter, Gauge, LabeledHistogram,
-                            LabeledCounter)):
+                            LabeledCounter, LabeledGauge)):
             out.append(obj)
     return out
 
@@ -275,3 +359,57 @@ def reset_all() -> None:
     """Fresh metric state (tests and bench runs)."""
     for metric in all_metrics():
         metric.reset()
+
+
+def _histogram_lines(h: Histogram, labels: str = "") -> list:
+    """One histogram's exposition lines; ``labels`` is a pre-rendered
+    ``key="value",`` prefix for labeled children."""
+    lines = []
+    cumulative = 0
+    for bound, count in zip(h.buckets, h.counts):
+        cumulative += count
+        lines.append(f'{h.name}_bucket{{{labels}le="{bound:g}"}} '
+                     f"{cumulative}")
+    lines.append(f'{h.name}_bucket{{{labels}le="+Inf"}} {h.n}')
+    suffix = f"{{{labels[:-1]}}}" if labels else ""
+    lines.append(f"{h.name}_sum{suffix} {h.total:.6g}")
+    lines.append(f"{h.name}_count{suffix} {h.n}")
+    return lines
+
+
+def prometheus_text() -> str:
+    """Render the process's metrics in Prometheus exposition format.
+    Registry-driven: iterates ``all_metrics()``, so every declared
+    metric is exported — registration and exposition cannot drift (the
+    omission class the metric-registration analysis rule closes
+    statically). Lives here (not cmd/common.py) so the apiserver route
+    table can serve a first-class ``/metrics`` without importing the
+    CLI layer."""
+    lines = []
+    for m in all_metrics():
+        if isinstance(m, LabeledHistogram):
+            lines.append(f"# TYPE {m.name} histogram")
+            for value, child in m.children():
+                lines.extend(_histogram_lines(
+                    child, f'{m.label}="{value}",'))
+        elif isinstance(m, Histogram):
+            lines.append(f"# TYPE {m.name} histogram")
+            lines.extend(_histogram_lines(m))
+        elif isinstance(m, LabeledCounter):
+            lines.append(f"# TYPE {m.name} counter")
+            for values, child in m.children():
+                rendered = ",".join(
+                    f'{k}="{v}"' for k, v in zip(m.label_names, values))
+                lines.append(f"{m.name}{{{rendered}}} {child.value}")
+        elif isinstance(m, LabeledGauge):
+            lines.append(f"# TYPE {m.name} gauge")
+            for value, child in m.children():
+                lines.append(
+                    f'{m.name}{{{m.label}="{value}"}} {child.value}')
+        elif isinstance(m, Counter):
+            lines.append(f"# TYPE {m.name} counter")
+            lines.append(f"{m.name} {m.value}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {m.name} gauge")
+            lines.append(f"{m.name} {m.value}")
+    return "\n".join(lines) + "\n"

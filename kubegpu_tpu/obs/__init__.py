@@ -1,7 +1,12 @@
-"""Observability: distributed scheduling traces + anomaly flight recorder.
+"""Observability: distributed scheduling traces, anomaly flight
+recorder, continuous sampling profiler, and metrics time-series.
 
 See ``obs/trace.py`` (spans, propagation, export), ``obs/flight.py``
-(dump-on-anomaly), and ``obs/validate.py`` (trace-file CI gate)."""
+(dump-on-anomaly), ``obs/profile.py`` (sampling profiler: CPU /
+lock-wait attribution by thread role + scheduling phase),
+``obs/timeseries.py`` (bounded ring of metric snapshots + windowed
+queries + anomaly watchdog), and ``obs/validate.py`` (trace-file CI
+gate)."""
 
 from kubegpu_tpu.obs.trace import (RECORDER, TRACE_HEADER, Span,  # noqa: F401
                                    SpanRecorder, batch_context,
@@ -11,3 +16,11 @@ from kubegpu_tpu.obs.trace import (RECORDER, TRACE_HEADER, Span,  # noqa: F401
                                    start_span, trace_id_for_pod,
                                    wall_now, write_trace)
 from kubegpu_tpu.obs.flight import FLIGHT, FlightRecorder  # noqa: F401
+from kubegpu_tpu.obs.profile import (Sampler,  # noqa: F401
+                                     current_attribution, profile_status,
+                                     register_thread, start_profiler,
+                                     stop_profiler)
+from kubegpu_tpu.obs.timeseries import (MetricsTimeSeries,  # noqa: F401
+                                        Watchdog, metrics_history,
+                                        snapshot_metrics, start_timeseries,
+                                        stop_timeseries)

@@ -162,6 +162,56 @@ class _Ctx(threading.local):
 
 _CTX = _Ctx()
 
+# ---- per-thread phase publication (for the sampling profiler) --------------
+#
+# A sampler thread cannot read another thread's thread-local span stack,
+# so while any sampler is running, span() publishes the innermost active
+# span name into this plain dict keyed by thread ident. Off by default:
+# the tracing hot path pays ONE global load + is-None test per span
+# transition (the explore.probe precedent); on, it pays one GIL-atomic
+# dict store. Refcounted so overlapping samplers compose.
+
+_PHASE_SINK: Optional[dict] = None
+_phase_refs = 0
+_phase_lock = threading.Lock()
+
+
+def enable_phase_tracking() -> None:
+    global _PHASE_SINK, _phase_refs
+    with _phase_lock:
+        _phase_refs += 1
+        if _PHASE_SINK is None:
+            _PHASE_SINK = {}
+
+
+def disable_phase_tracking() -> None:
+    global _PHASE_SINK, _phase_refs
+    with _phase_lock:
+        _phase_refs = max(0, _phase_refs - 1)
+        if _phase_refs == 0:
+            _PHASE_SINK = None
+
+
+def thread_phase(ident: int) -> Optional[str]:
+    """The innermost active span name on thread ``ident``, or None —
+    how the sampling profiler attributes a stack sample to the
+    scheduling phase that thread is executing."""
+    sink = _PHASE_SINK
+    if sink is None:
+        return None
+    return sink.get(ident)
+
+
+def _publish_phase(name: Optional[str]) -> None:
+    sink = _PHASE_SINK
+    if sink is None:
+        return
+    ident = threading.get_ident()
+    if name is None:
+        sink.pop(ident, None)
+    else:
+        sink[ident] = name
+
 
 def current() -> Optional[Span]:
     """The innermost active span on this thread, or None."""
@@ -246,10 +296,15 @@ def span(name: str, pod: Optional[str] = None, parent: Any = None,
     sp = start_span(name, pod=pod, parent=parent, proc=proc,
                     recorder=recorder, **attrs)
     _CTX.stack.append(sp)
+    if _PHASE_SINK is not None:
+        _publish_phase(name)
     try:
         yield sp
     finally:
         _CTX.stack.pop()
+        if _PHASE_SINK is not None:
+            cur = _CTX.stack[-1] if _CTX.stack else None
+            _publish_phase(cur.name if cur is not None else None)
         sp.finish()
         if slow_log_s is not None and sp.dur_s >= slow_log_s:
             rec = recorder or RECORDER

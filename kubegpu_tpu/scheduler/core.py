@@ -90,8 +90,9 @@ class GenericScheduler:
         # nominated pod's demand onto the node, see `_fits_on_node`).
         self._nominations: dict = {}
         self._nom_lock = threading.Lock()
-        self._pool = ThreadPoolExecutor(max_workers=self.parallelism,
-                                        thread_name_prefix="fit")
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.parallelism, thread_name_prefix="fit",
+            initializer=lambda: obs.register_thread("fit-pool"))
         # Memo-safety gate (see predicates.py): every configured predicate
         # must declare what it reads, or the equivalence memo stays off
         # for every pod — the generation counters can only invalidate
@@ -1118,6 +1119,7 @@ class BindWorkerPool:
         return True
 
     def _worker(self) -> None:
+        obs.register_thread("binder")
         while True:
             with self._cond:
                 while not self._items and not self._stopped:
@@ -2588,6 +2590,7 @@ class Scheduler:
         return n
 
     def run_forever(self, poll_s: float = 0.2) -> None:
+        obs.register_thread("sched-loop")
         while not self._stop.is_set():
             try:
                 if not self.schedule_one(timeout=poll_s):

@@ -38,6 +38,15 @@ class SchedulingQueue:
     def _priority(pod: dict) -> int:
         return int((pod.get("spec") or {}).get("priority") or 0)
 
+    def _publish_depth_locked(self) -> None:
+        # live queue depth (active + parked) for the metrics
+        # time-series, labeled per replica (obs_name) so HA processes
+        # with several queues don't clobber one another: monotone
+        # growth per child is the anomaly watchdog's "scheduler
+        # falling behind" signal
+        metrics.SCHED_QUEUE_DEPTH.labels(self.obs_name).set(
+            len(self._pods) + len(self._unschedulable))
+
     def push(self, kube_pod: dict) -> None:
         probe("queue.push")
         with self._lock:
@@ -52,6 +61,7 @@ class SchedulingQueue:
             self._pods[name] = kube_pod
             heapq.heappush(self._heap, (-self._priority(kube_pod),
                                         next(self._seq), name))
+            self._publish_depth_locked()
             self._lock.notify()
 
     def pop(self, timeout: float | None = None) -> dict | None:
@@ -65,6 +75,7 @@ class SchedulingQueue:
                     _, _, name = heapq.heappop(self._heap)
                     pod = self._pods.pop(name, None)
                     if pod is not None:
+                        self._publish_depth_locked()
                         admitted = self._enqueued.pop(name, None)
                         if admitted is not None:
                             wait_s = time.perf_counter() - admitted
@@ -94,6 +105,7 @@ class SchedulingQueue:
             self._backoff[name] = backoff
             self._unschedulable[name] = (kube_pod, time.monotonic() + backoff)
             self._enqueued.setdefault(name, time.perf_counter())
+            self._publish_depth_locked()
         obs.event("backoff_park", pod=name, proc=self.obs_name,
                   backoff_s=round(backoff, 3))
 
@@ -108,6 +120,7 @@ class SchedulingQueue:
             self._unschedulable[name] = (kube_pod,
                                          time.monotonic() + delay_s)
             self._enqueued.setdefault(name, time.perf_counter())
+            self._publish_depth_locked()
 
     def _admit_backed_off_locked(self) -> None:
         now = time.monotonic()
@@ -118,6 +131,11 @@ class SchedulingQueue:
                 self._pods[name] = pod
                 heapq.heappush(self._heap, (-self._priority(pod),
                                             next(self._seq), name))
+        if ready:
+            # a pod re-pushed while parked sits in BOTH maps until its
+            # park expires and the duplicate is dropped here — republish
+            # or the gauge stays one high until the next push/pop
+            self._publish_depth_locked()
 
     def move_all_to_active(self) -> None:
         """Cluster changed (node added/updated): retry everything now
@@ -130,6 +148,7 @@ class SchedulingQueue:
                     self._pods[name] = pod
                     heapq.heappush(self._heap, (-self._priority(pod),
                                                 next(self._seq), name))
+            self._publish_depth_locked()
             self._lock.notify_all()
 
     def forget(self, pod_name: str) -> None:
@@ -139,6 +158,7 @@ class SchedulingQueue:
             self._unschedulable.pop(pod_name, None)
             self._backoff.pop(pod_name, None)
             self._enqueued.pop(pod_name, None)
+            self._publish_depth_locked()
 
     def pending_count(self) -> int:
         with self._lock:
