@@ -1686,9 +1686,11 @@ def main():
     per_config["scale_1k_node_p95_ms"] = _p95_ms(s1k)
     per_config["scale_1k_node_sched_conflicts_total"] = \
         metrics.SCHED_CONFLICTS.value - conflicts_before
-    if os.environ.get("KGTPU_BENCH_4K"):
-        # the 4k fleet costs minutes of setup+stream; opt-in via env so
-        # the standard capture stays affordable
+    if os.environ.get("KGTPU_BENCH_SKIP_4K") != "1":
+        # headline since the vectorized scheduling core (ISSUE 14): the
+        # masked filter makes the 4096-node fleet affordable in the
+        # standard capture. KGTPU_BENCH_SKIP_4K=1 opts out for quick
+        # local reruns.
         s4k = config_scale_ha(n_hosts=4096, n_pods=128, replicas=2,
                               deadline_s=600.0)
         per_config["scale_4k_node_p50_ms"] = round(
@@ -1696,6 +1698,11 @@ def main():
         per_config["scale_4k_node_p95_ms"] = _p95_ms(s4k)
     per_config["fit_cache_hits_total"] = metrics.FIT_CACHE_HITS.value
     per_config["fit_cache_misses_total"] = metrics.FIT_CACHE_MISSES.value
+    per_config["fit_vector_passes_total"] = metrics.FIT_VECTOR_PASS_MS.n
+    per_config["fit_vector_pass_p50_ms"] = round(
+        metrics.FIT_VECTOR_PASS_MS.percentile(0.5), 4)
+    per_config["fit_scalar_fallback_total"] = \
+        metrics.FIT_SCALAR_FALLBACK.value
     if PROFILE:
         # Profiled rerun of the scheduler-heavy configs: the headline
         # numbers above stay sampler-free; the rerun quantifies WHERE
@@ -1798,13 +1805,55 @@ def smoke():
         assert p50_on <= p50_off * 1.10 + 5e-4, \
             f"sampler overhead blew the 10% budget: p50 " \
             f"{p50_off * 1e3:.2f} -> {p50_on * 1e3:.2f} ms"
-        assert att["thread_samples"] >= 30, \
-            f"sampler starved: only {att['thread_samples']} samples"
-        assert att["unattributed_share"] < 0.20, \
+        # The sampler-starved / attribution-completeness asserts moved
+        # onto the LONGER profiled section below: the vectorized core
+        # made this tiny A/B run finish in a handful of sample periods,
+        # so it can gate overhead but no longer attribution volume.
+        prof_keys = {"scale_8node_p50_ms_profiled": round(p50_on * 1e3, 3)}
+        # One profiled run of the scale config at 48 hosts. PR 13's
+        # attribution gates run UNCONDITIONALLY (a numpy-less image or
+        # KGTPU_VECTORIZE=0 must not silently drop them); the
+        # vectorized-core ratchet (ISSUE 14) rides the same section
+        # when the masked path is live: the filter phase's CPU share
+        # must sit BELOW allocate+score combined — it was ~74% of
+        # scheduler CPU before the masked pass — and the scalar-
+        # fallback rate on this uniform fleet (every pod
+        # array-eligible, no taints/volumes/nominations) must stay
+        # under 5%. One retry absorbs a sample-starved run on a fast
+        # or noisy box.
+        from kubegpu_tpu.scheduler import vectorized as _vec
+
+        fb0 = metrics.FIT_SCALAR_FALLBACK.value
+        vn0 = metrics.FIT_VECTOR_NODES_PER_PASS.total
+        for attempt in (1, 2):
+            _start_profiled_section()
+            config6_scale(n_hosts=48, n_pods=88)
+            config6_scale(n_hosts=48, n_pods=88)
+            att_vec = _stop_profiled_section()
+            if att_vec["thread_samples"] >= 30 or attempt == 2:
+                break
+        assert att_vec["thread_samples"] >= 30, \
+            f"sampler starved: only {att_vec['thread_samples']} samples"
+        assert att_vec["unattributed_share"] < 0.20, \
             f"profile attribution below the 80% bar: " \
-            f"{att['unattributed_share']:.0%} unattributed"
-        prof_keys = _attribution_keys(att)
-        prof_keys["scale_8node_p50_ms_profiled"] = round(p50_on * 1e3, 3)
+            f"{att_vec['unattributed_share']:.0%} unattributed"
+        prof_keys.update(_attribution_keys(att_vec))
+        if _vec.available():
+            share = att_vec["sched_cpu_share"]
+            assert share["filter"] < share["allocate"] + share["score"] \
+                + 1e-9, \
+                f"filter CPU share {share['filter']:.0%} >= allocate+" \
+                f"score {share['allocate'] + share['score']:.0%} — the " \
+                f"vectorized filter pass regressed to per-node work"
+            fb = metrics.FIT_SCALAR_FALLBACK.value - fb0
+            vn = metrics.FIT_VECTOR_NODES_PER_PASS.total - vn0
+            fallback_rate = fb / max(fb + vn, 1)
+            assert fallback_rate < 0.05, \
+                f"scalar-fallback rate {fallback_rate:.1%} >= 5% on a " \
+                f"uniform fleet — array-eligible pods are leaking to " \
+                f"the scalar path"
+            prof_keys["fit_scalar_fallback_rate"] = round(fallback_rate, 4)
+            prof_keys["vector_filter_cpu_share"] = share["filter"]
     throughput = config_throughput(n_hosts=16, n_pods=24)  # 56 of 64
     # the stream wire is what the smoke exercises (the binaries'
     # default); parity above is what keeps the JSON fallback honest
@@ -1884,8 +1933,34 @@ def smoke():
         "fit_cache_misses_total": metrics.FIT_CACHE_MISSES.value,
         "fit_cache_invalidations_total":
             metrics.FIT_CACHE_INVALIDATIONS.value,
+        "fit_vector_passes_total": metrics.FIT_VECTOR_PASS_MS.n,
+        "fit_scalar_fallback_total": metrics.FIT_SCALAR_FALLBACK.value,
         **prof_keys,
     }))
+
+
+def scale_4k():
+    """Standalone profiled scale_4k_node run (the nightly flamegraph
+    archive): the 4096-node fake fleet under 2 optimistic replicas with
+    the sampler attributing scheduler CPU at that scale. Prints one JSON
+    line; collapsed stacks + attribution land in $KGTPU_PROFILE_DIR."""
+    metrics.reset_all()
+    sampler = _start_profiled_section() if PROFILE else None
+    lat = config_scale_ha(n_hosts=4096, n_pods=128, replicas=2,
+                          deadline_s=900.0)
+    out = {
+        "metric": "scale_4k_node_profiled",
+        "wire_protocol": "stream",
+        "scale_4k_node_p50_ms": round(statistics.median(lat) * 1e3, 3),
+        "scale_4k_node_p95_ms": _p95_ms(lat),
+        "sched_conflicts_total": metrics.SCHED_CONFLICTS.value,
+        "fit_scalar_fallback_total": metrics.FIT_SCALAR_FALLBACK.value,
+    }
+    if sampler is not None:
+        out.update(_attribution_keys(_stop_profiled_section()))
+    while _LIVE_CLUSTERS:
+        _LIVE_CLUSTERS.pop().close()
+    print(json.dumps(out))
 
 
 def scale_1k():
@@ -1923,6 +1998,8 @@ if __name__ == "__main__":
         # capture-fallback path (the multi-minute tail in BENCH_r05.json)
         os.environ["KGTPU_BENCH_SKIP_WORKLOAD"] = "1"
     PROFILE = "--profile" in _argv
+    if "--scale-4k" in _argv:
+        sys.exit(scale_4k())
     if "--scale-1k" in _argv:
         sys.exit(scale_1k())
     sys.exit(smoke() if "--smoke" in _argv else main())

@@ -61,7 +61,15 @@ def _fit_cache_summary() -> dict:
     summary makes visible without a profiler."""
     return {"hits": metrics.FIT_CACHE_HITS.value,
             "misses": metrics.FIT_CACHE_MISSES.value,
-            "invalidations": metrics.FIT_CACHE_INVALIDATIONS.value}
+            "invalidations": metrics.FIT_CACHE_INVALIDATIONS.value,
+            # vectorized scheduling core: masked passes + how many
+            # node-verdicts fell through to the scalar path (the
+            # fallback rate on a uniform fleet is CI-gated < 5%)
+            "vector_passes": metrics.FIT_VECTOR_PASS_MS.n,
+            "vector_pass_p50_ms": round(
+                metrics.FIT_VECTOR_PASS_MS.percentile(0.5), 4),
+            "scalar_fallback": metrics.FIT_SCALAR_FALLBACK.value,
+            "verdict_timeouts": metrics.FIT_VERDICT_TIMEOUTS.value}
 
 
 def _data_plane_summary() -> dict:

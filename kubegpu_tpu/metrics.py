@@ -340,6 +340,23 @@ QUOTA_PARKED = Counter("quota_parked_total")
 # wedged sampler thread is visible from /metrics.
 SCHED_QUEUE_DEPTH = LabeledGauge("sched_queue_depth", "queue")
 PROFILE_SAMPLES = Counter("profile_samples_total")
+# Vectorized scheduling core (scheduler/vectorized.py + the columnar
+# mirror in scheduler/cache.py): fit_vector_pass_ms times one masked
+# filter pass (sum/count give total vector node-verdicts and pass
+# count); fit_vector_nodes_per_pass histograms how many nodes each pass
+# resolved vectorized; fit_scalar_fallback_total counts node-verdicts
+# that fell out of the mask into the scalar path (nodes with taints /
+# placed volumes / live nominations, or whole pods needing object
+# predicates) — the scalar-fallback RATE on a uniform fleet is
+# fallback / (fallback + vector nodes) and is CI-gated < 5%.
+# fit_verdict_timeouts_total counts device-verdict waiters that timed
+# out on a wedged owner and recomputed (silent duplicated work
+# otherwise — a wedged class is now visible).
+FIT_VECTOR_PASS_MS = Histogram("fit_vector_pass_ms", start_us=0.25)
+FIT_VECTOR_NODES_PER_PASS = Histogram(  # analysis: disable=metric-registration -- node-count histogram; the unit IS nodes-per-pass, not a time/bytes quantity the suffix vocabulary covers
+    "fit_vector_nodes_per_pass", start_us=1.0, factor=2.0, count=15)
+FIT_SCALAR_FALLBACK = Counter("fit_scalar_fallback_total")
+FIT_VERDICT_TIMEOUTS = Counter("fit_verdict_timeouts_total")
 
 
 def all_metrics() -> list:

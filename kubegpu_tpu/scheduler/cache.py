@@ -13,18 +13,24 @@ cache entirely from node/pod annotations (SURVEY.md §6 checkpoint/resume).
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from typing import Any
 
 from kubegpu_tpu import metrics
 from kubegpu_tpu.analysis.explore import probe
-from kubegpu_tpu.core import codec
+from kubegpu_tpu.core import codec, grammar
 from kubegpu_tpu.core.types import NodeInfo, PodInfo
 from kubegpu_tpu.scheduler import interpod
 from kubegpu_tpu.scheduler.equivalence import EquivalenceCache
 from kubegpu_tpu.scheduler.predicates import (pod_core_requests,
                                               pod_host_ports, pod_volumes)
+
+try:  # struct-of-arrays mirror; scalar paths never require numpy
+    import numpy as _np
+except ImportError:  # pragma: no cover - numpy ships in the image
+    _np = None
 
 ASSUMED_POD_TTL_S = 30.0
 
@@ -47,6 +53,8 @@ class CachedNode:
         self.pod_volumes: dict = {}     # pod name -> volume dicts (disk conflicts)
         self.pod_affinity: dict = {}    # pod name -> spec.affinity (interpod)
         self.pod_namespaces: dict = {}  # pod name -> namespace
+        self.pod_priorities: dict = {}  # pod name -> spec.priority (preempt scan)
+        self.pod_chips: dict = {}       # pod name -> charged chip-leaf count
 
     def used_ports(self) -> set:
         out: set = set()
@@ -101,6 +109,19 @@ def _fit_fingerprint(kube_node: dict) -> str:
         sort_keys=True, default=str)
 
 
+def _charged_chip_count(pod_info: PodInfo) -> int:
+    """Physical chip leafs this pod's allocation charges — what eviction
+    would free, exact by construction (the same ``allocate_from`` values
+    ``return_pod_resources`` walks). 0 for device-less pods."""
+    chips = 0
+    for conts in (pod_info.init_containers, pod_info.running_containers):
+        for cont in conts.values():
+            for phys in cont.allocate_from.values():
+                if grammar.chip_id_from_path(phys) is not None:
+                    chips += 1
+    return chips
+
+
 def _slim_node_copy(kube_node: dict) -> dict:
     """Copy only what predicates/priorities read (labels, annotations,
     taints, unschedulable, conditions, allocatable). The snapshot runs on
@@ -131,6 +152,262 @@ def _slim_node_copy(kube_node: dict) -> dict:
     }
 
 
+# ---- struct-of-arrays fleet mirror ------------------------------------------
+#
+# The vectorized scheduling core (scheduler/vectorized.py) filters and
+# scores the WHOLE fleet in masked array passes instead of per-node
+# Python predicate calls. These columns are its input: one row per node,
+# maintained under the SAME lock and on the SAME mutation paths that bump
+# fit generations today (set_node / remove_node / _charge_locked /
+# _invalidate_*), so a column can never disagree with the object it
+# mirrors. Rows hold only what the masked predicates read: condition
+# flags, taints, core alloc/req, free-chip counts, the canonical
+# device-shape fingerprint, and the min bound-pod priority (the
+# vectorized victim scan's prune key).
+
+_NO_PODS_PRIORITY = 2 ** 62
+# .../tpu/<chip-id>/<suffix> — every chip-attribute path, any suffix
+_CHIP_SEG_RE = re.compile(r"^(.*/" + grammar.TPU_LEAF + r"/)([^/]+)(/[^/]+)$")
+
+
+def _canonical_paths(allocatable: dict) -> dict:
+    """path -> translation-normalized path: chip coordinates shifted to
+    the node-local origin, so two nodes whose inventories are identical
+    modulo mesh position produce identical canonical paths. Device fit
+    verdicts are translation-invariant for pods whose requests name no
+    absolute device paths (count/auto/contiguous modes all translate
+    per node), which is what lets one allocator search stand in for a
+    whole uniform fleet. Non-coordinate chip ids map to themselves."""
+    parsed = {}
+    coords = []
+    for res in allocatable:
+        m = _CHIP_SEG_RE.match(res)
+        if m is None:
+            continue
+        c = grammar.coords_from_chip_id(m.group(2))
+        if c is None or len(c) != 3:
+            continue
+        parsed[res] = (m.group(1), c, m.group(3))
+        coords.append(c)
+    if not parsed:
+        return {}
+    org = tuple(min(c[i] for c in coords) for i in range(3))
+    return {res: f"{head}{grammar.chip_id_from_coords(tuple(c[i] - org[i] for i in range(3)))}{tail}"
+            for res, (head, c, tail) in parsed.items()}
+
+
+class _NodeRow:
+    """Per-node columnar fields, recomputed only on the mutation path
+    that owns them (node flags at set_node, usage at charge time)."""
+
+    __slots__ = ("unschedulable", "n_notready", "mem_pressure",
+                 "disk_pressure", "tainted",
+                 "core_alloc", "canon", "alloc_id", "chip_paths",
+                 "used_key", "free_chips", "vol_heavy",
+                 "min_prio", "gen")
+
+    def __init__(self) -> None:
+        self.gen = 0
+        self.vol_heavy = False
+        self.min_prio = _NO_PODS_PRIORITY
+
+
+class ColumnarView:
+    """Read-only struct-of-arrays snapshot handed to one scheduling pass
+    (copied under the cache lock, so a concurrent charge cannot tear a
+    masked filter mid-pass). ``names`` is sorted and row-aligned with
+    ``cycle_snapshot``'s name list; ``dev_fps[i]`` is node i's canonical
+    device-shape fingerprint (equal fingerprint => identical device
+    verdict for any translation-invariant request); ``canon_maps[i]``
+    its path canonicalization (shared refs — treat as immutable)."""
+
+    __slots__ = ("names", "idx", "epoch", "gen", "unschedulable",
+                 "n_notready", "mem_pressure", "disk_pressure", "tainted",
+                 "vol_heavy", "free_chips",
+                 "min_pod_priority", "core_alloc", "core_req",
+                 "dev_fps", "canon_maps")
+
+
+class _FleetColumns:
+    """The live mirror. guarded-by: SchedulerCache._lock — every method
+    here is only called with the cache lock held. Arrays materialize
+    lazily after membership changes (a 4k-node fleet registering pays
+    one O(n) build, not n of them) and are updated in place per-row
+    afterwards; ``view()`` hands out copies."""
+
+    def __init__(self) -> None:
+        self.rows: dict = {}          # node name -> _NodeRow
+        self._alloc_ids: dict = {}    # canonical alloc/scorer tuple -> id
+        self._names: list = []
+        self._idx: dict = {}
+        self._arrays: dict | None = None
+        self._res_keys: tuple = ()
+        self._dirty = True
+        self.epoch = 0  # bumped per rebuild: O(1) membership identity
+
+    # -- row computation (mutation-path hooks) ------------------------------
+
+    def set_node(self, cached: CachedNode) -> None:
+        name = cached.name
+        row = self.rows.get(name)
+        if row is None:
+            row = _NodeRow()
+            self.rows[name] = row
+            self._dirty = True
+        kube_node = cached.kube_node
+        spec = kube_node.get("spec") or {}
+        row.unschedulable = bool(spec.get("unschedulable"))
+        n_notready = 0
+        mem_p = disk_p = False
+        for cond in (kube_node.get("status") or {}).get("conditions") or []:
+            ctype, status = cond.get("type"), cond.get("status")
+            if ctype == "Ready" and status != "True":
+                n_notready += 1
+            elif ctype == "MemoryPressure" and status == "True":
+                mem_p = True
+            elif ctype == "DiskPressure" and status == "True":
+                disk_p = True
+        row.n_notready = n_notready
+        row.mem_pressure = mem_p
+        row.disk_pressure = disk_p
+        row.tainted = any(
+            taint.get("effect") in ("NoSchedule", "NoExecute")
+            for taint in spec.get("taints") or [])
+        row.core_alloc = cached.core_allocatable()
+        if set(row.core_alloc) - set(self._res_keys):
+            self._dirty = True
+        node_ex = cached.node_ex
+        row.canon = _canonical_paths(node_ex.allocatable)
+        canon = row.canon
+        alloc_key = (
+            tuple(sorted((canon.get(k, k), v)
+                         for k, v in node_ex.allocatable.items())),
+            tuple(sorted((canon.get(k, k), v)
+                         for k, v in node_ex.scorer.items())))
+        alloc_id = self._alloc_ids.get(alloc_key)
+        if alloc_id is None:
+            alloc_id = len(self._alloc_ids)
+            self._alloc_ids[alloc_key] = alloc_id
+        row.alloc_id = alloc_id
+        # chip-leaf paths in canonical sorted order — the fixed roster
+        # the free-chip count walks on every charge
+        row.chip_paths = tuple(sorted(
+            (p for p in node_ex.allocatable
+             if grammar.chip_id_from_path(p) is not None),
+            key=lambda p: canon.get(p, p)))
+        self.charge(cached)
+
+    def charge(self, cached: CachedNode) -> None:
+        """Usage-derived fields, recomputed on every pod charge/release
+        (the same event that bumps the node's fit generation)."""
+        row = self.rows.get(cached.name)
+        if row is None:
+            return
+        node_ex = cached.node_ex
+        canon = row.canon
+        used = node_ex.used
+        row.used_key = tuple(sorted(
+            (canon.get(k, k), v) for k, v in used.items() if v))
+        row.free_chips = sum(
+            max(node_ex.allocatable.get(path, 0) - used.get(path, 0), 0)
+            for path in row.chip_paths)
+        row.vol_heavy = bool(cached.pod_volumes)
+        row.min_prio = min(cached.pod_priorities.values()) \
+            if cached.pod_priorities else _NO_PODS_PRIORITY
+        if not self._dirty and self._arrays is not None:
+            self._write_row(self._idx[cached.name], row, cached)
+
+    def set_gen(self, name: str, gen: int) -> None:
+        row = self.rows.get(name)
+        if row is None:
+            return
+        row.gen = gen
+        if not self._dirty and self._arrays is not None:
+            self._arrays["gen"][self._idx[name]] = gen
+
+    def bump_all_gens(self, gens: dict) -> None:
+        for name, row in self.rows.items():
+            row.gen = gens.get(name, row.gen)
+        if not self._dirty and self._arrays is not None:
+            arr = self._arrays["gen"]
+            for i, name in enumerate(self._names):
+                arr[i] = self.rows[name].gen
+
+    def drop(self, name: str) -> None:
+        if self.rows.pop(name, None) is not None:
+            self._dirty = True
+
+    # -- materialization ----------------------------------------------------
+
+    def _write_row(self, i: int, row: _NodeRow, cached: CachedNode) -> None:
+        arrays = self._arrays
+        arrays["free_chips"][i] = row.free_chips
+        arrays["min_prio"][i] = row.min_prio
+        arrays["vol_heavy"][i] = row.vol_heavy
+        arrays["gen"][i] = row.gen
+        arrays["unschedulable"][i] = row.unschedulable
+        arrays["n_notready"][i] = row.n_notready
+        arrays["mem_pressure"][i] = row.mem_pressure
+        arrays["disk_pressure"][i] = row.disk_pressure
+        arrays["tainted"][i] = row.tainted
+        arrays["dev_fps"][i] = (row.alloc_id, row.used_key)
+        req = cached.requested_core
+        for res in self._res_keys:
+            arrays["core_alloc"][res][i] = row.core_alloc.get(res, _np.nan)
+            arrays["core_req"][res][i] = req.get(res, 0)
+
+    def _rebuild(self, nodes: dict) -> None:
+        self._names = sorted(self.rows)
+        self._idx = {n: i for i, n in enumerate(self._names)}
+        n = len(self._names)
+        res_keys: set = set()
+        for row in self.rows.values():
+            res_keys.update(row.core_alloc)
+        self._res_keys = tuple(sorted(res_keys))
+        self._arrays = {
+            "gen": _np.zeros(n, dtype=_np.int64),
+            "unschedulable": _np.zeros(n, dtype=bool),
+            "n_notready": _np.zeros(n, dtype=_np.int16),
+            "mem_pressure": _np.zeros(n, dtype=bool),
+            "disk_pressure": _np.zeros(n, dtype=bool),
+            "tainted": _np.zeros(n, dtype=bool),
+            "vol_heavy": _np.zeros(n, dtype=bool),
+            "free_chips": _np.zeros(n, dtype=_np.int64),
+            "min_prio": _np.zeros(n, dtype=_np.int64),
+            "core_alloc": {res: _np.full(n, _np.nan)
+                           for res in self._res_keys},
+            "core_req": {res: _np.zeros(n) for res in self._res_keys},
+            "dev_fps": [None] * n,
+        }
+        for i, name in enumerate(self._names):
+            self._write_row(i, self.rows[name], nodes[name])
+        self._dirty = False
+        self.epoch += 1
+
+    def view(self, nodes: dict) -> "ColumnarView | None":
+        if _np is None or len(self.rows) != len(nodes):
+            return None
+        if self._dirty or self._arrays is None:
+            self._rebuild(nodes)
+        arrays = self._arrays
+        out = ColumnarView()
+        out.names = list(self._names)
+        out.idx = self._idx
+        out.epoch = self.epoch
+        for field in ("gen", "unschedulable", "n_notready", "mem_pressure",
+                      "disk_pressure", "tainted", "vol_heavy",
+                      "free_chips"):
+            setattr(out, field, arrays[field].copy())
+        out.min_pod_priority = arrays["min_prio"].copy()
+        out.core_alloc = {res: arr.copy()
+                          for res, arr in arrays["core_alloc"].items()}
+        out.core_req = {res: arr.copy()
+                        for res, arr in arrays["core_req"].items()}
+        out.dev_fps = list(arrays["dev_fps"])
+        out.canon_maps = [self.rows[n].canon for n in self._names]
+        return out
+
+
 class SchedulerCache:
     def __init__(self, device_scheduler: Any) -> None:
         self.device_scheduler = device_scheduler
@@ -149,6 +426,10 @@ class SchedulerCache:
         self._gen: dict = {}            # node name -> generation
         self._snap: dict = {}           # node name -> (generation, NodeSnapshot)
         self.equivalence = EquivalenceCache()
+        # Struct-of-arrays fleet mirror for the vectorized scheduling
+        # core; None when numpy is unavailable (every consumer then
+        # takes the scalar path).
+        self.columns = _FleetColumns() if _np is not None else None
 
     # ---- generations / invalidation ----------------------------------------
 
@@ -159,6 +440,8 @@ class SchedulerCache:
         # fresh node retires nothing.
         self._gen[name] = self._gen.get(name, 0) + 1
         self._snap.pop(name, None)
+        if self.columns is not None:
+            self.columns.set_gen(name, self._gen[name])
         if record:
             metrics.FIT_CACHE_INVALIDATIONS.inc()
 
@@ -171,6 +454,8 @@ class SchedulerCache:
         for name in self.nodes:
             self._gen[name] = self._gen.get(name, 0) + 1
         self._snap.clear()
+        if self.columns is not None:
+            self.columns.bump_all_gens(self._gen)
         metrics.FIT_CACHE_INVALIDATIONS.inc(len(self.nodes))
 
     def node_generation(self, name: str) -> int:
@@ -206,6 +491,8 @@ class SchedulerCache:
             changed = old_labels is None or \
                 fingerprint != cached.fit_fingerprint
             cached.fit_fingerprint = fingerprint
+            if changed and self.columns is not None:
+                self.columns.set_node(cached)
             if not changed:
                 return
             if old_labels is None:
@@ -239,6 +526,8 @@ class SchedulerCache:
                     for aff in cached.pod_affinity.values())
                 self._required_anti_pods -= departed_anti
                 self.device_scheduler.remove_node(name)
+                if self.columns is not None:
+                    self.columns.drop(name)
                 # the departed node's own generation must always move —
                 # it is no longer in self.nodes, so the all-flush below
                 # would skip it and a re-add could resume at a generation
@@ -320,6 +609,9 @@ class SchedulerCache:
                 self._affinity_pods += 1
                 self._required_anti_pods += required_anti
             cached.pod_namespaces[name] = meta.get("namespace") or "default"
+            cached.pod_priorities[name] = \
+                int((kube_pod.get("spec") or {}).get("priority") or 0)
+            cached.pod_chips[name] = _charged_chip_count(pod_info)
             self._charged.add(name)
         else:
             cached.pod_ports.pop(name, None)
@@ -329,7 +621,11 @@ class SchedulerCache:
                 self._affinity_pods -= 1
                 self._required_anti_pods -= required_anti
             cached.pod_namespaces.pop(name, None)
+            cached.pod_priorities.pop(name, None)
+            cached.pod_chips.pop(name, None)
             self._charged.discard(name)
+        if self.columns is not None:
+            self.columns.charge(cached)
         if required_anti:
             # A pod with REQUIRED anti-affinity changes predicate results
             # on every node sharing a topology domain — per-node
@@ -373,10 +669,14 @@ class SchedulerCache:
                 return None
             return NodeSnapshot(cached)
 
-    def cycle_snapshot(self) -> tuple:
-        """``(names, snapshots, generations)`` for one scheduling pass
-        under ONE lock acquisition — the per-pod-per-node ``snapshot_node``
-        storm was the hot loop's biggest fixed cost at 256 nodes.
+    def cycle_snapshot(self, with_columns: bool = False) -> tuple:
+        """``(names, snapshots, generations[, columns])`` for one
+        scheduling pass under ONE lock acquisition — the per-pod-per-node
+        ``snapshot_node`` storm was the hot loop's biggest fixed cost at
+        256 nodes. ``with_columns`` additionally returns a
+        ``ColumnarView`` captured atomically with the snapshots and
+        generations (or None without numpy), so the vectorized pass and
+        the object snapshots can never describe different states.
 
         Snapshots are generation-cached and SHARED across passes: a node
         whose generation has not moved hands out the same object it did
@@ -403,6 +703,10 @@ class SchedulerCache:
                     entry = (gen, NodeSnapshot(self.nodes[name]))
                     self._snap[name] = entry
                 snaps[name] = entry[1]
+            if with_columns:
+                cols = self.columns.view(self.nodes) \
+                    if self.columns is not None else None
+                return names, snaps, gens, cols
             return names, snaps, gens
 
     def has_affinity_pods(self) -> bool:

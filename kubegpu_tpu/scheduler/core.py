@@ -25,7 +25,8 @@ from typing import Any, Callable, Iterable
 from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.analysis.explore import probe
 from kubegpu_tpu.core import codec, grammar
-from kubegpu_tpu.scheduler import factory, interpod, predicates, priorities
+from kubegpu_tpu.scheduler import (factory, interpod, predicates, priorities,
+                                   vectorized)
 from kubegpu_tpu.scheduler.cache import SchedulerCache
 from kubegpu_tpu.scheduler.equivalence import (devolumed_class,
                                                equivalence_class)
@@ -79,6 +80,14 @@ class GenericScheduler:
         self._device_verdicts: dict = {}
         self._device_lock = threading.Lock()
         self._device_inflight: dict = {}  # dev_key -> threading.Event
+        # Vectorized scheduling core (scheduler/vectorized.py): one masked
+        # array pass per class replaces the per-node predicate loop when
+        # the algorithm is the default chain and the pod is array-eligible.
+        # None when numpy is unavailable or KGTPU_VECTORIZE=0 — every
+        # consumer then takes the scalar path unchanged.
+        self.vector = vectorized.VectorizedFitPass(cache, device_scheduler) \
+            if vectorized.available() and self.algorithm.vector_predicates \
+            else None
         self._owner_cache = None  # (expires, owner listings | None)
         # Set by Scheduler; None = no volume surface (predicate no-ops).
         self.volume_binder = None
@@ -157,8 +166,11 @@ class GenericScheduler:
             return (base if base.node_name == node_name else inv).clone()
         # exposed so the device-verdict cache can tell WHICH variant a
         # node evaluates: the pod's annotated node sees the pinned
-        # allocation, everyone else the invalidated one
+        # allocation, everyone else the invalidated one; the vectorized
+        # pass reads the invalidated PodInfo directly to derive the
+        # broadcastable demand class
         get.pinned_node = base.node_name
+        get.inv_info = inv
         return get
 
     # ---- nominated-node reservations --------------------------------------
@@ -474,7 +486,10 @@ class GenericScheduler:
                     hit = self._device_verdicts.get(dev_key)
                 if hit is not None:
                     return hit
-                # owner failed or timed out: compute it ourselves
+                # owner failed or timed out: compute it ourselves — and
+                # count the recompute, or a wedged class silently doubles
+                # every waiter's work with nothing visible in /metrics
+                metrics.FIT_VERDICT_TIMEOUTS.inc()
         try:
             if pod_info_get is not None:
                 pod_info = pod_info_get(snap.name)
@@ -536,14 +551,43 @@ class GenericScheduler:
             eq_class = equivalence_class(kube_pod)
         elif memo_ok:
             vol_split = devolumed_class(kube_pod)
+        pod_info_get = self._pod_info_provider(kube_pod)
+        # A PVC pod's masked pass runs its DEVOLUMED sibling (verdicts
+        # are monotone in volumes); survivors owe the volume-reading
+        # predicates a scalar run against the real pod afterwards —
+        # exactly the devolumed-split contract the scalar path applies.
+        filter_pod = kube_pod if vol_split is None else vol_split[1]
+        lookup_class = eq_class if eq_class is not None else \
+            (vol_split[0] if vol_split is not None else None)
+        # The affinity pre-gate reads only a counter, not the metadata:
+        # when the cluster holds placed (anti-)affinity pods this pass
+        # ends scalar anyway (``meta`` below nulls the columns), so skip
+        # paying the columnar snapshot copy up front. The post-snapshot
+        # ``meta`` check stays authoritative — a stale False here just
+        # means one wasted column copy, never a wrong verdict.
+        want_vector = (
+            self.vector is not None and lookup_class is not None
+            and not interpod.pod_declares_interpod_affinity(kube_pod)
+            and not self.cache.has_affinity_pods()
+            and self.vector.pod_eligible(filter_pod, pod_info_get.inv_info))
         # Snapshots + generations BEFORE the metadata snapshot: a watcher
         # invalidation racing the metadata build must make the eventual
         # store() land under a never-served generation — a verdict
         # computed from pre-invalidation metadata stored under a
-        # post-invalidation generation would persist wrongly.
-        names, snaps, eq_gens = self.cache.cycle_snapshot()
+        # post-invalidation generation would persist wrongly. The
+        # columnar view rides the same lock acquisition so the masked
+        # pass and the object snapshots describe ONE state.
+        if want_vector:
+            names, snaps, eq_gens, cols = \
+                self.cache.cycle_snapshot(with_columns=True)
+        else:
+            names, snaps, eq_gens = self.cache.cycle_snapshot()
+            cols = None
         meta = self._interpod_meta(kube_pod)
-        pod_info_get = self._pod_info_provider(kube_pod)
+        if meta is not None:
+            # placed pods carry (anti-)affinity metadata: every node owes
+            # MatchInterPodAffinity an object-level run — scalar pass
+            cols = None
         device_class = self._device_class(kube_pod, auto_topology)
         # Nominations and memo hits resolve serially, up front: the
         # nominations in one lock pass, the memo in one `lookup_many` —
@@ -555,15 +599,50 @@ class GenericScheduler:
             min_priority=_pod_priority(kube_pod))
         nom_fps = {n: tuple(sorted(p["metadata"]["name"] for p in pods))
                    for n, pods in nom_by_node.items()}
-        lookup_class = eq_class if eq_class is not None else \
-            (vol_split[0] if vol_split is not None else None)
-        hits: dict = {}
-        if lookup_class is not None:
-            hits = self.cache.equivalence.lookup_many(
-                lookup_class, eq_gens, nom_fps)
         results: dict = {}
+        scalar_names = names
+        if cols is not None:
+            # ONE masked pass resolves every array-eligible node's
+            # verdict; the remainder (tainted / volume-carrying /
+            # nominated nodes) falls through to the scalar path below.
+            t0v = time.perf_counter()
+            results, scalar_names = self.vector.run_filter(
+                filter_pod, lookup_class, cols, snaps, nom_by_node,
+                pod_info_get)
+            if vol_split is not None:
+                # positive sibling verdicts: only the volume-reading
+                # predicates remain, run against the REAL pod (few
+                # survivors — the sibling pass pruned the fleet)
+                for n, r in results.items():
+                    if not r[0]:
+                        continue
+                    ctx = factory.PredicateContext(kube_pod, snaps[n],
+                                                   meta, vol)
+                    for _pname, pred in self._volume_predicates:
+                        ok, reasons = pred(ctx)
+                        if not ok:
+                            results[n] = (False, reasons, 0.0)
+                            break
+            metrics.FIT_VECTOR_PASS_MS.observe(
+                (time.perf_counter() - t0v) * 1e3)
+            metrics.FIT_VECTOR_NODES_PER_PASS.observe(
+                len(names) - len(scalar_names))
+            if scalar_names:
+                metrics.FIT_SCALAR_FALLBACK.inc(len(scalar_names))
+        elif self.vector is not None:
+            # the array machinery exists but this pod (or this pass's
+            # inter-pod metadata) needs object predicates: the whole
+            # fleet is a scalar fallback — visible in the rate
+            metrics.FIT_SCALAR_FALLBACK.inc(len(names))
+        hits: dict = {}
+        if lookup_class is not None and scalar_names:
+            hits = self.cache.equivalence.lookup_many(
+                lookup_class,
+                eq_gens if cols is None
+                else {n: eq_gens[n] for n in scalar_names},
+                nom_fps)
         pending = []
-        for n in names:
+        for n in scalar_names:
             hit = hits.get(n)
             if hit is not None and (vol_split is None or not hit[0]):
                 # a positive sibling verdict still owes the volume-
@@ -617,6 +696,22 @@ class GenericScheduler:
         ``snaps`` are the fit pass's shared cycle snapshots (read-only);
         a feasible node missing from them (direct callers) is snapshotted
         here."""
+        if meta is self._AUTO_META:
+            meta = self._interpod_meta(kube_pod)
+        if self.vector is not None and self.algorithm.vector_priorities \
+                and meta is None:
+            # every configured priority has an array kernel and no
+            # placed pod carries affinity metadata: score the survivors
+            # as column arithmetic (float-for-float the scalar combine)
+            scored = self.vector.run_scores(
+                kube_pod, feasible, snaps or {}, self.algorithm,
+                self._owner_selectors(kube_pod))
+            if scored is not None:
+                for ext in self.extenders:
+                    for name, score in ext.prioritize(
+                            kube_pod, sorted(scored)).items():
+                        scored[name] = scored.get(name, 0.0) + score
+                return scored
         pod_requests = _pod_core_requests(kube_pod)
         snaps = snaps or {}
         facts: dict = {}
@@ -800,7 +895,17 @@ class GenericScheduler:
         meta = self._interpod_meta(kube_pod)
         vol = self._volume_snapshot(kube_pod)
         pdb_state = self._pdb_state()
-        names, cycle_snaps, gens = self.cache.cycle_snapshot()
+        pod_info_get = self._pod_info_provider(kube_pod)
+        want_vector = (
+            self.vector is not None and meta is None and vol is None
+            and not self._requests_auto_topology(kube_pod)
+            and self.vector.pod_eligible(kube_pod, pod_info_get.inv_info))
+        if want_vector:
+            names, cycle_snaps, gens, cols = \
+                self.cache.cycle_snapshot(with_columns=True)
+        else:
+            names, cycle_snaps, gens = self.cache.cycle_snapshot()
+            cols = None
         if failures is None:
             # Direct call without a fit pass: the memo's stored negatives
             # stand in for one — a node whose cached verdict failed on an
@@ -832,9 +937,10 @@ class GenericScheduler:
         api = getattr(self, "api", None)
         if api is None:
             return None
+        lister = getattr(self, "pod_lister", None)
         try:
-            pods_by_name = {p["metadata"]["name"]: p
-                            for p in list_bound_pods(api)}
+            bound = lister() if lister is not None else list_bound_pods(api)
+            pods_by_name = {p["metadata"]["name"]: p for p in bound}
         except Exception:
             return None
         # Eviction can only change a verdict where something evictable
@@ -849,9 +955,69 @@ class GenericScheduler:
             return any(_pod_priority(pods_by_name[p]) < prio
                        for p in snap.pod_names if p in pods_by_name)
 
-        names = [n for n in names if _has_evictable(n)]
-        pod_info_get = self._pod_info_provider(kube_pod)
+        if cols is not None:
+            # Columnar twin of the per-pod loop: the min bound-pod
+            # priority column answers "anything evictable here?" in one
+            # compare per node. Assumed pods widen the column's min, so
+            # this prune only KEEPS extra nodes (the simulation still
+            # decides) — it can never drop a node the loop would keep.
+            def _has_evictable_fast(node_name: str) -> bool:
+                i = cols.idx.get(node_name)
+                if i is None:
+                    return _has_evictable(node_name)
+                return bool(cols.min_pod_priority[i] < prio)
+
+            names = [n for n in names if _has_evictable_fast(n)]
+        else:
+            names = [n for n in names if _has_evictable(n)]
         device_class = self._device_class(kube_pod)
+        # One PodInfo decode per victim candidate per PASS: the
+        # simulation charges each victim up to three times per node
+        # (evict, reprieve, re-evict), and the annotation JSON decode
+        # dominated the per-charge cost. take/return never mutate the
+        # PodInfo, so one shared decode is safe across nodes and phases.
+        info_cache: dict = {}
+
+        def info_of(pod: dict) -> Any:
+            pod_name = pod["metadata"]["name"]
+            info = info_cache.get(pod_name)
+            if info is None:
+                info = codec.kube_pod_to_pod_info(
+                    pod, invalidate_existing=False)
+                info_cache[pod_name] = info
+            return info
+
+        fast = vectorized.FastPreemptFit(self.vector, kube_pod,
+                                         pod_info_get, cols) \
+            if cols is not None else None
+        # Canonical-simulation memo (fast path only): nodes whose
+        # (device shape, usage, core state, ordered victim roster)
+        # fingerprints match run isomorphic simulations, so one
+        # representative's victim indices + violation count stand for
+        # the whole group — the uniform-fleet victim scan collapses to
+        # one simulation plus fingerprint computation per node.
+        sim_memo: dict | None = {} if fast is not None else None
+        if fast is not None:
+            # chip-capacity prune off the columns: a node whose free +
+            # evictable chips cannot cover the demand fails phase 1 of
+            # the simulation by construction — skip it before paying a
+            # private snapshot + full evict-and-reprieve
+            names = [n for n in names
+                     if cycle_snaps.get(n) is None
+                     or fast.might_fit_after_full_eviction(
+                         n, prio, pods_by_name, cycle_snaps[n])]
+        if fast is not None and names:
+            cidx, tnt, vh = cols.idx, cols.tainted, cols.vol_heavy
+            n_fast = sum(1 for n in names
+                         if (i := cidx.get(n)) is not None
+                         and not tnt[i] and not vh[i])
+            if n_fast * 2 < len(names):
+                # Mostly off-columns nodes (tainted / volume-carrying):
+                # the canonical-shape memo can't collapse this scan, and
+                # the serial dispatch below would forfeit the 16-way
+                # pool for nothing — run the scalar parallel path.
+                fast = None
+                sim_memo = None
 
         def eval_node(node_name: str) -> tuple | None:
             snap = self.cache.snapshot_node(node_name)
@@ -859,7 +1025,8 @@ class GenericScheduler:
                 return None
             found = self._victims_on_node(kube_pod, snap, prio, meta,
                                           pdb_state, pods_by_name,
-                                          pod_info_get, vol, device_class)
+                                          pod_info_get, vol, device_class,
+                                          fast, sim_memo, info_of)
             if found is None:
                 return None
             victims, violations = found
@@ -872,8 +1039,15 @@ class GenericScheduler:
         # Victim search parallelized over nodes with the fit pool — each
         # worker simulates on its own snapshot (the reference runs this
         # 16-way too). min() over keys keeps selection deterministic.
-        results = [r for r in self._parallel_map(eval_node, names)
-                   if r is not None]
+        # With the vectorized fast fit active the scan runs serially: its
+        # canonical-shape verdict memo is scheduling-thread-owned, and on
+        # a uniform fleet the memo collapses the whole scan to a handful
+        # of allocator searches — cheaper than any pool dispatch.
+        if fast is not None:
+            results = [r for r in map(eval_node, names) if r is not None]
+        else:
+            results = [r for r in self._parallel_map(eval_node, names)
+                       if r is not None]
         if not results:
             return None
         return min(results, key=lambda r: r[0])[1]
@@ -956,7 +1130,8 @@ class GenericScheduler:
     def _fits_after_evictions(self, kube_pod: dict, snap: Any,
                               meta: Any, evicted: set,
                               pod_info_get: Any = None, vol: Any = None,
-                              device_class: Any = None) -> bool:
+                              device_class: Any = None,
+                              fast: Any = None) -> bool:
         """Full predicate chain against the mutated snapshot — taints,
         selectors, volume conflicts, inter-pod terms AND device fit — the
         reference's podFitsOnNode during preemption. A node where only
@@ -968,6 +1143,13 @@ class GenericScheduler:
         repeat across nodes, so the grpalloc search runs once per unique
         (shape, demand) instead of ~2x per candidate per node — this is
         what holds preemption p50 flat at cluster scale."""
+        if fast is not None:
+            # vectorized evict-and-reprieve fit: columns for the
+            # eviction-invariant gates, the canonical-shape memo for the
+            # device search. None = this node needs the scalar chain.
+            verdict = fast.fits(snap)
+            if verdict is not None:
+                return verdict
         sim_meta = meta
         if meta is not None and evicted:
             sim_meta = interpod.InterPodMetadata(
@@ -983,7 +1165,9 @@ class GenericScheduler:
                          pdb_state: list | None = None,
                          pods_by_name: dict | None = None,
                          pod_info_get: Any = None, vol: Any = None,
-                         device_class: Any = None) -> tuple | None:
+                         device_class: Any = None,
+                         fast: Any = None, sim_memo: dict | None = None,
+                         info_of: Any = None) -> tuple | None:
         from kubegpu_tpu.cluster.apiserver import NotFound  # cycle-free import
         from kubegpu_tpu.scheduler.predicates import (pod_host_ports,
                                                       pod_volumes)
@@ -1008,6 +1192,28 @@ class GenericScheduler:
                 candidates.append(p)
         if not candidates:
             return None
+        # Reprieve processing order is pure over (candidates, pdb_state)
+        # — computed up front so the canonical-simulation memo can key
+        # and replay it before any charge is paid.
+        violating, non_violating = self._split_by_pdb_violation(
+            candidates, pdb_state or [])
+        violating_names = {p["metadata"]["name"] for p in violating}
+        by_prio = lambda p: (-_pod_priority(p), p["metadata"]["name"])  # noqa: E731
+        order = sorted(violating, key=by_prio) + \
+            sorted(non_violating, key=by_prio)
+        nominated = self._nominated_pods_on(snap.name,
+                                            exclude=preemptor_name,
+                                            min_priority=prio)
+        memo_key = None
+        if fast is not None and sim_memo is not None and \
+                info_of is not None and not nominated:
+            memo_key = fast.sim_key(snap, order, pdb_state or [], info_of)
+            if memo_key is not None and memo_key in sim_memo:
+                hit = sim_memo[memo_key]
+                if hit is None:
+                    return None
+                victim_idx, violations = hit
+                return [order[i] for i in victim_idx], violations
         evicted: set = set()
 
         def charge(pod: dict, sign: int) -> None:
@@ -1015,7 +1221,8 @@ class GenericScheduler:
             snapshot consistent — core usage, device usage, ports, labels,
             volumes — because the full predicate chain reads all of it."""
             name = pod["metadata"]["name"]
-            info = codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
+            info = info_of(pod) if info_of is not None else \
+                codec.kube_pod_to_pod_info(pod, invalidate_existing=False)
             if sign < 0:
                 self.device_scheduler.return_pod_resources(info, sim)
                 evicted.add(name)
@@ -1044,35 +1251,40 @@ class GenericScheduler:
         # preemption fit simulation too).
         for victim in candidates:
             charge(victim, -1)
-        nominated = self._nominated_pods_on(snap.name, exclude=preemptor_name,
-                                            min_priority=prio)
         if nominated:
             self._charge_nominated(nominated, snap)
         if not self._fits_after_evictions(kube_pod, snap, meta, evicted,
-                                          pod_info_get, vol, device_class):
+                                          pod_info_get, vol, device_class,
+                                          fast):
+            if memo_key is not None:
+                sim_memo[memo_key] = None
             return None
         # Phase 2: reprieve — PDB-violating candidates FIRST (so they're
         # kept whenever possible, minimizing violations), then the rest;
         # within each class in descending priority (then name for
         # determinism); keep each pod that doesn't break the fit
         # (upstream selectVictimsOnNode's two-pass reprieve).
-        violating, non_violating = self._split_by_pdb_violation(
-            candidates, pdb_state or [])
-        violating_names = {p["metadata"]["name"] for p in violating}
-        by_prio = lambda p: (-_pod_priority(p), p["metadata"]["name"])  # noqa: E731
         victims = []
-        for pod in sorted(violating, key=by_prio) + \
-                sorted(non_violating, key=by_prio):
+        for pod in order:
             charge(pod, +1)
             if self._fits_after_evictions(kube_pod, snap, meta, evicted,
-                                          pod_info_get, vol, device_class):
+                                          pod_info_get, vol, device_class,
+                                          fast):
                 continue  # reprieved
             charge(pod, -1)
             victims.append(pod)
         if not victims:
+            if memo_key is not None:
+                sim_memo[memo_key] = None
             return None
         violations = sum(1 for v in victims
                          if v["metadata"]["name"] in violating_names)
+        if memo_key is not None:
+            victim_names = {v["metadata"]["name"] for v in victims}
+            sim_memo[memo_key] = (
+                tuple(i for i, p in enumerate(order)
+                      if p["metadata"]["name"] in victim_names),
+                violations)
         return victims, violations
 
 
@@ -1220,6 +1432,7 @@ class Scheduler:
                                         algorithm=algorithm)
         self.generic.api = api
         self.generic.obs_name = self.obs_name
+        self.generic.pod_lister = self._view_list_bound
         self.volume_binder = VolumeBinder(api)
         self.generic.volume_binder = self.volume_binder
         # guarded-by: GangBuffer._lock -- monitor object, internally locked
@@ -1298,6 +1511,17 @@ class Scheduler:
     def _view_drop(self, name: str) -> None:
         with self._view_lock:
             self._pod_view.pop(name, None)
+
+    def _view_list_bound(self) -> list:
+        """Bound pods straight from the informer mirror — the victim
+        scan's pod source. One dict scan instead of an API list that
+        deep-copies every bound pod per preemption pass; the returned
+        objects are the mirror's own (read-only contract: preemption
+        reads priority/labels/annotation and deletes victims by name,
+        never mutates the dicts)."""
+        with self._view_lock:
+            return [obj for obj in self._pod_view.values()
+                    if (obj.get("spec") or {}).get("nodeName")]
 
     def _view_get(self, name: str) -> dict | None:
         with self._view_lock:
@@ -2026,9 +2250,12 @@ class Scheduler:
 
         with self._gang_lock:
             inflight_ports = set(self._gang_ports_inflight.values())
+        with self._view_lock:
+            mirror_pods = list(self._pod_view.values())
         coord = annotate_gang_processes(members, assignment, gang,
                                         api=self.api,
-                                        extra_used=inflight_ports)
+                                        extra_used=inflight_ports,
+                                        pods=mirror_pods)
         with self._gang_lock:
             self._gang_ports_inflight[gang] = coord
         # Pin every member, then validate each against its host through the
@@ -2340,9 +2567,11 @@ class Scheduler:
         from kubegpu_tpu.scheduler.gang import gang_key
 
         try:
-            # bound pods only (node-index slice): ownership of chips and
-            # evictability both require a placed pod
-            pods = list_bound_pods(self.api)
+            # bound pods only: ownership of chips and evictability both
+            # require a placed pod — served from the informer mirror
+            # (read-only; victims are deleted by name), not a deep-
+            # copying API list per defragmentation attempt
+            pods = self._view_list_bound()
         except Exception:
             return False
         pods_by_name: dict = {}

@@ -59,6 +59,15 @@ class AlgorithmConfig:
         self.priorities = priorities_list
         self.device_weight = device_weight
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        # Vector-safety marks (set by the factory builders): the masked
+        # pass in scheduler/vectorized.py hard-codes the DEFAULT
+        # predicate chain's semantics, so only an algorithm using
+        # exactly that chain (unparameterized) may vectorize its filter;
+        # priorities vectorize when every configured name has an array
+        # kernel. A policy-composed algorithm defaults to scalar — the
+        # sound choice for predicate sets the kernels don't model.
+        self.vector_predicates = False
+        self.vector_priorities = False
 
 
 # ---- fit predicate registry -------------------------------------------------
@@ -446,8 +455,9 @@ def default_algorithm(priority_weights: dict | None = None) -> AlgorithmConfig:
     if priority_weights is None:
         prios = [(name, weight, PRIORITIES[name](None))
                  for name, weight in DEFAULT_PRIORITIES]
-        return AlgorithmConfig(preds, prios,
-                               device_weight=DEFAULT_DEVICE_WEIGHT)
+        return _mark_vector_safe(
+            AlgorithmConfig(preds, prios,
+                            device_weight=DEFAULT_DEVICE_WEIGHT))
     device_weight = 0.0
     prios = []
     for key in sorted(priority_weights):
@@ -458,7 +468,21 @@ def default_algorithm(priority_weights: dict | None = None) -> AlgorithmConfig:
         name = PRIORITY_ALIASES.get(key, key)
         if weight and name in PRIORITIES:
             prios.append((name, weight, PRIORITIES[name](None)))
-    return AlgorithmConfig(preds, prios, device_weight=device_weight)
+    return _mark_vector_safe(
+        AlgorithmConfig(preds, prios, device_weight=device_weight))
+
+
+def _mark_vector_safe(algo: AlgorithmConfig) -> AlgorithmConfig:
+    """Set the vector-safety marks for an algorithm built from the
+    DEFAULT predicate chain: the masked filter models exactly that
+    chain; priorities vectorize iff every name has an array kernel."""
+    from kubegpu_tpu.scheduler.vectorized import VECTOR_SCORABLE_PRIORITIES
+
+    algo.vector_predicates = True
+    algo.vector_priorities = all(
+        name in VECTOR_SCORABLE_PRIORITIES
+        for name, _weight, _fn in algo.priorities)
+    return algo
 
 
 class PolicyError(ValueError):
@@ -474,7 +498,7 @@ def cluster_autoscaler_algorithm() -> AlgorithmConfig:
         ("MostRequestedPriority", w, PRIORITIES["MostRequestedPriority"](None))
         if name == "LeastRequestedPriority" else (name, w, fn)
         for name, w, fn in algo.priorities]
-    return algo
+    return _mark_vector_safe(algo)
 
 
 ALGORITHM_PROVIDERS = {

@@ -53,20 +53,25 @@ def gang_coordinator_port(gang: int, used: set | frozenset = frozenset()) -> int
     raise RuntimeError(f"all {GANG_PORT_SPAN} gang coordinator ports in use")
 
 
-def coordinator_ports_in_use(api, coordinator_node: str) -> set:
+def coordinator_ports_in_use(api, coordinator_node: str,
+                             pods: list | None = None) -> set:
     """Ports already promised to live gangs coordinated on ``node`` —
     read from existing pods' process-contract annotations, so the claim
     survives a scheduler restart exactly like every other decision (the
     API server is the checkpoint, SURVEY.md §6). Contracts only persist
     at commit time, so callers with gangs still in flight (the pipelined
-    binder) pass those promises in via ``extra_used`` below."""
+    binder) pass those promises in via ``extra_used`` below. ``pods``
+    short-circuits the API list — the scheduler hands its informer
+    mirror in (read-only) so a gang commit doesn't pay a deep-copying
+    cluster-wide list per plan."""
     import json
 
     used = set()
-    try:
-        pods = api.list_pods()
-    except Exception:
-        return used
+    if pods is None:
+        try:
+            pods = api.list_pods()
+        except Exception:
+            return used
     for pod in pods:
         raw = ((pod.get("metadata") or {}).get("annotations") or {}).get(
             GANG_PROCESS_ANNOTATION)
@@ -83,7 +88,7 @@ def coordinator_ports_in_use(api, coordinator_node: str) -> set:
 
 def annotate_gang_processes(members: list, assignment: dict,
                             gang: int, api=None,
-                            extra_used=()) -> tuple:
+                            extra_used=(), pods: list | None = None) -> tuple:
     """Write each member's process contract into its annotations.
 
     Rank order is the sorted member-name order (the same determinism
@@ -97,7 +102,8 @@ def annotate_gang_processes(members: list, assignment: dict,
     names = sorted(m["metadata"]["name"] for m in members)
     ranks = {name: i for i, name in enumerate(names)}
     coordinator_node = assignment[names[0]][0]
-    used = coordinator_ports_in_use(api, coordinator_node) if api else set()
+    used = coordinator_ports_in_use(api, coordinator_node, pods) \
+        if api or pods is not None else set()
     used |= {p for node, p in extra_used if node == coordinator_node}
     port = gang_coordinator_port(gang, used)
     for member in members:
@@ -172,6 +178,15 @@ class GangPlanner:
 
     def __init__(self, cache):
         self.cache = cache
+        # node -> (fit generation, [ChipEntry]) — the parsed per-node chip
+        # rows, reused while the node's generation stands. A gang plan
+        # previously re-snapshotted and re-regex-parsed the WHOLE fleet's
+        # chip paths per call; now only nodes that changed since the last
+        # plan pay the parse. Scheduling-thread-owned (the planner runs
+        # inside the gang handler, never concurrently).
+        # racer: single-writer -- the gang handler runs on the
+        # scheduling thread; no other code touches the planner
+        self._chip_rows: dict = {}
 
     # -- cluster-wide free map ----------------------------------------------
 
@@ -195,12 +210,20 @@ class GangPlanner:
                 hbm_floors.add(int(c.requests.get(grammar.RESOURCE_HBM_PER_CHIP, 0)))
         if not sizes or any(n <= 0 for n in sizes.values()):
             return None
-        node_infos = {}
-        for node_name in self.cache.node_names():
-            snap = self.cache.snapshot_node(node_name)
-            if snap is not None:
-                node_infos[node_name] = snap.node_ex
-        all_chips = collect_chips(node_infos)
+        # Generation-cached chip rows off the SHARED cycle snapshots
+        # (read-only by contract; ChipEntry is immutable after build).
+        names, snaps, gens = self.cache.cycle_snapshot()
+        all_chips: list = []
+        for node_name in names:
+            entry = self._chip_rows.get(node_name)
+            if entry is None or entry[0] != gens[node_name]:
+                entry = (gens[node_name], collect_chips(
+                    {node_name: snaps[node_name].node_ex}))
+                self._chip_rows[node_name] = entry
+            all_chips.extend(entry[1])
+        if len(self._chip_rows) > len(names):
+            for gone in set(self._chip_rows) - set(names):
+                del self._chip_rows[gone]
         if not all_chips:
             return None
         mesh, origin = mesh_from_chips(all_chips)

@@ -191,7 +191,9 @@ def test_simulate_cli_runs():
     assert placed["plain-2chip"] != "<pending>"
     assert placed["contig-4chip"] != "<pending>"
     # the fit-memo summary rides along: a dead cache would read 0 hits
-    assert set(doc["fit_cache"]) == {"hits", "misses", "invalidations"}
+    assert set(doc["fit_cache"]) == {
+        "hits", "misses", "invalidations", "vector_passes",
+        "vector_pass_p50_ms", "scalar_fallback", "verdict_timeouts"}
 
 
 def test_prometheus_text_renders():

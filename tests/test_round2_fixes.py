@@ -283,10 +283,14 @@ def test_equivalence_store_rejects_pre_invalidation_generation():
     assert cache.equivalence.lookup("n1", "cls", gen) == (True, [], 1.0)
 
 
-def test_device_verdict_pinned_variant_keys_are_distinct():
+def test_device_verdict_pinned_variant_keys_are_distinct(monkeypatch):
     """A pod annotated for node A evaluates the PINNED PodInfo variant on
     A and the invalidated variant elsewhere — the cached verdicts must
-    never be shared across that boundary (shape-equal nodes)."""
+    never be shared across that boundary (shape-equal nodes). This pins
+    the SCALAR device-verdict cache's keying (the vectorized pass has
+    its own never-memoize-the-pinned-variant rule, pinned by
+    tests/test_vectorized.py), so the masked path is forced off."""
+    monkeypatch.setenv("KGTPU_VECTORIZE", "0")
     api = InMemoryAPIServer()
     api.create_node(tpu_node("a", chips=2))
     api.create_node(tpu_node("b", chips=2))  # shape-equal

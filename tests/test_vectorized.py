@@ -1,0 +1,529 @@
+"""Vectorized scheduling core: differential proof against the scalar path.
+
+Two halves:
+
+1. Scheduler differential — randomized inventories (mixed node health,
+   degraded chips, taints, unschedulable nodes, volumes, gangs,
+   priorities, churn, preemption) driven through BOTH the masked
+   array pass and the scalar per-node chain, asserting identical
+   feasible sets, failure reasons, scores, chosen hosts, and chip
+   allocations. The scalar path is the oracle; the vectorized path is
+   bit-identical by construction or these tests fail.
+
+2. Mesh bitmask convolution — the shift-and-AND placement tables in
+   `topology/mesh.py` against the preserved pure-Python reference
+   search, block-for-block and rank-for-rank, on wrap and no-wrap
+   meshes.
+"""
+
+import random
+import threading
+
+import pytest
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import DEVICE_GROUP_PREFIX, ContainerInfo, PodInfo
+from kubegpu_tpu.scheduler import vectorized
+from kubegpu_tpu.scheduler.gang import RESOURCE_GANG, RESOURCE_GANG_SIZE
+from kubegpu_tpu.topology import mesh as mesh_mod
+from kubegpu_tpu.topology.mesh import ICIMesh
+
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+
+G = DEVICE_GROUP_PREFIX
+
+pytestmark = pytest.mark.skipif(not vectorized.available(),
+                                reason="numpy unavailable")
+
+
+# ---- fixtures ---------------------------------------------------------------
+
+
+def mesh_tpu_node(name, origin, dims=(2, 2, 1), cpu="8", degraded=(),
+                  taints=None, unschedulable=False, conditions=None):
+    """A host owning a ``dims`` block of mesh chips at ``origin``
+    (coordinate chip ids, like the advertiser emits). ``degraded``
+    chip indexes are dropped from allocatable (capacity keeps them) —
+    the PR 1 chip-health contract."""
+    from kubegpu_tpu.core.types import NodeInfo
+
+    info = NodeInfo(name=name)
+    coords = [(origin[0] + dx, origin[1] + dy, origin[2] + dz)
+              for dx in range(dims[0]) for dy in range(dims[1])
+              for dz in range(dims[2])]
+    info.allocatable[grammar.RESOURCE_NUM_CHIPS] = len(coords)
+    for i, c in enumerate(coords):
+        cid = grammar.chip_id_from_coords(c)
+        info.capacity[f"{G}/tpu/{cid}/chips"] = 1
+        info.capacity[f"{G}/tpu/{cid}/hbm"] = 1000
+        if i in degraded:
+            continue
+        info.allocatable[f"{G}/tpu/{cid}/chips"] = 1
+        info.allocatable[f"{G}/tpu/{cid}/hbm"] = 1000
+    meta = {"name": name}
+    codec.node_info_to_annotation(meta, info)
+    node = {"metadata": meta,
+            "status": {"allocatable": {"cpu": cpu, "pods": 100}}}
+    spec = {}
+    if taints:
+        spec["taints"] = taints
+    if unschedulable:
+        spec["unschedulable"] = True
+    if spec:
+        node["spec"] = spec
+    if conditions:
+        node["status"]["conditions"] = conditions
+    return node
+
+
+def volume_pod(name, numchips, claim):
+    pod = tpu_pod(name, numchips)
+    pod["spec"]["volumes"] = [
+        {"name": "data", "persistentVolumeClaim": {"claimName": claim}}]
+    return pod
+
+
+def gang_pods(prefix, gang_id, size, chips_each):
+    out = []
+    for j in range(size):
+        pi = PodInfo(name=f"{prefix}-{j}",
+                     requests={RESOURCE_GANG: gang_id,
+                               RESOURCE_GANG_SIZE: size})
+        pi.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: chips_each})
+        meta = {"name": f"{prefix}-{j}"}
+        codec.pod_info_to_annotation(meta, pi)
+        out.append({"metadata": meta,
+                    "spec": {"containers": [
+                        {"name": "main",
+                         "resources": {"requests": {"cpu": "1"}}}]}})
+    return out
+
+
+def build_cluster(rng):
+    """A randomized mixed fleet: mesh hosts at varying origins, some
+    degraded chips, one tainted host, one unschedulable, one NotReady,
+    one memory-pressured, plus pre-provisioned PVs/PVCs."""
+    api = InMemoryAPIServer()
+    n = 8
+    for i in range(n):
+        origin = (2 * (i % 4), 2 * (i // 4), 0)
+        degraded = (rng.randrange(4),) if rng.random() < 0.25 else ()
+        kwargs = {}
+        if i == 5:
+            kwargs["taints"] = [{"key": "k", "value": "v",
+                                 "effect": "NoSchedule"}]
+        if i == 6:
+            kwargs["unschedulable"] = True
+        if i == 7:
+            kwargs["conditions"] = [{"type": "MemoryPressure",
+                                     "status": "True"}]
+        api.create_node(mesh_tpu_node(f"host{i}", origin,
+                                      degraded=degraded, **kwargs))
+    for i in range(3):
+        api.create_pv({"metadata": {"name": f"pv{i}"},
+                       "spec": {"capacity": {"storage": "10Gi"},
+                                "storageClassName": ""}})
+        api.create_pvc({"metadata": {"name": f"pvc{i}"},
+                        "spec": {"resources":
+                                 {"requests": {"storage": "10Gi"}},
+                                 "storageClassName": ""}})
+    return api
+
+
+def drive_stream(api, sched, rng):
+    """A randomized pod stream with churn, volumes, a gang, priorities
+    and one forced preemption. Returns the placement record: pod ->
+    (node, sorted chip paths)."""
+    placements = {}
+
+    def record(name):
+        pod = api.get_pod(name)
+        node = (pod.get("spec") or {}).get("nodeName")
+        chips = []
+        pi = codec.annotation_to_pod_info(pod.get("metadata") or {})
+        for cont in pi.running_containers.values():
+            chips.extend(sorted(cont.allocate_from.values()))
+        placements[name] = (node, tuple(chips))
+
+    created = []
+    for i in range(14):
+        chips = rng.choice([1, 1, 2, 2, 4])
+        if i % 5 == 3:
+            pod = volume_pod(f"v{i}", 1, f"pvc{i % 3}")
+        else:
+            pod = tpu_pod(f"p{i}", chips, priority=rng.choice([0, 0, 10]))
+        api.create_pod(pod)
+        created.append(pod["metadata"]["name"])
+        sched.run_until_idle()
+        if i % 6 == 5 and created:
+            # churn: delete a random placed pod
+            victim = created.pop(rng.randrange(len(created)))
+            try:
+                api.delete_pod(victim)
+            except KeyError:
+                pass
+            sched.run_until_idle()
+            placements[f"deleted-{victim}"] = True
+    for pod in gang_pods("g", 901, 2, 2):
+        api.create_pod(pod)
+    sched.run_until_idle()
+    for j in range(2):
+        record(f"g-{j}")
+    # force a preemption: fill what's left, then a high-priority pod
+    filler = 0
+    while True:
+        pod = tpu_pod(f"fill{filler}", 1)
+        api.create_pod(pod)
+        sched.run_until_idle()
+        if not (api.get_pod(f"fill{filler}").get("spec") or {}) \
+                .get("nodeName"):
+            break
+        filler += 1
+        if filler > 40:
+            break
+    hi = tpu_pod("preemptor", 2, priority=100)
+    api.create_pod(hi)
+    sched.run_until_idle()
+    record("preemptor")
+    from kubegpu_tpu.cluster.apiserver import NotFound
+
+    for name in created:
+        try:
+            record(name)
+        except NotFound:
+            placements[name] = "preempted"  # chosen victims must match too
+    return placements
+
+
+def run_differential(seed, monkeypatch_env, vectorize):
+    monkeypatch_env.setenv("KGTPU_VECTORIZE", "1" if vectorize else "0")
+    rng = random.Random(seed)
+    api = build_cluster(rng)
+    sched = make_scheduler(api)
+    assert (sched.generic.vector is not None) == vectorize
+    try:
+        return drive_stream(api, sched, rng)
+    finally:
+        sched.stop()
+
+
+# ---- differential property tests -------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_stream_placements_identical(seed, monkeypatch):
+    vec = run_differential(seed, monkeypatch, vectorize=True)
+    scalar = run_differential(seed, monkeypatch, vectorize=False)
+    assert vec == scalar
+
+
+def _engines_over(api, monkeypatch):
+    """Two engines over the SAME cluster state: one vectorized, one
+    scalar — for verdict-for-verdict filter/score comparison."""
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    vec_sched = make_scheduler(api)
+    monkeypatch.setenv("KGTPU_VECTORIZE", "0")
+    scalar_sched = make_scheduler(api)
+    assert vec_sched.generic.vector is not None
+    assert scalar_sched.generic.vector is None
+    return vec_sched, scalar_sched
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_filter_verdicts_and_scores_identical(seed, monkeypatch):
+    rng = random.Random(seed)
+    api = build_cluster(rng)
+    vec_sched, scalar_sched = _engines_over(api, monkeypatch)
+    try:
+        # place a few pods so usage columns are non-trivial (both caches
+        # observe the same binds through their informers)
+        for i in range(4):
+            api.create_pod(tpu_pod(f"seed{i}", rng.choice([1, 2])))
+            vec_sched.run_until_idle()
+        probes = [tpu_pod("probe-small", 1), tpu_pod("probe-big", 4),
+                  tpu_pod("probe-huge", 16),
+                  volume_pod("probe-vol", 1, "pvc0")]
+        for probe in probes:
+            name = probe["metadata"]["name"]
+            vf, vfail, vsnaps, vmeta = \
+                vec_sched.generic.find_nodes_that_fit(probe)
+            sf, sfail, ssnaps, smeta = \
+                scalar_sched.generic.find_nodes_that_fit(probe)
+            assert vf == sf, name          # feasible set + device scores
+            assert vfail == sfail, name    # failure reasons, verbatim
+            if vf:
+                vscores = vec_sched.generic.prioritize_nodes(
+                    probe, vf, vsnaps, vmeta)
+                sscores = scalar_sched.generic.prioritize_nodes(
+                    probe, sf, ssnaps, smeta)
+                assert vscores == sscores, name
+    finally:
+        vec_sched.stop()
+        scalar_sched.stop()
+
+
+def test_preemption_choice_identical(monkeypatch):
+    rng = random.Random(7)
+    api = build_cluster(rng)
+    vec_sched, scalar_sched = _engines_over(api, monkeypatch)
+    try:
+        i = 0
+        while True:
+            api.create_pod(tpu_pod(f"low{i}", 1, priority=0))
+            vec_sched.run_until_idle()
+            if not (api.get_pod(f"low{i}").get("spec") or {}) \
+                    .get("nodeName"):
+                api.delete_pod(f"low{i}")
+                vec_sched.run_until_idle()
+                break
+            i += 1
+            assert i < 64
+        hi = tpu_pod("preemptor", 2, priority=100)
+        got_vec = vec_sched.generic.preempt(hi)
+        got_scalar = scalar_sched.generic.preempt(hi)
+        assert (got_vec is None) == (got_scalar is None)
+        if got_vec is not None:
+            vnode, vvictims = got_vec
+            snode, svictims = got_scalar
+            assert vnode == snode
+            assert [v["metadata"]["name"] for v in vvictims] == \
+                [v["metadata"]["name"] for v in svictims]
+    finally:
+        vec_sched.stop()
+        scalar_sched.stop()
+
+
+def test_vector_pass_runs_and_memoizes(monkeypatch):
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    for i in range(4):
+        api.create_node(flat_tpu_node(f"host{i}", chips=4))
+    sched = make_scheduler(api)
+    try:
+        api.create_pod(tpu_pod("a", 1))
+        sched.run_until_idle()
+        passes_after_first = metrics.FIT_VECTOR_PASS_MS.n
+        assert passes_after_first >= 1
+        assert metrics.FIT_VECTOR_NODES_PER_PASS.total >= 4
+        assert metrics.FIT_SCALAR_FALLBACK.value == 0
+        hits0 = metrics.FIT_CACHE_HITS.value
+        api.create_pod(tpu_pod("b", 1))
+        sched.run_until_idle()
+        # warm pass: the 3 untouched nodes served from the mask memo,
+        # folded into the fit-memo effectiveness counters
+        assert metrics.FIT_CACHE_HITS.value >= hits0 + 3
+    finally:
+        sched.stop()
+
+
+def test_pinned_variant_never_enters_shape_memo(monkeypatch):
+    """The vectorized twin of the scalar pinned-variant keying test: a
+    pod annotated for node A evaluates the PINNED PodInfo on A (verdict
+    computed fresh, never memoized — it is identity-specific) and the
+    broadcastable invalidated variant elsewhere."""
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("a", chips=2))
+    api.create_node(flat_tpu_node("b", chips=2))  # shape-equal
+    sched = make_scheduler(api)
+    try:
+        pi = PodInfo(name="pinned", node_name="a")
+        pi.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 1},
+            dev_requests={f"{G}/tpu/dev0/chips": 1},
+            allocate_from={f"{G}/tpu/dev0/chips": f"{G}/tpu/dev0/chips"})
+        meta = {"name": "pinned"}
+        codec.pod_info_to_annotation(meta, pi)
+        pod = {"metadata": meta,
+               "spec": {"containers": [
+                   {"name": "main",
+                    "resources": {"requests": {"cpu": "1"}}}]}}
+        feasible, _, _, _ = sched.generic.find_nodes_that_fit(pod)
+        assert set(feasible) == {"a", "b"}
+        vec = sched.generic.vector
+        assert len(vec._shape_verdicts) == 1  # ONLY the broadcast variant
+        # and the scalar device cache stayed untouched (lock off the path)
+        assert not sched.generic._device_verdicts
+    finally:
+        sched.stop()
+
+
+def test_pinned_node_simulation_never_memoized(monkeypatch):
+    """The preemption twin of the shape-memo test above: ``sim_key``
+    must exclude the preemptor's pinned node — ``fits()`` evaluates the
+    PINNED PodInfo variant there, so its evict-and-reprieve simulation
+    is identity-specific and a shape-equal node must neither replay it
+    nor hand it one to replay."""
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("a", chips=2))
+    api.create_node(flat_tpu_node("b", chips=2))  # shape-equal
+    sched = make_scheduler(api)
+    try:
+        pi = PodInfo(name="pre", node_name="a")
+        pi.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 1},
+            dev_requests={f"{G}/tpu/dev0/chips": 1},
+            allocate_from={f"{G}/tpu/dev0/chips": f"{G}/tpu/dev0/chips"})
+        meta = {"name": "pre"}
+        codec.pod_info_to_annotation(meta, pi)
+        pod = {"metadata": meta,
+               "spec": {"priority": 100,
+                        "containers": [
+                            {"name": "main",
+                             "resources": {"requests": {"cpu": "1"}}}]}}
+        gen = sched.generic
+        names, snaps, gens, cols = gen.cache.cycle_snapshot(
+            with_columns=True)
+        assert cols is not None
+        fast = vectorized.FastPreemptFit(
+            gen.vector, pod, gen._pod_info_provider(pod), cols)
+        info_of = lambda p: None  # noqa: E731 - no candidates to decode
+        assert fast.sim_key(snaps["a"], [], [], info_of) is None
+        assert fast.sim_key(snaps["b"], [], [], info_of) is not None
+    finally:
+        sched.stop()
+
+
+def test_kill_switch_disables_vectorization(monkeypatch):
+    monkeypatch.setenv("KGTPU_VECTORIZE", "0")
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    try:
+        assert sched.generic.vector is None
+        api.create_pod(tpu_pod("a", 1))
+        sched.run_until_idle()
+        assert api.get_pod("a")["spec"].get("nodeName")
+        assert metrics.FIT_VECTOR_PASS_MS.n == 0
+    finally:
+        sched.stop()
+
+
+def test_columns_track_mutations():
+    """The struct-of-arrays mirror stays consistent with the objects it
+    mirrors across charge/release/node-update, and the view is captured
+    atomically with the cycle snapshot."""
+    from tests.test_fit_memo import make_cache
+
+    cache = make_cache()
+    cache.set_node(mesh_tpu_node("n0", (0, 0, 0)))
+    cache.set_node(mesh_tpu_node("n1", (2, 0, 0)))
+    names, snaps, gens, cols = cache.cycle_snapshot(with_columns=True)
+    assert cols is not None and cols.names == ["n0", "n1"]
+    i0 = cols.idx["n0"]
+    assert int(cols.free_chips[i0]) == 4
+    # same canonical shape at both origins: the device fingerprint's
+    # alloc id must match (this is what broadcast rides on)
+    assert cols.dev_fps[0][0] == cols.dev_fps[1][0]
+    pod = tpu_pod("p", 2)
+    pod["metadata"]["annotations"] = dict(pod["metadata"]["annotations"])
+    # allocate for n0 so the charge carries chips
+    info = cache.pod_info_for_node(pod, "n0")
+    cache.device_scheduler.pod_allocate(info, cache.nodes["n0"].node_ex)
+    info.node_name = "n0"
+    codec.pod_info_to_annotation(pod["metadata"], info)
+    cache.assume_pod(pod, "n0")
+    *_, cols2 = cache.cycle_snapshot(with_columns=True)
+    assert int(cols2.free_chips[cols2.idx["n0"]]) == 2
+    assert int(cols2.free_chips[cols2.idx["n1"]]) == 4
+    assert int(cols2.gen[cols2.idx["n0"]]) == cache.node_generation("n0")
+    cache.forget_pod(pod)
+    *_, cols3 = cache.cycle_snapshot(with_columns=True)
+    assert int(cols3.free_chips[cols3.idx["n0"]]) == 4
+    cache.remove_node("n1")
+    *_, cols4 = cache.cycle_snapshot(with_columns=True)
+    assert cols4.names == ["n0"]
+
+
+def test_verdict_timeout_counter_moves():
+    """A device-verdict waiter whose owner never delivered (crashed or
+    wedged) recomputes AND counts the recompute."""
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    try:
+        generic = sched.generic
+        pod = tpu_pod("p", 1)
+        pod_info_get = generic._pod_info_provider(pod)
+        device_class = generic._device_class(pod)
+        snap = sched.cache.snapshot_node("host0")
+        dev_key = (snap.node_ex.shape_key(), device_class, False)
+        ev = threading.Event()
+        ev.set()  # owner "crashed": event fired, no verdict stored
+        with generic._device_lock:
+            generic._device_inflight[dev_key] = ev
+        fits, _, _ = generic._run_predicates(
+            pod, snap, None, pod_info_get, device_class, None)
+        assert fits
+        assert metrics.FIT_VERDICT_TIMEOUTS.value == 1
+    finally:
+        sched.stop()
+
+
+# ---- mesh bitmask convolution ----------------------------------------------
+
+
+def masked_find(mesh, free, count):
+    """`find_contiguous_block`'s convolution branch, native core
+    bypassed — the masked half of the differential pair."""
+    free = set(map(tuple, free))
+    if count <= 0:
+        return []
+    if count > len(free):
+        return None
+    table = mesh_mod._mask_table(mesh, count)
+    assert table is not None
+    block = table.best_block(table.free_words(free))
+    if block is not None:
+        return block
+    for comp in mesh.free_components(free):
+        if len(comp) < count:
+            continue
+        blob = mesh_mod._greedy_blob(mesh, comp, min(comp), count)
+        if blob is not None:
+            return blob
+    return None
+
+
+@pytest.mark.parametrize("wrap", [False, True])
+def test_convolution_block_matches_reference(wrap):
+    mesh = ICIMesh((4, 4, 2), wrap=wrap)
+    rng = random.Random(3 if wrap else 4)
+    for trial in range(40):
+        k = rng.randrange(1, mesh.size() + 1)
+        free = set(rng.sample(mesh.chips, k))
+        for count in (1, 2, 3, 4, 6, 8):
+            got = masked_find(mesh, free, count)
+            want = mesh_mod._find_contiguous_block_reference(
+                mesh, free, count)
+            assert got == want, (wrap, trial, count, sorted(free))
+
+
+@pytest.mark.parametrize("wrap", [False, True])
+def test_convolution_ranking_matches_reference(wrap):
+    """`candidate_blocks` (table path) must yield the SAME blocks in the
+    SAME order as the preserved reference enumeration — the gang
+    planner's host-aligned splitting depends on the ranking."""
+    mesh = ICIMesh((4, 4, 1), wrap=wrap)
+    rng = random.Random(11 if wrap else 12)
+    for trial in range(25):
+        k = rng.randrange(2, mesh.size() + 1)
+        free = set(rng.sample(mesh.chips, k))
+        for count in (2, 4):
+            got = list(mesh_mod.candidate_blocks(mesh, free, count,
+                                                 limit=32))
+            want = list(mesh_mod._candidate_blocks_reference(
+                mesh, free, count, limit=32))
+            assert got == want, (wrap, trial, count, sorted(free))
+
+
+def test_large_mesh_skips_table():
+    big = ICIMesh((128, 128, 1), wrap=False)
+    assert mesh_mod._mask_table(big, 4) is None
