@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: check analyze lint type test rules report
+.PHONY: check analyze lint type test rules report mutate mutate-smoke
 
 check: analyze lint type test
 
@@ -24,6 +24,16 @@ analyze:
 # (the worklist the vectorized-core refactor burns down)
 report:
 	$(PY) -m kubegpu_tpu.analysis --rule hot-path --report kubegpu_tpu
+
+# the dynamic half of the dual-path drift defense: AST mutants over
+# the vector/scalar twin closure, each killed by the differential
+# suite or carrying a justified equivalent-mutant waiver (exit 1 on
+# unwaived survivors). `mutate-smoke` is CI's fast PR-time subset.
+mutate:
+	$(PY) -m kubegpu_tpu.analysis --mutate
+
+mutate-smoke:
+	$(PY) -m kubegpu_tpu.analysis --mutate --mutate-smoke
 
 rules:
 	$(PY) -m kubegpu_tpu.analysis --list-rules

@@ -41,6 +41,62 @@ def dotted_name(node: ast.AST) -> str | None:
     return None
 
 
+def bound_comments(src: "SourceFile", regex: "re.Pattern[str]") -> list:
+    """``(comment_line, def_line, match)`` for every comment matching
+    ``regex``: trailing on a ``def`` line, or on a comment line above
+    it with any run of comment/decorator/blank lines between (stacked
+    declarations — ``# hot-path: pure`` over ``# twin-of:`` over the
+    ``def`` — all keep their binding). A comment that reaches no
+    ``def`` is returned with ``def_line None`` so callers can flag the
+    orphan instead of silently dropping a decayed declaration. One
+    implementation, shared by every def-bound comment convention, so
+    the conventions cannot drift apart."""
+    lines = src.text.splitlines()
+    out: list = []
+    for i, text in enumerate(lines, start=1):
+        m = regex.search(text)
+        if m is None:
+            continue
+        if text.strip().startswith(("def ", "async def ")):
+            out.append((i, i, m))
+            continue
+        j = i + 1
+        bound = None
+        while j <= len(lines) and j <= i + 16:
+            nxt = lines[j - 1].strip()
+            if nxt.startswith(("def ", "async def ")):
+                bound = j
+                break
+            if nxt.startswith("#") or nxt.startswith("@") or not nxt:
+                j += 1
+                continue
+            break
+        out.append((i, bound, m))
+    return out
+
+
+def walk_functions(tree: ast.AST) -> list:
+    """``(qualname, node)`` for every function/method in a module, with
+    ``Class.method`` qualnames one level deep (the repo convention).
+    Shared by the twin rules and the mutation engine — both key off
+    these qualnames, so there is exactly one implementation."""
+    out: list = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out.append((qual, child))
+                visit(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
 class Suppression:
     """One ``# analysis: disable[-file]=...`` comment, with usage
     tracking: the engine marks which rules it actually silenced so the

@@ -1,0 +1,1879 @@
+"""AST mutation engine: prove the differential oracles' kill power.
+
+The twin rules (``rules/twins.py``) check that the vector/scalar dual
+implementations stay *declared and exercised*; this module checks that
+the differential oracles would actually *catch* a divergence. It
+applies small, deliberately bug-shaped AST mutations to the targeted
+closure — ``scheduler/vectorized.py``, the ``topology/mesh.py``
+convolution tables, ``scheduler/cache.py`` column maintenance, and
+``scheduler/equivalence.py`` store/lookup — re-executes each mutated
+module **in process** (rebinding cross-module ``from X import Y``
+references), and runs the differential kill suite until a check fails.
+A mutant every check passes is a *survivor*: either a missing
+differential assertion (add it) or a real bug (fix it); a mutant whose
+behavior is provably unobservable carries a justified entry in
+:data:`WAIVERS`.
+
+Operators (tuned to this codebase's bug shapes):
+
+============  ==============================================================
+``cmp``       comparison flips: ``<`` <-> ``<=``, ``>`` <-> ``>=``,
+              ``==`` <-> ``!=``, ``in`` <-> ``not in``
+``boundary``  off-by-one on small integer constants in arithmetic,
+              comparisons, shifts, slices and ``range()`` bounds (the
+              box-bounds / word-shift bug class)
+``maskop``    ``&`` <-> ``|`` on masks (BinOp, AugAssign, and
+              ``np.bitwise_and`` <-> ``np.bitwise_or``)
+``minmax``    swapped extremum: ``min``/``max``, ``argmin``/``argmax``,
+              ``maximum``/``minimum``, ``any``/``all`` (the popcount
+              tie-break bug class)
+``dropcall``  a deleted maintenance statement: generation bumps, column
+              updates, memo stores/records, charge-set bookkeeping
+============  ==============================================================
+
+Mutant IDs are content-addressed — ``<module>.<function>:<op>:<hash>``
+over the (operator, original snippet, mutated snippet, ordinal) — so
+they survive unrelated line shifts and CI can pin a fast PR-time
+subset (:data:`PINNED_SMOKE`). ``python -m kubegpu_tpu.analysis
+--mutate [--budget-s N]`` runs the sweep; ``--list-mutants``
+enumerates without executing anything.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import itertools
+import os
+import random
+import signal
+import sys
+import threading
+import time
+import types
+from typing import Any, Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+# Unmutated infrastructure may be imported by name; anything inside the
+# mutation targets must be reached through its module object so a
+# re-exec'd (mutated or restored) definition is always the one used.
+from kubegpu_tpu.analysis.engine import walk_functions
+from kubegpu_tpu.core import codec, grammar
+from kubegpu_tpu.core.types import (DEVICE_GROUP_PREFIX, ContainerInfo,
+                                    NodeInfo, PodInfo)
+
+MUTANT_TIMEOUT_S = 120.0
+
+#: module name -> qualname prefixes whose functions are mutated. A bare
+#: class name covers every method; the lists deliberately exclude the
+#: scalar oracles (mutating shared code would blind the differential).
+TARGETS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("kubegpu_tpu.scheduler.vectorized", (
+        "VectorizedFitPass.run_filter",
+        "VectorizedFitPass._compute_rows",
+        "VectorizedFitPass._shape_verdict",
+        "VectorizedFitPass._store_mask",
+        "_fractions",
+        "_kernel_least_requested",
+        "_kernel_most_requested",
+        "_kernel_balanced",
+        "FastPreemptFit.fits",
+        "FastPreemptFit.sim_key",
+        "FastPreemptFit.might_fit_after_full_eviction",
+        "_chips_demand",
+        "broadcast_class",
+    )),
+    ("kubegpu_tpu.topology.mesh", (
+        "_MaskTable",
+        "_ShapePlacements",
+        "_mask_table",
+    )),
+    ("kubegpu_tpu.scheduler.cache", (
+        "_FleetColumns",
+        "_canonical_paths",
+        "SchedulerCache._invalidate_locked",
+        "SchedulerCache._invalidate_all_locked",
+        "SchedulerCache.set_node",
+        "SchedulerCache._charge_locked",
+        "SchedulerCache.remove_node",
+        "SchedulerCache.cycle_snapshot",
+    )),
+    ("kubegpu_tpu.scheduler.equivalence", (
+        "EquivalenceCache",
+    )),
+)
+
+#: Equivalent mutants: behavior provably unobservable through any
+#: differential oracle, each with its justification (rendered in the
+#: report; audited by tests/test_analysis.py against this dict).
+WAIVERS: Dict[str, str] = {
+    "vectorized.run_filter:cmp:34408c08":
+        "memo['n'] == n is defense-in-depth: epoch equality already "
+        "implies identical membership (every rebuild bumps the epoch), "
+        "so the n compare can never be the deciding guard",
+    "vectorized.run_filter:maskop:6a3d05fb":
+        "elig|valid only widens reuse onto nominated rows, whose "
+        "verdicts the scalar fallback recomputes and overwrites in "
+        "find_nodes_that_fit; observable only as one extra counted hit",
+    "vectorized._store_mask:boundary:c0bc97c4":
+        "the gens-array init sentinel is shadowed by the valid mask: "
+        "rows are only reused after a write sets both, so -1 vs -2 "
+        "never reaches a comparison",
+    "vectorized._store_mask:boundary:5c2d189c":
+        "same valid-mask shadowing as the -2 variant; live node "
+        "generations start at 1 (first registration bumps), so even a "
+        "0 sentinel cannot collide",
+    "vectorized.might_fit_after_full_eviction:cmp:a351a73a":
+        "the <=0 early return is an optimization: for zero demand the "
+        "general free+evictable >= 0 formula is True anyway",
+    "vectorized.might_fit_after_full_eviction:boundary:af2235de":
+        "same zero-demand shortcut: demand can never be negative, and "
+        "the general formula already answers True for demand 0",
+    "mesh.__init__:boundary:905e0b4f":
+        "(nbits+63)//63 only over-allocates words; the extra words are "
+        "all-zero and every row/free mask is sized by the same "
+        "self.words, so feasibility and popcounts are unchanged",
+    "mesh.__init__:boundary:5b7a224d":
+        "(nbits+64)//64 only over-allocates (one extra zero word for "
+        "exact multiples of 64); same consistent-sizing argument",
+    "mesh.__init__:minmax:3d4179e1":
+        "the shape-exceeds-dims skip is a precomputation shortcut: an "
+        "oversized shape has no valid placement (_block_coords returns "
+        "None or wraps onto itself at every origin), so including it "
+        "yields an empty placement set and is dropped anyway",
+    "cache._invalidate_locked:boundary:5da31794":
+        "generation arithmetic only needs strict monotonicity; every "
+        "consumer compares for equality or order, so +2 per bump is "
+        "indistinguishable from +1",
+    "cache._invalidate_all_locked:boundary:7a45e8f2":
+        "same monotonicity argument as the per-node bump",
+    "cache.remove_node:dropcall:d67a34a0":
+        "re-registration always bumps through the first-registration "
+        "path (old_labels is None => _invalidate_locked), so a pass "
+        "holding the pre-delete generation can never be served a "
+        "post-re-add store; the remove-time bump is belt-and-braces",
+    "cache.remove_node:dropcall:4ca211ba":
+        "equivalence.drop_node is memory hygiene by contract: "
+        "staleness is carried entirely by the generation mismatch "
+        "(generations outlive the node), so retained entries can "
+        "never be served",
+    "cache._charge_locked:dropcall:8fbfcccf":
+        "the node-vanished release unmark is unreachable belt-and-"
+        "braces: remove_node already un-marks every pod of a departing "
+        "node, so a release for a vanished node never finds the pod "
+        "still marked",
+    "cache.cycle_snapshot:cmp:d7f8b98b":
+        "the snapshot generation compare is defense-in-depth: every "
+        "bump path pops or clears the _snap entry under the same lock, "
+        "so a cached snapshot with a stale generation cannot exist",
+    "equivalence.lookup_many:cmp:f0936fe9":
+        "the guard only avoids a zero-increment metrics call; "
+        "inc(0) is a no-op, so >= changes nothing observable",
+}
+
+#: Fast PR-time subset (CI's mutation smoke): one representative per
+#: module x operator family, all killed by the cheap early checks
+#: (~2 s total). Re-pin with --list-mutants after editing a target.
+PINNED_SMOKE: List[str] = [
+    "mesh._placements:maskop:49134da8",          # mask build & <-> |
+    "mesh.best_block:minmax:e9dbe866",           # feasibility all <-> any
+    "mesh.__init__:boundary:e9d6f1fb",           # word-count off-by-one
+    "cache._canonical_paths:cmp:a0207ff8",       # canonicalization drift
+    "cache.set_node:dropcall:f3a8c4fe",          # dropped column update
+    "equivalence.lookup:cmp:a798df36",           # generation serving flip
+    "vectorized._shape_verdict:cmp:cfda14ce",    # memo bound flip
+    "vectorized._kernel_balanced:maskop:6d9eed74",  # score kernel drift
+]
+
+
+class MutationError(RuntimeError):
+    """The engine itself failed (source drift between enumerate and
+    apply, unknown mutant id, missing numpy)."""
+
+
+# ---- target discovery -------------------------------------------------------
+
+
+_functions = walk_functions
+
+
+def _matches(qual: str, prefixes: Tuple[str, ...]) -> bool:
+    return any(qual == p or qual.startswith(p + ".") for p in prefixes)
+
+
+def _module_tree(module_name: str) -> Tuple[types.ModuleType, ast.Module]:
+    import importlib
+
+    module = importlib.import_module(module_name)
+    path = module.__file__
+    if path is None:
+        raise MutationError(f"{module_name} has no source file")
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    return module, tree
+
+
+# ---- operators --------------------------------------------------------------
+
+
+class _Site:
+    __slots__ = ("op", "qualname", "lineno", "before", "after", "apply")
+
+    def __init__(self, op: str, qualname: str, lineno: int, before: str,
+                 after: str, apply: Callable[[], None]) -> None:
+        self.op = op
+        self.qualname = qualname
+        self.lineno = lineno
+        self.before = before
+        self.after = after
+        self.apply = apply
+
+
+def _own_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk order, but nested function/class definitions belong to
+    their own target entry (avoid double-mutating), and annotation
+    subtrees are skipped — under ``from __future__ import annotations``
+    they are never evaluated, so mutating them yields junk equivalent
+    mutants (``dict | None`` is not a runtime ``|``)."""
+    work: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    skip: Set[int] = set()
+    for node in ast.walk(fn):
+        ann = getattr(node, "annotation", None)
+        if ann is not None:
+            skip.update(id(sub) for sub in ast.walk(ann))
+        ret = getattr(node, "returns", None)
+        if ret is not None:
+            skip.update(id(sub) for sub in ast.walk(ret))
+    while work:
+        node = work.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) or id(node) in skip:
+            continue
+        yield node
+        work.extend(ast.iter_child_nodes(node))
+
+
+def _parents(fn: ast.AST) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+_CMP_SWAP: Dict[type, type] = {
+    ast.Lt: ast.LtE, ast.LtE: ast.Lt,
+    ast.Gt: ast.GtE, ast.GtE: ast.Gt,
+    ast.Eq: ast.NotEq, ast.NotEq: ast.Eq,
+    ast.In: ast.NotIn, ast.NotIn: ast.In,
+}
+
+_BIT_SWAP: Dict[type, type] = {ast.BitAnd: ast.BitOr, ast.BitOr: ast.BitAnd}
+
+_NAME_SWAP: Dict[str, str] = {
+    "min": "max", "max": "min",
+    "argmin": "argmax", "argmax": "argmin",
+    "maximum": "minimum", "minimum": "maximum",
+    "any": "all", "all": "any",
+    "bitwise_and": "bitwise_or", "bitwise_or": "bitwise_and",
+}
+
+_DROP_CALLS = frozenset({
+    "set_gen", "bump_all_gens", "charge", "set_node", "drop", "_write_row",
+    "_invalidate_locked", "_invalidate_all_locked", "drop_node",
+    "store", "store_many", "record", "add", "discard", "_rebuild",
+})
+
+_MAX_BOUNDARY_CONST = 64
+
+
+def _terminal(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _clip(text: str, limit: int = 90) -> str:
+    text = " ".join(text.split())
+    return text if len(text) <= limit else text[:limit - 3] + "..."
+
+
+def _sites_cmp(qual: str, fn: ast.AST) -> Iterator[_Site]:
+    for node in _own_walk(fn):
+        if not isinstance(node, ast.Compare):
+            continue
+        for i, op in enumerate(node.ops):
+            new_cls = _CMP_SWAP.get(type(op))
+            if new_cls is None:
+                continue
+
+            def apply(node: ast.Compare = node, i: int = i,
+                      new_cls: type = new_cls) -> None:
+                node.ops[i] = new_cls()
+
+            yield _Site("cmp", qual, node.lineno, _clip(ast.unparse(node)),
+                        f"{type(op).__name__}->{new_cls.__name__}", apply)
+
+
+def _sites_boundary(qual: str, fn: ast.AST) -> Iterator[_Site]:
+    parents = _parents(fn)
+    for node in _own_walk(fn):
+        if not (isinstance(node, ast.Constant)
+                and type(node.value) is int
+                and abs(node.value) <= _MAX_BOUNDARY_CONST):
+            continue
+        parent = parents.get(id(node))
+        numeric = isinstance(parent, (ast.BinOp, ast.Compare, ast.Slice,
+                                      ast.UnaryOp)) or (
+            isinstance(parent, ast.Call)
+            and _terminal(parent.func) in ("range", "islice"))
+        if not numeric:
+            continue
+        ctx = _clip(ast.unparse(parent if parent is not None else node))
+        for delta in (1, -1):
+            def apply(node: ast.Constant = node,
+                      delta: int = delta) -> None:
+                node.value = node.value + delta
+
+            yield _Site("boundary", qual, node.lineno, ctx,
+                        f"{node.value}->{node.value + delta}", apply)
+
+
+def _sites_maskop(qual: str, fn: ast.AST) -> Iterator[_Site]:
+    for node in _own_walk(fn):
+        if isinstance(node, (ast.BinOp, ast.AugAssign)):
+            new_cls = _BIT_SWAP.get(type(node.op))
+            if new_cls is not None:
+                def apply(node: Any = node, new_cls: type = new_cls) -> None:
+                    node.op = new_cls()
+
+                yield _Site("maskop", qual, node.lineno,
+                            _clip(ast.unparse(node)),
+                            f"{type(node.op).__name__}->{new_cls.__name__}",
+                            apply)
+        elif isinstance(node, ast.Call):
+            name = _terminal(node.func)
+            if name in ("bitwise_and", "bitwise_or"):
+                yield from _swap_call_name(qual, node, "maskop")
+
+
+def _sites_minmax(qual: str, fn: ast.AST) -> Iterator[_Site]:
+    for node in _own_walk(fn):
+        if isinstance(node, ast.Call):
+            name = _terminal(node.func)
+            if name in _NAME_SWAP and name not in ("bitwise_and",
+                                                   "bitwise_or"):
+                yield from _swap_call_name(qual, node, "minmax")
+
+
+def _swap_call_name(qual: str, node: ast.Call, op: str) -> Iterator[_Site]:
+    name = _terminal(node.func)
+    if name is None:
+        return
+    new = _NAME_SWAP[name]
+
+    def apply(node: ast.Call = node, new: str = new) -> None:
+        if isinstance(node.func, ast.Name):
+            node.func.id = new
+        else:
+            assert isinstance(node.func, ast.Attribute)
+            node.func.attr = new
+
+    yield _Site(op, qual, node.lineno, _clip(ast.unparse(node)),
+                f"{name}->{new}", apply)
+
+
+def _sites_dropcall(qual: str, fn: ast.AST) -> Iterator[_Site]:
+    for holder in itertools.chain([fn], _own_walk(fn)):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(holder, field, None)
+            if not isinstance(stmts, list):
+                continue
+            for i, stmt in enumerate(stmts):
+                if not (isinstance(stmt, ast.Expr)
+                        and isinstance(stmt.value, ast.Call)):
+                    continue
+                name = _terminal(stmt.value.func)
+                if name not in _DROP_CALLS:
+                    continue
+
+                def apply(stmts: List[ast.stmt] = stmts,
+                          stmt: ast.stmt = stmt) -> None:
+                    idx = stmts.index(stmt)
+                    stmts[idx] = ast.Pass()
+
+                yield _Site("dropcall", qual, stmt.lineno,
+                            _clip(ast.unparse(stmt)), "deleted", apply)
+
+
+_OPERATORS: Tuple[Callable[[str, ast.AST], Iterator[_Site]], ...] = (
+    _sites_cmp, _sites_boundary, _sites_maskop, _sites_minmax,
+    _sites_dropcall,
+)
+
+
+# ---- enumeration ------------------------------------------------------------
+
+
+class MutantRef:
+    __slots__ = ("mutant_id", "module", "qualname", "op", "index",
+                 "lineno", "before", "after")
+
+    def __init__(self, mutant_id: str, module: str, qualname: str, op: str,
+                 index: int, lineno: int, before: str, after: str) -> None:
+        self.mutant_id = mutant_id
+        self.module = module
+        self.qualname = qualname
+        self.op = op
+        self.index = index
+        self.lineno = lineno
+        self.before = before
+        self.after = after
+
+    def describe(self) -> Dict[str, Any]:
+        return {"id": self.mutant_id, "module": self.module,
+                "function": self.qualname, "op": self.op,
+                "line": self.lineno, "before": self.before,
+                "after": self.after}
+
+
+def _enumerate_sites(module_name: str,
+                     tree: ast.Module) -> List[_Site]:
+    prefixes = dict(TARGETS)[module_name]
+    sites: List[_Site] = []
+    for qual, fn in _functions(tree):
+        if not _matches(qual, prefixes):
+            continue
+        for operator in _OPERATORS:
+            sites.extend(operator(qual, fn))
+    return sites
+
+
+def _refs_for(module_name: str, sites: List[_Site]) -> List[MutantRef]:
+    short = module_name.rsplit(".", 1)[-1]
+    dup: Dict[Tuple[str, str, str, str], int] = {}
+    refs: List[MutantRef] = []
+    for i, site in enumerate(sites):
+        key = (site.op, site.qualname, site.before, site.after)
+        ordinal = dup.get(key, 0)
+        dup[key] = ordinal + 1
+        blob = "|".join((site.op, site.qualname, site.before, site.after,
+                         str(ordinal)))
+        digest = hashlib.sha1(blob.encode()).hexdigest()[:8]
+        fn = site.qualname.rsplit(".", 1)[-1]
+        refs.append(MutantRef(f"{short}.{fn}:{site.op}:{digest}",
+                              module_name, site.qualname, site.op, i,
+                              site.lineno, site.before, site.after))
+    return refs
+
+
+def enumerate_mutants() -> List[MutantRef]:
+    """Every mutant over the targeted closure, deterministic order and
+    content-addressed IDs (stable under unrelated source edits)."""
+    out: List[MutantRef] = []
+    for module_name, _prefixes in TARGETS:
+        _module, tree = _module_tree(module_name)
+        out.extend(_refs_for(module_name, _enumerate_sites(module_name,
+                                                           tree)))
+    return out
+
+
+# ---- in-process application -------------------------------------------------
+
+
+class ModulePatch:
+    """One applied mutant: the target module re-executed with the
+    mutated tree, and every ``from X import Y`` alias of a replaced
+    top-level class/function rebound across the package. ``restore()``
+    reverts both."""
+
+    def __init__(self, module: types.ModuleType, tree: ast.Module) -> None:
+        self._module = module
+        self._snapshot = dict(module.__dict__)
+        self._rebinds: List[Tuple[types.ModuleType, str, Any]] = []
+        code = compile(tree, module.__file__ or "<mutant>", "exec")
+        exec(code, module.__dict__)
+        self._crossref()
+
+    def _crossref(self) -> None:
+        for name, old in self._snapshot.items():
+            new = self._module.__dict__.get(name)
+            if new is old or not isinstance(
+                    old, (type, types.FunctionType)):
+                continue
+            for mod_name, mod in list(sys.modules.items()):
+                if mod is None or mod is self._module or \
+                        not mod_name.startswith("kubegpu_tpu"):
+                    continue
+                mod_dict = getattr(mod, "__dict__", None)
+                if mod_dict is None:
+                    continue
+                for attr, val in list(mod_dict.items()):
+                    if val is old:
+                        self._rebinds.append((mod, attr, old))
+                        mod_dict[attr] = new
+
+    def restore(self) -> None:
+        self._module.__dict__.clear()
+        self._module.__dict__.update(self._snapshot)
+        for mod, attr, old in self._rebinds:
+            mod.__dict__[attr] = old
+
+
+def apply_mutant(ref: MutantRef) -> ModulePatch:
+    """Parse the target module fresh, re-derive the site list, apply
+    the referenced mutation and re-exec in process. Raises
+    :class:`MutationError` if the source drifted since enumeration."""
+    module, tree = _module_tree(ref.module)
+    sites = _enumerate_sites(ref.module, tree)
+    if ref.index >= len(sites):
+        raise MutationError(f"{ref.mutant_id}: site index out of range "
+                            f"(source changed since enumeration?)")
+    recomputed = _refs_for(ref.module, sites)[ref.index]
+    if recomputed.mutant_id != ref.mutant_id:
+        raise MutationError(f"{ref.mutant_id}: site list drifted "
+                            f"(now {recomputed.mutant_id})")
+    sites[ref.index].apply()
+    ast.fix_missing_locations(tree)
+    return ModulePatch(module, tree)
+
+
+def find_mutant(mutant_id: str,
+                refs: Optional[List[MutantRef]] = None) -> MutantRef:
+    for ref in refs if refs is not None else enumerate_mutants():
+        if ref.mutant_id == mutant_id:
+            return ref
+    raise MutationError(f"unknown mutant id {mutant_id!r}")
+
+
+# ---- the differential kill suite -------------------------------------------
+#
+# Ordered cheap-first. Each check raises on divergence (any exception =
+# killed). Checks reach mutated code only through module objects, and
+# every oracle recomputation is independent of the mutated functions.
+
+
+def _np() -> Any:
+    try:
+        import numpy
+    except ImportError as e:  # pragma: no cover - numpy ships in the image
+        raise MutationError("mutation sweep requires numpy") from e
+    return numpy
+
+
+def _mesh_mod() -> Any:
+    from kubegpu_tpu.topology import mesh
+    return mesh
+
+
+def _cache_mod() -> Any:
+    from kubegpu_tpu.scheduler import cache
+    return cache
+
+
+def _equiv_mod() -> Any:
+    from kubegpu_tpu.scheduler import equivalence
+    return equivalence
+
+
+def _vec_mod() -> Any:
+    from kubegpu_tpu.scheduler import vectorized
+    return vectorized
+
+
+def _device_scheduler() -> Any:
+    from kubegpu_tpu.scheduler.registry import DevicesScheduler
+    from kubegpu_tpu.scheduler.tpu_scheduler import TPUScheduler
+
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    return ds
+
+
+G = DEVICE_GROUP_PREFIX
+
+
+def _mesh_node(name: str, origin: Tuple[int, int, int],
+               dims: Tuple[int, int, int] = (2, 2, 1), cpu: str = "8",
+               degraded: Tuple[int, ...] = (),
+               taints: Optional[List[dict]] = None,
+               unschedulable: bool = False,
+               conditions: Optional[List[dict]] = None) -> dict:
+    info = NodeInfo(name=name)
+    coords = [(origin[0] + dx, origin[1] + dy, origin[2] + dz)
+              for dx in range(dims[0]) for dy in range(dims[1])
+              for dz in range(dims[2])]
+    info.allocatable[grammar.RESOURCE_NUM_CHIPS] = len(coords)
+    for i, c in enumerate(coords):
+        cid = grammar.chip_id_from_coords(c)
+        info.capacity[f"{G}/tpu/{cid}/chips"] = 1
+        info.capacity[f"{G}/tpu/{cid}/hbm"] = 1000
+        if i in degraded:
+            continue
+        info.allocatable[f"{G}/tpu/{cid}/chips"] = 1
+        info.allocatable[f"{G}/tpu/{cid}/hbm"] = 1000
+    meta = {"name": name}
+    codec.node_info_to_annotation(meta, info)
+    node: dict = {"metadata": meta,
+                  "status": {"allocatable": {"cpu": cpu, "pods": 100}}}
+    spec: dict = {}
+    if taints:
+        spec["taints"] = taints
+    if unschedulable:
+        spec["unschedulable"] = True
+    if spec:
+        node["spec"] = spec
+    if conditions:
+        node["status"]["conditions"] = conditions
+    return node
+
+
+def _tpu_pod(name: str, numchips: int, priority: int = 0,
+             cpu: str = "1") -> dict:
+    pi = PodInfo(name=name)
+    pi.running_containers["main"] = ContainerInfo(
+        requests={grammar.RESOURCE_NUM_CHIPS: numchips})
+    meta = {"name": name}
+    codec.pod_info_to_annotation(meta, pi)
+    return {"metadata": meta,
+            "spec": {"priority": priority,
+                     "containers": [{"name": "main",
+                                     "resources": {
+                                         "requests": {"cpu": cpu}}}]}}
+
+
+def _schedulers(api: Any) -> Tuple[Any, Any]:
+    """(vectorized, scalar) engines over one API server."""
+    from kubegpu_tpu.scheduler.core import Scheduler
+
+    saved = os.environ.get("KGTPU_VECTORIZE")
+    try:
+        os.environ["KGTPU_VECTORIZE"] = "1"
+        vec = Scheduler(api, _device_scheduler())
+        os.environ["KGTPU_VECTORIZE"] = "0"
+        scalar = Scheduler(api, _device_scheduler())
+    finally:
+        if saved is None:
+            os.environ.pop("KGTPU_VECTORIZE", None)
+        else:
+            os.environ["KGTPU_VECTORIZE"] = saved
+    if vec.generic.vector is None:
+        raise MutationError("vectorized engine unavailable (numpy?)")
+    return vec, scalar
+
+
+# -- oracle recomputations (deliberately independent of the targets) ---------
+
+_CHIP_RE: Optional[Any] = None
+
+
+def _oracle_canonical(allocatable: Dict[str, int]) -> Dict[str, str]:
+    """Reference re-implementation of cache._canonical_paths — the
+    independent oracle the mirror check compares against."""
+    import re as _re
+
+    global _CHIP_RE
+    if _CHIP_RE is None:
+        _CHIP_RE = _re.compile(
+            r"^(.*/" + grammar.TPU_LEAF + r"/)([^/]+)(/[^/]+)$")
+    parsed: Dict[str, Tuple[str, Tuple[int, int, int], str]] = {}
+    coords: List[Tuple[int, int, int]] = []
+    for res in allocatable:
+        m = _CHIP_RE.match(res)
+        if m is None:
+            continue
+        c = grammar.coords_from_chip_id(m.group(2))
+        if c is None or len(c) != 3:
+            continue
+        parsed[res] = (m.group(1), (c[0], c[1], c[2]), m.group(3))
+        coords.append((c[0], c[1], c[2]))
+    if not parsed:
+        return {}
+    org = tuple(min(c[i] for c in coords) for i in range(3))
+    out: Dict[str, str] = {}
+    for res, (head, c, tail) in parsed.items():
+        cid = grammar.chip_id_from_coords(
+            (c[0] - org[0], c[1] - org[1], c[2] - org[2]))
+        out[res] = f"{head}{cid}{tail}"
+    return out
+
+
+def _verify_columns(cache: Any, cols: Any) -> None:
+    """Every column field vs a from-scratch recomputation off the
+    CachedNode objects — the scalar oracle for the fleet mirror."""
+    np = _np()
+    assert cols is not None, "columnar view unavailable"
+    assert cols.names == sorted(cache.nodes), "view membership drift"
+    for i, name in enumerate(cols.names):
+        cached = cache.nodes[name]
+        kube = cached.kube_node
+        spec = kube.get("spec") or {}
+        conditions = (kube.get("status") or {}).get("conditions") or []
+        assert bool(cols.unschedulable[i]) == bool(
+            spec.get("unschedulable")), (name, "unschedulable")
+        notready = sum(1 for c in conditions
+                       if c.get("type") == "Ready"
+                       and c.get("status") != "True")
+        assert int(cols.n_notready[i]) == notready, (name, "n_notready")
+        assert bool(cols.mem_pressure[i]) == any(
+            c.get("type") == "MemoryPressure" and c.get("status") == "True"
+            for c in conditions), (name, "mem_pressure")
+        assert bool(cols.disk_pressure[i]) == any(
+            c.get("type") == "DiskPressure" and c.get("status") == "True"
+            for c in conditions), (name, "disk_pressure")
+        assert bool(cols.tainted[i]) == any(
+            t.get("effect") in ("NoSchedule", "NoExecute")
+            for t in spec.get("taints") or []), (name, "tainted")
+        node_ex = cached.node_ex
+        free = sum(
+            max(node_ex.allocatable.get(p, 0) - node_ex.used.get(p, 0), 0)
+            for p in node_ex.allocatable
+            if grammar.chip_id_from_path(p) is not None)
+        assert int(cols.free_chips[i]) == free, (name, "free_chips")
+        assert bool(cols.vol_heavy[i]) == bool(cached.pod_volumes), \
+            (name, "vol_heavy")
+        want_prio = min(cached.pod_priorities.values()) \
+            if cached.pod_priorities else 2 ** 62
+        assert int(cols.min_pod_priority[i]) == want_prio, \
+            (name, "min_pod_priority")
+        assert int(cols.gen[i]) == cache.node_generation(name), \
+            (name, "generation")
+        core_alloc = cached.core_allocatable()
+        for res, arr in cols.core_alloc.items():
+            want = core_alloc.get(res)
+            if want is None:
+                assert np.isnan(arr[i]), (name, res, "core_alloc nan")
+            else:
+                assert arr[i] == want, (name, res, "core_alloc")
+        for res, arr in cols.core_req.items():
+            assert arr[i] == cached.requested_core.get(res, 0), \
+                (name, res, "core_req")
+        canon = _oracle_canonical(node_ex.allocatable)
+        assert cols.canon_maps[i] == canon, (name, "canonical paths")
+        want_key = tuple(sorted(
+            (canon.get(k, k), v) for k, v in node_ex.used.items() if v))
+        assert cols.dev_fps[i][1] == want_key, (name, "used_key")
+
+
+# -- the checks ---------------------------------------------------------------
+
+
+def _check_mesh_tables() -> None:
+    """Convolution tables vs the preserved reference search, block for
+    block and rank for rank (native core bypassed). The (5, 13, 1) mesh
+    is 65 cells — TWO 64-bit words — so word-count and word-shift
+    off-by-ones are observable, not masked by a single-word fleet."""
+    mesh_mod = _mesh_mod()
+    rng = random.Random(20260804)
+    for dims, wrap, trials in (((4, 3, 2), False, 4), ((4, 4, 1), True, 4),
+                               ((5, 13, 1), False, 6)):
+        mesh = mesh_mod.ICIMesh(dims, wrap=wrap)
+        for _trial in range(trials):
+            k = rng.randrange(1, mesh.size() + 1)
+            free = set(rng.sample(mesh.chips, k))
+            for count in (1, 2, 4, 6):
+                table = mesh_mod._mask_table(mesh, count)
+                assert table is not None, "table construction failed"
+                got = table.best_block(table.free_words(free))
+                want = _reference_box_best(mesh_mod, mesh, free, count)
+                assert got == want, ("best_block", dims, wrap, count,
+                                     sorted(free))
+                got_rank = list(mesh_mod.candidate_blocks(
+                    mesh, free, count, limit=12))
+                want_rank = list(mesh_mod._candidate_blocks_reference(
+                    mesh, free, count, limit=12))
+                assert got_rank == want_rank, ("ranked", dims, wrap, count)
+    assert mesh_mod._mask_table(
+        mesh_mod.ICIMesh((128, 128, 1), wrap=False), 4) is None, \
+        "oversized mesh must skip table precomputation"
+    # MAX_TABLE_CELLS is inclusive: a mesh of exactly the cap gets a
+    # table (boundary probed by shrinking the cap onto a small mesh)
+    probe = mesh_mod.ICIMesh((4, 3, 2), wrap=False)
+    saved_cap = mesh_mod.MAX_TABLE_CELLS
+    try:
+        mesh_mod.MAX_TABLE_CELLS = probe.size()
+        mesh_mod._MASK_TABLES.clear()
+        assert mesh_mod._mask_table(probe, 2) is not None, \
+            "a mesh of exactly MAX_TABLE_CELLS cells must tabulate"
+    finally:
+        mesh_mod.MAX_TABLE_CELLS = saved_cap
+        mesh_mod._MASK_TABLES.clear()
+    # the table cache is bounded: never more than _MAX_MASK_TABLES live
+    saved_bound = mesh_mod._MAX_MASK_TABLES
+    try:
+        mesh_mod._MAX_MASK_TABLES = 2
+        mesh_mod._MASK_TABLES.clear()
+        small = mesh_mod.ICIMesh((2, 2, 1), wrap=False)
+        for count in (1, 2, 3):
+            mesh_mod._mask_table(small, count)
+        assert len(mesh_mod._MASK_TABLES) <= 2, \
+            "table cache exceeded its bound"
+    finally:
+        mesh_mod._MAX_MASK_TABLES = saved_bound
+        mesh_mod._MASK_TABLES.clear()
+
+
+def _reference_box_best(mesh_mod: Any, mesh: Any, free: set,
+                        count: int) -> Optional[list]:
+    """The reference search's box phase only (best_block's contract:
+    None when no axis-aligned box fits)."""
+    if count <= 0 or count > len(free):
+        return None
+    for shape in mesh_mod._block_shapes(count):
+        if any(s > d for s, d in zip(shape, mesh.dims)):
+            continue
+        best = None
+        for origin in sorted(free):
+            block = mesh_mod._block_coords(origin, shape, mesh)
+            if block is None or not free.issuperset(block):
+                continue
+            key = (mesh_mod._exposure(block, free, mesh), origin)
+            if best is None or key < best[0]:
+                best = (key, block)
+        if best is not None:
+            return sorted(best[1])
+    return None
+
+
+def _check_equivalence_model() -> None:
+    """EquivalenceCache vs a transparent dict model: generation
+    serving, monotonic stores, nomination fingerprints, batch forms,
+    hit/miss accounting, node drop, and the per-node bound."""
+    eq_mod = _equiv_mod()
+    eq = eq_mod.EquivalenceCache()
+    assert eq.lookup("n1", "c1", 5) is None
+    eq.store("n1", "c1", 5, ("ok", [], 1.0))
+    assert eq.lookup("n1", "c1", 5) == ("ok", [], 1.0)
+    assert eq.lookup("n1", "c1", 6) is None, "stale generation served"
+    assert (eq.hits, eq.misses) == (1, 2), "hit/miss accounting drift"
+    eq.record(3, 2)
+    assert (eq.hits, eq.misses) == (4, 4), "record() accounting drift"
+    # monotonic-store guard: a slow pass must not clobber fresher state
+    eq.store("n1", "c1", 9, ("new", [], 2.0))
+    eq.store("n1", "c1", 7, ("old", [], 0.0))
+    assert eq.lookup("n1", "c1", 9, record=False) == ("new", [], 2.0)
+    # record=False peeks must not move the counters
+    before = (eq.hits, eq.misses)
+    eq.lookup("n1", "c1", 9, record=False)
+    eq.lookup_many("c1", {"n1": 9, "n2": 1}, {}, record=False)
+    assert (eq.hits, eq.misses) == before, "record=False moved counters"
+    # nomination fingerprints partition the key space
+    eq.store("n1", "c1", 9, ("nom", [], 3.0), nom_fp=("p1",))
+    assert eq.lookup("n1", "c1", 9, nom_fp=("p1",),
+                     record=False) == ("nom", [], 3.0)
+    assert eq.lookup("n1", "c1", 9, record=False) == ("new", [], 2.0)
+    # batch store/lookup agree with the scalar forms
+    eq.store_many("c2", {"n1": ("a", [], 0.0), "n2": ("b", [], 0.0)},
+                  {"n1": 3, "n2": 4})
+    got = eq.lookup_many("c2", {"n1": 3, "n2": 9, "n3": 1}, {},
+                         record=False)
+    assert got == {"n1": ("a", [], 0.0)}, "lookup_many generation filter"
+    assert eq.lookup("n2", "c2", 4, record=False) == ("b", [], 0.0)
+    # store_many honors the monotonic guard too
+    eq.store_many("c2", {"n1": ("stale", [], 0.0)}, {"n1": 2})
+    assert eq.lookup("n1", "c2", 3, record=False) == ("a", [], 0.0)
+    eq.drop_node("n1")
+    assert eq.lookup("n1", "c2", 3, record=False) is None, \
+        "drop_node left entries behind"
+    # per-node class bound: oldest evicted, newest kept
+    bound = eq_mod.MAX_CLASSES_PER_NODE
+    for i in range(bound + 1):
+        eq.store("nb", f"cls{i}", 1, (i, [], 0.0))
+    assert eq.lookup("nb", "cls0", 1, record=False) is None, \
+        "per-node bound not enforced"
+    assert eq.lookup("nb", f"cls{bound}", 1,
+                     record=False) == (bound, [], 0.0)
+    # equal-generation stores OVERWRITE (only a strictly newer existing
+    # entry refuses): the verdict-recompute paths rely on it
+    eq.store("ng", "c", 5, ("first", [], 0.0))
+    eq.store("ng", "c", 5, ("second", [], 0.0))
+    assert eq.lookup("ng", "c", 5, record=False) == ("second", [], 0.0), \
+        "equal-generation store must overwrite"
+    eq.store_many("ng", {"nm": ("a", [], 0.0)}, {"nm": 5})
+    eq.store_many("ng", {"nm": ("b", [], 0.0)}, {"nm": 5})
+    assert eq.lookup("nm", "ng", 5, record=False) == ("b", [], 0.0), \
+        "equal-generation store_many must overwrite"
+    # ... and the bound holds on the batch path too
+    eq2 = eq_mod.EquivalenceCache()
+    for i in range(bound + 1):
+        eq2.store_many(f"bcls{i}", {"nx": (i, [], 0.0)}, {"nx": 1})
+    assert eq2.lookup("nx", "bcls0", 1, record=False) is None, \
+        "store_many ignored the per-node bound"
+
+
+def _check_score_kernels() -> None:
+    """Every score kernel float-for-float against its scalar original,
+    including the degenerate rows (no allocatable at all, cpu-only)
+    where the count/denominator boundary mutants hide."""
+    from kubegpu_tpu.scheduler import factory, priorities
+    from kubegpu_tpu.scheduler.predicates import pod_core_requests
+
+    vec_mod = _vec_mod()
+    cache = _cache_mod().SchedulerCache(_device_scheduler())
+    n0 = _mesh_node("k0", (0, 0, 0), cpu="8")
+    n0["status"]["allocatable"]["memory"] = "16Gi"
+    n0["metadata"]["labels"] = {"topology.kubernetes.io/zone": "z1"}
+    n1 = _mesh_node("k1", (2, 0, 0), cpu="4")
+    n1["status"]["allocatable"]["memory"] = "8Gi"
+    n1["metadata"]["labels"] = {"topology.kubernetes.io/zone": "z2",
+                                "tier": "gold"}
+    n2 = _mesh_node("k2", (4, 0, 0), cpu="16", taints=[
+        {"key": "k", "value": "v", "effect": "PreferNoSchedule"}])
+    n3 = _mesh_node("k3", (0, 2, 0))
+    n3["status"]["allocatable"] = {}          # count == 0 row
+    n3["metadata"]["annotations"] = dict(n3["metadata"]["annotations"])
+    n3["metadata"]["annotations"][
+        "scheduler.alpha.kubernetes.io/preferAvoidPods"] = \
+        '{"preferAvoidPods": []}'
+    for node in (n0, n1, n2, n3):
+        cache.set_node(node)
+    for i, (node, labels) in enumerate([("k0", {"app": "web"}),
+                                        ("k0", {"app": "web"}),
+                                        ("k1", {"app": "db"})]):
+        cache.add_pod({"metadata": {"name": f"kb{i}", "labels": labels},
+                       "spec": {"containers": [
+                           {"name": "m",
+                            "resources": {"requests": {"cpu": "1"}}}]}},
+                      node)
+    pod = {"metadata": {"name": "probe", "labels": {"app": "web"},
+                        "ownerReferences": [{"uid": "u1",
+                                             "kind": "ReplicaSet",
+                                             "name": "rs"}]},
+           "spec": {"containers": [
+               {"name": "m", "resources": {"requests": {
+                   "cpu": "2", "memory": "1Gi"}}}],
+               "affinity": {"nodeAffinity": {
+                   "preferredDuringSchedulingIgnoredDuringExecution": [
+                       {"weight": 3, "preference": {"matchExpressions": [
+                           {"key": "tier", "operator": "In",
+                            "values": ["gold"]}]}}]}}}}
+    names = sorted(cache.nodes)
+    snaps = [cache.snapshot_node(n) for n in names]
+    facts = {n: priorities.NodeFacts(s.kube_node, s.core_allocatable,
+                                     s.requested_core, s.pod_labels)
+             for n, s in zip(names, snaps)}
+    req = pod_core_requests(pod)
+    cols = vec_mod._ScoreColumns(snaps, req)
+    pairs: List[Tuple[Any, Any]] = [
+        (vec_mod._kernel_least_requested,
+         lambda n: priorities.least_requested(req, facts[n])),
+        (vec_mod._kernel_most_requested,
+         lambda n: priorities.most_requested(req, facts[n])),
+        (vec_mod._kernel_balanced,
+         lambda n: priorities.balanced_allocation(req, facts[n])),
+        (vec_mod._kernel_node_affinity,
+         lambda n: priorities.node_affinity(pod, facts[n])),
+        (vec_mod._kernel_taints,
+         lambda n: priorities.taint_toleration(pod, facts[n])),
+        (vec_mod._kernel_avoid,
+         lambda n: priorities.node_prefer_avoid_pods(pod, facts[n])),
+        (vec_mod._kernel_equal,
+         lambda n: priorities.equal_priority(pod, facts[n])),
+    ]
+    for kernel, scalar in pairs:
+        got = kernel(pod, req, cols, snaps, None)
+        want = [scalar(n) for n in names]
+        assert [float(v) for v in got] == want, (
+            getattr(kernel, "__name__", "kernel"), list(got), want)
+    for sels in (None, [{"app": "web"}], []):
+        ctx = factory.PriorityContext(None, owner_selectors=sels)
+        want_map = factory._pr_spreading(None)(pod, req, facts, ctx)
+        got = vec_mod._kernel_spreading(pod, req, cols, snaps, sels)
+        assert {n: float(got[i]) for i, n in enumerate(names)} == \
+            want_map, ("spreading", sels)
+    want_ip = factory._pr_interpod(None)(pod, req, facts,
+                                         factory.PriorityContext(None))
+    got_ip = vec_mod._kernel_interpod(pod, req, cols, snaps, None)
+    assert {n: float(got_ip[i]) for i, n in enumerate(names)} == want_ip
+
+
+class _StubDevice:
+    def pod_fits_resources(self, pod_info: Any, node_ex: Any,
+                           flag: bool) -> Tuple[bool, list, float]:
+        return True, [], 1.0
+
+
+class _StubSnap:
+    node_ex = None
+
+
+def _check_memo_capacity() -> None:
+    """The scheduling-thread-owned memos hold their documented bounds
+    and quarter-oldest eviction policy (PR 3's 'evict quarter-oldest,
+    not clear()' contract, inherited by the lock-free twins)."""
+    np = _np()
+    vec_mod = _vec_mod()
+    vec = vec_mod.VectorizedFitPass(None, _StubDevice())
+    cap = vec_mod.MAX_SHAPE_VERDICTS
+    for i in range(cap):
+        vec._shape_verdicts[("prefill", i)] = (True, [], 0.0)
+    vec._shape_verdict(("fp",), ("bc",), "rep", {"rep": _StubSnap()},
+                       lambda name: object())
+    want = cap - cap // 4 + 1
+    assert len(vec._shape_verdicts) == want, \
+        ("shape-verdict eviction drift", len(vec._shape_verdicts), want)
+    # the mask memo evicts exactly one oldest class per overflow
+    class _Cols:
+        names = ["x"]
+        epoch = 1
+        gen = np.zeros(1, dtype=np.int64)
+    for i in range(vec_mod.MAX_MASK_CLASSES):
+        vec._mask_memo[f"cls{i}"] = {"epoch": 0, "n": 1}
+    vec._store_mask("fresh", _Cols(), None, {})
+    assert len(vec._mask_memo) == vec_mod.MAX_MASK_CLASSES, \
+        ("mask-memo bound drift", len(vec._mask_memo))
+    assert "cls0" not in vec._mask_memo, "oldest class not evicted"
+    assert "fresh" in vec._mask_memo
+
+
+def _check_columns_mirror() -> None:
+    """The fleet mirror vs from-scratch recomputation across the full
+    mutation vocabulary: set_node, charge/release, heartbeat no-ops,
+    idempotent replays, anti-affinity flushes, node removal and
+    re-registration — plus generation/staleness semantics."""
+    cache_mod = _cache_mod()
+    cache = cache_mod.SchedulerCache(_device_scheduler())
+    cache.set_node(_mesh_node("n0", (0, 0, 0)))
+    cache.set_node(_mesh_node("n1", (2, 0, 0)))          # same shape
+    cache.set_node(_mesh_node("n2", (0, 2, 0), degraded=(1,)))
+    cache.set_node(_mesh_node("n3", (2, 2, 0), taints=[
+        {"key": "k", "value": "v", "effect": "NoSchedule"}]))
+    cache.set_node(_mesh_node("n4", (4, 0, 0), unschedulable=True,
+                              conditions=[{"type": "MemoryPressure",
+                                           "status": "True"}]))
+    # explicit Ready conditions either way, plus an unrelated condition
+    # with status False — the Ready-gate comparisons must not blur
+    cache.set_node(_mesh_node("n5", (4, 2, 0), conditions=[
+        {"type": "Ready", "status": "False"}]))
+    cache.set_node(_mesh_node("n6", (0, 4, 0), conditions=[
+        {"type": "Ready", "status": "True"},
+        {"type": "NetworkUnavailable", "status": "False"}]))
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    _verify_columns(cache, cols)
+    assert int(cols.n_notready[cols.idx["n5"]]) == 1
+    assert int(cols.n_notready[cols.idx["n6"]]) == 0
+    # the preemption prune key is the MIN bound-pod priority
+    for pname, prio in (("pp-lo", 3), ("pp-hi", 40)):
+        cache.add_pod({"metadata": {"name": pname},
+                       "spec": {"priority": prio, "containers": [
+                           {"name": "m", "resources": {
+                               "requests": {"cpu": "1"}}}]}}, "n6")
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    assert int(cols.min_pod_priority[cols.idx["n6"]]) == 3
+    _verify_columns(cache, cols)
+    assert cols.dev_fps[cols.idx["n0"]][0] == \
+        cols.dev_fps[cols.idx["n1"]][0], \
+        "same canonical shape must share an alloc id"
+    assert cols.dev_fps[cols.idx["n0"]][0] != \
+        cols.dev_fps[cols.idx["n2"]][0], \
+        "degraded inventory must not share the healthy shape"
+
+    # charge: assume with a real allocation, then the staleness contract
+    g0 = cache.node_generation("n0")
+    cache.equivalence.store("n0", "probe-class", g0, (True, [], 1.0))
+    pod = _tpu_pod("p0", 2)
+    info = cache.pod_info_for_node(pod, "n0")
+    cache.device_scheduler.pod_allocate(info, cache.nodes["n0"].node_ex)
+    info.node_name = "n0"
+    codec.pod_info_to_annotation(pod["metadata"], info)
+    cache.assume_pod(pod, "n0")
+    g1 = cache.node_generation("n0")
+    assert g1 > g0, "fit-relevant mutation must bump the generation"
+    assert cache.equivalence.lookup("n0", "probe-class", g1,
+                                    record=False) is None, \
+        "pre-mutation verdict served after the bump"
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    _verify_columns(cache, cols)
+    assert int(cols.free_chips[cols.idx["n0"]]) == 2
+    snaps = cache.cycle_snapshot()[1]
+    assert snaps["n0"].requested_core.get("cpu", 0) > 0, \
+        "cycle snapshot stale after charge"
+
+    # heartbeat-only repatch: no generation movement, columns intact
+    hb = _mesh_node("n1", (2, 0, 0))
+    hb["metadata"]["annotations"] = dict(hb["metadata"]["annotations"])
+    hb["metadata"]["annotations"][codec.NODE_HEARTBEAT_ANNOTATION] = \
+        "999999"
+    g_n1 = cache.node_generation("n1")
+    cache.set_node(hb)
+    assert cache.node_generation("n1") == g_n1, \
+        "heartbeat repatch must not invalidate"
+    _verify_columns(cache, cache.cycle_snapshot(with_columns=True)[3])
+
+    # idempotent replay: a bound pod added twice charges once
+    bound = _tpu_pod("b0", 1, cpu="2")
+    binfo = cache.pod_info_for_node(bound, "n1")
+    cache.device_scheduler.pod_allocate(binfo, cache.nodes["n1"].node_ex)
+    binfo.node_name = "n1"
+    codec.pod_info_to_annotation(bound["metadata"], binfo)
+    cache.add_pod(bound, "n1")
+    free_once = int(cache.cycle_snapshot(with_columns=True)[3]
+                    .free_chips[cols.idx["n1"]])
+    cache.add_pod(bound, "n1")
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    assert int(cols.free_chips[cols.idx["n1"]]) == free_once, \
+        "watch replay double-charged"
+    _verify_columns(cache, cols)
+
+    # forget releases EXACTLY once; requested_core returns to absolute
+    # zero (the release sign is a contract, not mirror-consistency)
+    cache.forget_pod(pod)
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    assert int(cols.free_chips[cols.idx["n0"]]) == 4, "forget leaked chips"
+    assert cache.nodes["n0"].requested_core.get("cpu", 0) == 0, \
+        "release did not return the charge to zero"
+    _verify_columns(cache, cols)
+    # release must unmark the pod: add -> remove -> add recharges
+    cache.remove_pod(bound, "n1")
+    assert cache.nodes["n1"].requested_core.get("cpu", 0) == 0, \
+        "remove_pod did not zero the core charge"
+    cache.add_pod(bound, "n1")
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    assert int(cols.free_chips[cols.idx["n1"]]) == free_once, \
+        "re-added pod was not recharged (release left it marked)"
+    _verify_columns(cache, cols)
+    cache.remove_pod(bound, "n1")
+
+    # required anti-affinity flushes EVERY node's generation
+    gens_before = {n: cache.node_generation(n) for n in cache.nodes}
+    anti = {"metadata": {"name": "anti", "labels": {"app": "a"}},
+            "spec": {"containers": [{"name": "m", "resources": {
+                "requests": {"cpu": "1"}}}],
+                "affinity": {"podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "a"}},
+                         "topologyKey": "kubernetes.io/hostname"}]}}}}
+    cache.add_pod(anti, "n2")
+    for n, g in gens_before.items():
+        assert cache.node_generation(n) > g, \
+            f"anti-affinity admit must flush {n}"
+    _verify_columns(cache, cache.cycle_snapshot(with_columns=True)[3])
+
+    # with a required-anti pod placed, a LABEL MOVE on any node flips
+    # the symmetry veto on every node sharing the domain: all-flush
+    gens_before = {n: cache.node_generation(n) for n in cache.nodes}
+    relabeled = _mesh_node("n0", (0, 0, 0))
+    relabeled["metadata"]["labels"] = {"topology.kubernetes.io/zone": "zX"}
+    cache.set_node(relabeled)
+    for n, g in gens_before.items():
+        assert cache.node_generation(n) > g, \
+            f"label move with anti pods placed must flush {n}"
+    _verify_columns(cache, cache.cycle_snapshot(with_columns=True)[3])
+
+    # ... and an ordinary fit-relevant change bumps ITS node
+    g_cpu = cache.node_generation("n1")
+    recpu = _mesh_node("n1", (2, 0, 0), cpu="6")
+    cache.set_node(recpu)
+    assert cache.node_generation("n1") > g_cpu, \
+        "allocatable change must invalidate the node"
+    _verify_columns(cache, cache.cycle_snapshot(with_columns=True)[3])
+
+    # removing the NODE that hosts the anti pod departs its veto: the
+    # remaining fleet must flush too
+    gens_before = {n: cache.node_generation(n) for n in cache.nodes
+                   if n != "n2"}
+    cache.remove_node("n2")
+    for n, g in gens_before.items():
+        assert cache.node_generation(n) > g, \
+            f"departed anti pod must flush {n}"
+    cache.set_node(_mesh_node("n2", (0, 2, 0), degraded=(1,)))
+
+    # node removal: the mirror row must go, and the retained generation
+    # must keep moving so a re-add cannot resurrect stale verdicts
+    g_rm = cache.node_generation("n3")
+    cache.remove_node("n3")
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    assert cols is not None and "n3" not in cols.names, \
+        "removed node lingers in the mirror"
+    _verify_columns(cache, cols)
+    cache.set_node(_mesh_node("n3", (2, 2, 0)))
+    assert cache.node_generation("n3") > g_rm, \
+        "re-added node resumed a generation an old pass may hold"
+    _verify_columns(cache, cache.cycle_snapshot(with_columns=True)[3])
+
+    # node flap: delete + re-add + watch replay of the bound pod as
+    # ADDED must re-charge it against the fresh node (the un-mark
+    # discipline in remove_node)
+    flap = _tpu_pod("flap", 1, cpu="2")
+    finfo = cache.pod_info_for_node(flap, "n3")
+    cache.device_scheduler.pod_allocate(finfo, cache.nodes["n3"].node_ex)
+    finfo.node_name = "n3"
+    codec.pod_info_to_annotation(flap["metadata"], finfo)
+    cache.add_pod(flap, "n3")
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    charged_free = int(cols.free_chips[cols.idx["n3"]])
+    assert charged_free == 3
+    cache.remove_node("n3")
+    cache.set_node(_mesh_node("n3", (2, 2, 0)))
+    cache.add_pod(flap, "n3")  # the watch replays current objects
+    *_, cols = cache.cycle_snapshot(with_columns=True)
+    assert int(cols.free_chips[cols.idx["n3"]]) == charged_free, \
+        "flap replay did not re-charge the bound pod"
+    _verify_columns(cache, cols)
+
+
+def _check_filter_differential() -> None:
+    """Masked filter/score vs the scalar chain: verdicts, reasons and
+    scores over a mixed fleet, plus the cross-path sharing contract
+    (vector-stored verdicts readable through the equivalence memo)."""
+    from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+
+    eq_mod = _equiv_mod()
+    api = InMemoryAPIServer()
+    api.create_node(_mesh_node("h0", (0, 0, 0)))
+    api.create_node(_mesh_node("h1", (2, 0, 0)))
+    api.create_node(_mesh_node("h2", (4, 0, 0)))
+    api.create_node(_mesh_node("h3", (0, 2, 0), degraded=(0,)))
+    api.create_node(_mesh_node("h4", (2, 2, 0), unschedulable=True))
+    api.create_node(_mesh_node("h5", (4, 2, 0), cpu="1", conditions=[
+        {"type": "DiskPressure", "status": "True"}]))
+    # discriminators the first sweep proved necessary: a tainted node
+    # (mask-eligibility poisoning), a NotReady node (condition-count
+    # boundary), a pressure-free tiny-cpu node and an exact-fit node
+    # (the Insufficient >-vs->= boundary)
+    api.create_node(_mesh_node("h6", (0, 4, 0), taints=[
+        {"key": "k", "value": "v", "effect": "NoSchedule"}]))
+    api.create_node(_mesh_node("h7", (2, 4, 0), conditions=[
+        {"type": "Ready", "status": "False"}]))
+    api.create_node(_mesh_node("h8", (4, 4, 0), cpu="2"))
+    vec, scalar = _schedulers(api)
+    try:
+        for i in range(3):
+            api.create_pod(_tpu_pod(f"seed{i}", 1 + i % 2))
+            vec.run_until_idle()
+        probes = [_tpu_pod("q1", 1), _tpu_pod("q2", 2, cpu="4"),
+                  _tpu_pod("q4", 4), _tpu_pod("q16", 16),
+                  _tpu_pod("qx", 1, cpu="2"),  # exact fit on h8
+                  {"metadata": {"name": "be"},
+                   "spec": {"containers": [{"name": "m"}]}}]
+        for _round in range(2):  # warm pass: memo-reuse paths live too
+            for probe in probes:
+                name = probe["metadata"]["name"]
+                vf, vfail, vsnaps, vmeta = \
+                    vec.generic.find_nodes_that_fit(probe)
+                sf, sfail, ssnaps, smeta = \
+                    scalar.generic.find_nodes_that_fit(probe)
+                assert vf == sf, (name, _round, "feasible", vf, sf)
+                assert vfail == sfail, (name, _round, "reasons",
+                                        vfail, sfail)
+                if vf:
+                    vs = vec.generic.prioritize_nodes(probe, vf, vsnaps,
+                                                      vmeta)
+                    ss = scalar.generic.prioritize_nodes(probe, sf,
+                                                         ssnaps, smeta)
+                    assert vs == ss, (name, _round, "scores", vs, ss)
+        # cross-path sharing: the masked pass's verdicts must be
+        # readable through the equivalence memo at the same generations
+        pod = _tpu_pod("share", 1)
+        feasible, _, _, _ = vec.generic.find_nodes_that_fit(pod)
+        eq_class = eq_mod.equivalence_class(pod)
+        hit_somewhere = False
+        for n in feasible:
+            hit = vec.cache.equivalence.lookup(
+                n, eq_class, vec.cache.node_generation(n), record=False)
+            if hit is not None:
+                assert hit[0] is True, (n, "shared verdict polarity")
+                hit_somewhere = True
+        assert hit_somewhere, "vector verdicts never reached the memo"
+        # pinned-pod pass, then a same-demand unpinned pod: the pinned
+        # variant's identity-specific verdict must never be broadcast
+        pinned = PodInfo(name="pin", node_name="h0")
+        pinned.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 1},
+            dev_requests={f"{G}/tpu/x0y0z0/chips": 1},
+            allocate_from={f"{G}/tpu/x0y0z0/chips":
+                           f"{G}/tpu/x0y0z0/chips"})
+        pmeta = {"name": "pin"}
+        codec.pod_info_to_annotation(pmeta, pinned)
+        ppod = {"metadata": pmeta,
+                "spec": {"containers": [{"name": "main", "resources": {
+                    "requests": {"cpu": "1"}}}]}}
+        for probe in (ppod, _tpu_pod("unpinned", 1)):
+            name = probe["metadata"]["name"]
+            vf, vfail, vsnaps, vmeta = vec.generic.find_nodes_that_fit(
+                probe)
+            sf, sfail, _s, _m = scalar.generic.find_nodes_that_fit(probe)
+            assert vf == sf, (name, "pinned-path feasible", vf, sf)
+            assert vfail == sfail, (name, "pinned-path reasons")
+        assert not vec.generic._device_verdicts, \
+            "masked pass leaked into the locked scalar device cache"
+    finally:
+        vec.stop()
+        scalar.stop()
+
+
+def _check_mask_memo() -> None:
+    """The generation-vector mask memo across membership churn: after a
+    same-size node swap the row alignment changes, and a memo that
+    survives the epoch (or mis-keys generations) broadcasts one node's
+    verdict as another's. Plus the memo-effectiveness accounting: a
+    warm pass must fold its mask-memo reuse into the equivalence
+    hit/miss counters."""
+    from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+
+    api = InMemoryAPIServer()
+    api.create_node(_mesh_node("a", (0, 0, 0), cpu="1"))   # tiny cpu
+    api.create_node(_mesh_node("b", (2, 0, 0), cpu="8"))
+    vec, scalar = _schedulers(api)
+    try:
+        probe = _tpu_pod("align", 1, cpu="4")
+
+        def both() -> None:
+            vf, vfail, _vs, _vm = vec.generic.find_nodes_that_fit(probe)
+            sf, sfail, _ss, _sm = scalar.generic.find_nodes_that_fit(
+                probe)
+            assert vf == sf, ("feasible", vf, sf)
+            assert vfail == sfail, ("reasons", vfail, sfail)
+
+        both()
+        hits0 = vec.cache.equivalence.hits
+        both()  # warm: reuse must be counted through record()
+        assert vec.cache.equivalence.hits >= hits0 + 1, \
+            "mask-memo reuse missing from the hit accounting"
+        # same-size membership swap: rows realign, generations collide
+        # (fresh nodes restart at the same small counters) — only the
+        # epoch distinguishes the memo's rows from the new fleet's
+        api.delete_node("a")
+        api.create_node(_mesh_node("c", (4, 0, 0), cpu="1"))
+        vec.run_until_idle()
+        scalar.run_until_idle()
+        both()
+    finally:
+        vec.stop()
+        scalar.stop()
+    _check_pinned_poison()
+
+
+def _check_pinned_poison() -> None:
+    """A pinned pod's identity-specific device verdict must never enter
+    the broadcast shape memo: two shape-and-usage-identical nodes, the
+    pinned chip occupied on the pinned node, then a same-demand
+    unpinned pod — a poisoned memo broadcasts the pinned failure."""
+    from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+
+    api = InMemoryAPIServer()
+    for name, origin in (("pa", (0, 0, 0)), ("pb", (2, 0, 0))):
+        node = _mesh_node(name, origin)
+        node["metadata"]["labels"] = {"host": name}
+        api.create_node(node)
+    vec, scalar = _schedulers(api)
+    try:
+        # occupy the same canonical chip on BOTH nodes (identical fps)
+        for name in ("pa", "pb"):
+            seed = _tpu_pod(f"occ-{name}", 1)
+            seed["spec"]["nodeSelector"] = {"host": name}
+            api.create_pod(seed)
+            vec.run_until_idle()
+        occ = codec.annotation_to_pod_info(
+            api.get_pod("occ-pa").get("metadata") or {})
+        taken = next(iter(
+            occ.running_containers["main"].allocate_from.values()))
+        pin = PodInfo(name="pin-poison", node_name="pa")
+        pin.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 1},
+            dev_requests={taken: 1}, allocate_from={taken: taken})
+        pmeta = {"name": "pin-poison"}
+        codec.pod_info_to_annotation(pmeta, pin)
+        ppod = {"metadata": pmeta,
+                "spec": {"containers": [{"name": "main", "resources": {
+                    "requests": {"cpu": "1"}}}]}}
+        # a second same-class pod pinned to pb's FREE chip: its node is
+        # shape-and-usage-identical to pa, so a poisoned memo serves it
+        # the first pin's failure
+        node_info = codec.annotation_to_node_info(
+            api.get_node("pb").get("metadata") or {})
+        occ_b = codec.annotation_to_pod_info(
+            api.get_pod("occ-pb").get("metadata") or {})
+        taken_b = set(occ_b.running_containers["main"]
+                      .allocate_from.values())
+        free_b = sorted(p for p in node_info.allocatable
+                        if grammar.chip_id_from_path(p) is not None
+                        and p not in taken_b)[0]
+        pin2 = PodInfo(name="pin-free", node_name="pb")
+        pin2.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 1},
+            dev_requests={free_b: 1}, allocate_from={free_b: free_b})
+        p2meta = {"name": "pin-free"}
+        codec.pod_info_to_annotation(p2meta, pin2)
+        ppod2 = {"metadata": p2meta,
+                 "spec": {"containers": [{"name": "main", "resources": {
+                     "requests": {"cpu": "1"}}}]}}
+        for probe in (ppod, ppod2, _tpu_pod("post-pin", 1)):
+            name = probe["metadata"]["name"]
+            vf, vfail, vsnaps, vmeta = vec.generic.find_nodes_that_fit(
+                probe)
+            sf, sfail, _ss, _sm = scalar.generic.find_nodes_that_fit(
+                probe)
+            assert vf == sf, (name, "poison feasible", vf, sf)
+            assert vfail == sfail, (name, "poison reasons", vfail, sfail)
+    finally:
+        vec.stop()
+        scalar.stop()
+
+
+def _check_preempt_differential() -> None:
+    """Preemption choice vs the scalar path, the FastPreemptFit.fits
+    twin verdict for verdict, and the pinned-node sim-key exclusion."""
+    from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+
+    vec_mod = _vec_mod()
+    api = InMemoryAPIServer()
+    for i in range(3):
+        api.create_node(_mesh_node(f"m{i}", (2 * i, 0, 0)))
+    # unhealthy rows: fits() gates these off the columns, and the first
+    # sweep proved the boundaries invisible on an all-healthy fleet
+    api.create_node(_mesh_node("m-nr", (0, 2, 0), conditions=[
+        {"type": "Ready", "status": "False"}]))
+    api.create_node(_mesh_node("m-dp", (2, 2, 0), conditions=[
+        {"type": "DiskPressure", "status": "True"}]))
+    # exact-cpu node: the preemptor's request lands exactly on the cap
+    # (one cpu-1 filler + the cpu-2 preemptor == 3)
+    api.create_node(_mesh_node("m-cpu", (4, 2, 0), cpu="3"))
+    # two-chip node: free+evictable lands BETWEEN the init-max demand
+    # and a min-folded undercount, so demand arithmetic is observable
+    api.create_node(_mesh_node("m-two", (2, 4, 0), degraded=(2, 3)))
+    # a one-chip node holding a priority-5 pod: the strict `<` victim
+    # gate and the zero-free prune boundary are only visible here
+    meq = _mesh_node("m-eq", (0, 4, 0), degraded=(1, 2, 3))
+    meq["metadata"]["labels"] = {"role": "eq"}
+    api.create_node(meq)
+    vec, scalar = _schedulers(api)
+    try:
+        eqv = _tpu_pod("eqv", 1, priority=5)
+        eqv["spec"]["nodeSelector"] = {"role": "eq"}
+        api.create_pod(eqv)
+        vec.run_until_idle()
+        assert (api.get_pod("eqv").get("spec") or {}).get("nodeName") \
+            == "m-eq", "eq-priority pod missed its node"
+        i = 0
+        while True:
+            api.create_pod(_tpu_pod(f"low{i}", 1, priority=0))
+            vec.run_until_idle()
+            if not (api.get_pod(f"low{i}").get("spec") or {}) \
+                    .get("nodeName"):
+                api.delete_pod(f"low{i}")
+                vec.run_until_idle()
+                break
+            i += 1
+            assert i < 32, "filler never saturated the fleet"
+        pre = _tpu_pod("pre", 2, priority=100, cpu="2")
+        # fits() vs the scalar evict-and-reprieve chain
+        gen = vec.generic
+        names, _s, _g, cols = gen.cache.cycle_snapshot(with_columns=True)
+        assert cols is not None
+        fast = vec_mod.FastPreemptFit(gen.vector, pre,
+                                      gen._pod_info_provider(pre), cols)
+        sgen = scalar.generic
+        pig = sgen._pod_info_provider(pre)
+        dc = sgen._device_class(pre)
+        for name in names:
+            vsnap = gen.cache.snapshot_node(name)
+            ssnap = sgen.cache.snapshot_node(name)
+            if vsnap is None or ssnap is None:
+                continue
+            verdict = fast.fits(vsnap)
+            if verdict is None:
+                continue
+            want = sgen._fits_after_evictions(pre, ssnap, None, set(),
+                                              pig, None, dc)
+            assert verdict == want, (name, "fits twin divergence")
+        # pinned preemptor: its node's simulation is identity-specific
+        pinned = PodInfo(name="pinned", node_name="m0")
+        pinned.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 1},
+            dev_requests={f"{G}/tpu/x0y0z0/chips": 1},
+            allocate_from={f"{G}/tpu/x0y0z0/chips":
+                           f"{G}/tpu/x0y0z0/chips"})
+        pmeta = {"name": "pinned"}
+        codec.pod_info_to_annotation(pmeta, pinned)
+        ppod = {"metadata": pmeta,
+                "spec": {"priority": 100,
+                         "containers": [{"name": "main", "resources": {
+                             "requests": {"cpu": "1"}}}]}}
+        pfast = vec_mod.FastPreemptFit(gen.vector, ppod,
+                                       gen._pod_info_provider(ppod), cols)
+        s0 = gen.cache.snapshot_node("m0")
+        s1 = gen.cache.snapshot_node("m1")
+        no_cands: Any = lambda p: None
+        assert pfast.sim_key(s0, [], [], no_cands) is None, \
+            "pinned node entered the simulation memo"
+        assert pfast.sim_key(s1, [], [], no_cands) is not None, \
+            "shape memo dead for unpinned nodes"
+        # chip-capacity prune EXACTNESS: the prune must agree with the
+        # free+evictable arithmetic recomputed from the cache — an
+        # over-eager prune silently drops placeable nodes, a demand
+        # under-count admits unplaceable ones. Preemptors exercise the
+        # init-vs-running max fold and the strict victim-priority gate.
+        pods_by_name = {p["metadata"]["name"]: p
+                        for p in api.list_pods() if p.get("spec")}
+        cycle_snaps = gen.cache.cycle_snapshot()[1]
+        init_pre = PodInfo(name="initpre")
+        init_pre.running_containers["main"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 2})
+        init_pre.init_containers["setup"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 4})
+        init_pre.init_containers["stage"] = ContainerInfo(
+            requests={grammar.RESOURCE_NUM_CHIPS: 1})
+        imeta = {"name": "initpre"}
+        codec.pod_info_to_annotation(imeta, init_pre)
+        ipod = {"metadata": imeta,
+                "spec": {"priority": 100, "containers": [
+                    {"name": "main",
+                     "resources": {"requests": {"cpu": "1"}}}]}}
+        for probe_pod, prio in ((pre, 100), (ipod, 100),
+                                (_tpu_pod("one", 1, priority=5), 5),
+                                (_tpu_pod("zero", 0, priority=5), 5)):
+            pf = vec_mod.FastPreemptFit(
+                gen.vector, probe_pod,
+                gen._pod_info_provider(probe_pod), cols)
+            # demand recomputed INDEPENDENTLY (running sum, init max) —
+            # an oracle through the mutated _chips_demand proves nothing
+            inv = gen._pod_info_provider(probe_pod).inv_info
+            running = sum(
+                int(c.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
+                for c in inv.running_containers.values())
+            init = max(
+                (int(c.requests.get(grammar.RESOURCE_NUM_CHIPS, 0))
+                 for c in inv.init_containers.values()), default=0)
+            demand = max(running, init)
+            for name in names:
+                snap = cycle_snaps.get(name)
+                cached = gen.cache.get_node(name)
+                if snap is None or cached is None or \
+                        cols.idx.get(name) is None:
+                    continue
+                node_ex = cached.node_ex
+                free = sum(
+                    max(node_ex.allocatable.get(p, 0)
+                        - node_ex.used.get(p, 0), 0)
+                    for p in node_ex.allocatable
+                    if grammar.chip_id_from_path(p) is not None)
+                evictable = 0
+                for pod_name in snap.pod_names:
+                    vic = pods_by_name.get(pod_name)
+                    if vic is None:
+                        continue
+                    if int((vic.get("spec") or {}).get("priority")
+                           or 0) < prio:
+                        evictable += cached.pod_chips.get(pod_name, 0)
+                want = demand <= 0 or free + evictable >= demand
+                got = pf.might_fit_after_full_eviction(
+                    name, prio, pods_by_name, snap)
+                assert got == want, ("prune exactness", name,
+                                     probe_pod["metadata"]["name"],
+                                     got, want, free, evictable, demand)
+        # sim_key's PDB-match vectors, against a direct recomputation
+        pdb_state = [{"selector": {"app": "web"}, "allowed": 1},
+                     {"selector": {"app": "web", "tier": "gold"},
+                      "allowed": 0}]
+        cands = [
+            {"metadata": {"name": "full", "labels": {
+                "app": "web", "tier": "gold"}},
+             "spec": {"priority": 1, "containers": []}},
+            {"metadata": {"name": "partial", "labels": {"app": "web"}},
+             "spec": {"priority": 2, "containers": []}},
+            {"metadata": {"name": "none", "labels": {"app": "db"}},
+             "spec": {"priority": 3, "containers": []}},
+        ]
+        info_of = lambda p: codec.kube_pod_to_pod_info(  # noqa: E731
+            p, invalidate_existing=False)
+        key = fast.sim_key(gen.cache.snapshot_node("m1"), cands,
+                           pdb_state, info_of)
+        assert key is not None
+        got_matches = [part[3] for part in key[1]]
+        want_matches = []
+        for cand in cands:
+            labels = cand["metadata"]["labels"]
+            want_matches.append(tuple(
+                j for j, s in enumerate(pdb_state)
+                if all(labels.get(k) == v
+                       for k, v in s["selector"].items())))
+        assert got_matches == want_matches, \
+            ("sim_key pdb vectors", got_matches, want_matches)
+        # capacity probes: fits() and sim_key() own copies of the
+        # quarter-oldest eviction policy
+        cap = vec_mod.MAX_SHAPE_VERDICTS
+        snap_ok = gen.cache.snapshot_node("m1")
+        gen.vector._shape_verdicts.clear()
+        for i in range(cap):
+            gen.vector._shape_verdicts[("prefill", i)] = (True, [], 0.0)
+        fast.fits(snap_ok)
+        want_len = cap - cap // 4 + 1
+        assert len(gen.vector._shape_verdicts) == want_len, \
+            ("fits eviction drift", len(gen.vector._shape_verdicts))
+        gen.vector._contrib_fps.clear()
+        for i in range(cap):
+            gen.vector._contrib_fps[("prefill", i)] = ()
+        fast.sim_key(snap_ok, cands[:1], [], info_of)
+        want_len = cap - cap // 4 + 1
+        assert len(gen.vector._contrib_fps) == want_len, \
+            ("sim_key eviction drift", len(gen.vector._contrib_fps))
+        # the actual preemption decision, vec vs scalar
+        got_vec = vec.generic.preempt(pre)
+        got_scalar = scalar.generic.preempt(pre)
+        assert (got_vec is None) == (got_scalar is None), \
+            ("preempt verdict", got_vec, got_scalar)
+        if got_vec is not None:
+            vnode, vvictims = got_vec
+            snode, svictims = got_scalar
+            assert vnode == snode, ("preempt node", vnode, snode)
+            assert [v["metadata"]["name"] for v in vvictims] == \
+                [v["metadata"]["name"] for v in svictims], "victim drift"
+    finally:
+        vec.stop()
+        scalar.stop()
+
+
+def _check_stream_differential() -> None:
+    """A short randomized pod stream (churn, volumes, a gang) driven
+    through a vectorized and a scalar engine on identically-built
+    clusters: placements must be identical pod for pod, chip for
+    chip."""
+    placements = [_drive_stream(vectorize) for vectorize in (True, False)]
+    assert placements[0] == placements[1], "stream placement drift"
+
+
+def _drive_stream(vectorize: bool) -> Dict[str, Any]:
+    from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer, NotFound
+    from kubegpu_tpu.scheduler.core import Scheduler
+
+    rng = random.Random(42)
+    api = InMemoryAPIServer()
+    for i in range(6):
+        origin = (2 * (i % 3), 2 * (i // 3), 0)
+        degraded = (rng.randrange(4),) if rng.random() < 0.3 else ()
+        api.create_node(_mesh_node(f"s{i}", origin, degraded=degraded))
+    for i in range(2):
+        api.create_pv({"metadata": {"name": f"pv{i}"},
+                       "spec": {"capacity": {"storage": "10Gi"},
+                                "storageClassName": ""}})
+        api.create_pvc({"metadata": {"name": f"pvc{i}"},
+                        "spec": {"resources":
+                                 {"requests": {"storage": "10Gi"}},
+                                 "storageClassName": ""}})
+    saved = os.environ.get("KGTPU_VECTORIZE")
+    os.environ["KGTPU_VECTORIZE"] = "1" if vectorize else "0"
+    try:
+        sched = Scheduler(api, _device_scheduler())
+    finally:
+        if saved is None:
+            os.environ.pop("KGTPU_VECTORIZE", None)
+        else:
+            os.environ["KGTPU_VECTORIZE"] = saved
+    assert (sched.generic.vector is not None) == vectorize
+    placements: Dict[str, Any] = {}
+    try:
+        created: List[str] = []
+        for i in range(10):
+            if i % 4 == 3:
+                pod = _tpu_pod(f"v{i}", 1)
+                pod["spec"]["volumes"] = [
+                    {"name": "data",
+                     "persistentVolumeClaim": {"claimName": f"pvc{i % 2}"}}]
+            else:
+                pod = _tpu_pod(f"p{i}", rng.choice([1, 1, 2, 4]),
+                               priority=rng.choice([0, 0, 10]))
+            api.create_pod(pod)
+            created.append(pod["metadata"]["name"])
+            sched.run_until_idle()
+            if i % 5 == 4 and created:
+                victim = created.pop(rng.randrange(len(created)))
+                try:
+                    api.delete_pod(victim)
+                except KeyError:
+                    pass
+                sched.run_until_idle()
+                placements[f"deleted-{victim}"] = True
+        hi = _tpu_pod("pre", 2, priority=100)
+        api.create_pod(hi)
+        sched.run_until_idle()
+        for name in created + ["pre"]:
+            try:
+                pod = api.get_pod(name)
+            except NotFound:
+                placements[name] = "preempted"
+                continue
+            chips: List[str] = []
+            pi = codec.annotation_to_pod_info(pod.get("metadata") or {})
+            for cont in pi.running_containers.values():
+                chips.extend(sorted(cont.allocate_from.values()))
+            placements[name] = ((pod.get("spec") or {}).get("nodeName"),
+                                tuple(chips))
+    finally:
+        sched.stop()
+    return placements
+
+
+KILL_CHECKS: Tuple[Tuple[str, Callable[[], None]], ...] = (
+    ("mesh-tables", _check_mesh_tables),
+    ("equivalence-model", _check_equivalence_model),
+    ("score-kernels", _check_score_kernels),
+    ("memo-capacity", _check_memo_capacity),
+    ("columns-mirror", _check_columns_mirror),
+    ("filter-differential", _check_filter_differential),
+    ("mask-memo", _check_mask_memo),
+    ("preempt-differential", _check_preempt_differential),
+    ("stream-differential", _check_stream_differential),
+)
+
+
+# ---- the sweep --------------------------------------------------------------
+
+
+class _Timeout(Exception):
+    pass
+
+
+def _run_checks(timeout_s: float) -> Optional[str]:
+    """Run the kill suite; the name of the first failing check, or None
+    (the mutant survived). A wedged mutant trips the alarm and counts
+    as killed — hanging the suite IS an observable difference."""
+    use_alarm = (hasattr(signal, "SIGALRM")
+                 and threading.current_thread()
+                 is threading.main_thread())
+    if use_alarm:
+        def _fire(_sig: int, _frm: Any) -> None:
+            raise _Timeout()
+
+        old_handler = signal.signal(signal.SIGALRM, _fire)
+        signal.alarm(max(1, int(timeout_s)))
+    try:
+        for name, check in KILL_CHECKS:
+            try:
+                check()
+            except _Timeout:
+                return "timeout"
+            except MutationError:
+                raise
+            except BaseException:
+                return name
+        return None
+    finally:
+        if use_alarm:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old_handler)
+
+
+def run_sweep(ids: Optional[List[str]] = None,
+              budget_s: Optional[float] = None,
+              log: Optional[Callable[[str], None]] = None) -> Dict[str, Any]:
+    """Apply each mutant, run the kill suite, restore, report.
+
+    ``ids`` restricts the sweep (CI's pinned subset); ``budget_s``
+    stops cleanly when the wall clock runs out (remaining mutants are
+    reported ``skipped``, never silently dropped)."""
+    _np()  # fail early with a typed error when numpy is absent
+    refs = enumerate_mutants()
+    if ids is not None:
+        by_id = {r.mutant_id: r for r in refs}
+        missing = [i for i in ids if i not in by_id]
+        if missing:
+            raise MutationError(
+                f"unknown mutant id(s): {', '.join(missing)} — "
+                f"re-pin after changing the targeted closure "
+                f"(--list-mutants)")
+        refs = [by_id[i] for i in ids]
+    t0 = time.monotonic()
+    results: List[Dict[str, Any]] = []
+    killed = survived = waived = skipped = 0
+    # sanity: the unmutated tree must pass its own kill suite, or every
+    # "kill" below would be noise
+    baseline = _run_checks(MUTANT_TIMEOUT_S * 2)
+    if baseline is not None:
+        raise MutationError(
+            f"kill suite fails on the UNMUTATED tree (check "
+            f"{baseline!r}) — fix the oracle before measuring mutants")
+    for ref in refs:
+        entry = ref.describe()
+        if budget_s is not None and time.monotonic() - t0 > budget_s:
+            entry["status"] = "skipped"
+            skipped += 1
+            results.append(entry)
+            continue
+        t_m = time.monotonic()
+        waiver = WAIVERS.get(ref.mutant_id)
+        if waiver is not None:
+            entry["status"] = "waived"
+            entry["justification"] = waiver
+            waived += 1
+            results.append(entry)
+            continue
+        try:
+            patch = apply_mutant(ref)
+        except SyntaxError:
+            entry["status"] = "killed"
+            entry["killed_by"] = "compile"
+            killed += 1
+            results.append(entry)
+            continue
+        try:
+            failed = _run_checks(MUTANT_TIMEOUT_S)
+        finally:
+            patch.restore()
+        entry["ms"] = round((time.monotonic() - t_m) * 1e3, 1)
+        if failed is None:
+            entry["status"] = "survived"
+            survived += 1
+        else:
+            entry["status"] = "killed"
+            entry["killed_by"] = failed
+            killed += 1
+        results.append(entry)
+        if log is not None:
+            log(f"{entry['status']:8s} {ref.mutant_id} "
+                f"({entry.get('killed_by', '-')}, {entry.get('ms', 0)} ms)")
+    measured = killed + survived
+    return {
+        "targets": [m for m, _p in TARGETS],
+        "checks": [n for n, _c in KILL_CHECKS],
+        "total": len(refs),
+        "killed": killed,
+        "survived": survived,
+        "waived": waived,
+        "skipped": skipped,
+        "kill_rate": round(killed / measured, 4) if measured else None,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "mutants": results,
+    }
+
+
+def render_report(report: Dict[str, Any]) -> str:
+    rate = report["kill_rate"]
+    lines = [
+        f"mutation sweep: {report['total']} mutant(s) over "
+        f"{len(report['targets'])} module(s) — "
+        f"{report['killed']} killed, {report['survived']} survived, "
+        f"{report['waived']} waived, {report['skipped']} skipped "
+        f"in {report['elapsed_s']}s"
+        + (f" (kill rate {rate * 100:.1f}%)" if rate is not None else "")]
+    by_check: Dict[str, int] = {}
+    for m in report["mutants"]:
+        if m["status"] == "killed":
+            by_check[m["killed_by"]] = by_check.get(m["killed_by"], 0) + 1
+    if by_check:
+        lines.append("  kills by check: " + ", ".join(
+            f"{n}={c}" for n, c in sorted(by_check.items(),
+                                          key=lambda kv: -kv[1])))
+    for m in report["mutants"]:
+        if m["status"] == "survived":
+            lines.append(
+                f"  SURVIVOR {m['id']} — {m['function']} line {m['line']}"
+                f": {m['before']}  [{m['op']}: {m['after']}]")
+    for m in report["mutants"]:
+        if m["status"] == "waived":
+            lines.append(f"  waived   {m['id']} — {m['justification']}")
+    if report["survived"]:
+        lines.append(
+            f"{report['survived']} unexplained survivor(s): each one is "
+            f"a missing differential assertion (add it) or a real bug "
+            f"(fix it) — or carries a justified WAIVERS entry")
+    return "\n".join(lines)
+
+
+def render_mutant_list(refs: List[MutantRef]) -> str:
+    lines = [f"{len(refs)} mutant(s):"]
+    for ref in refs:
+        lines.append(f"  {ref.mutant_id:46s} {ref.module.rsplit('.', 1)[-1]}"
+                     f":{ref.lineno:<5d} {ref.before}  -> {ref.after}")
+    return "\n".join(lines)

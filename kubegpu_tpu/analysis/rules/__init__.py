@@ -14,6 +14,8 @@ from kubegpu_tpu.analysis.rules.locks import (LockDiscipline,
 from kubegpu_tpu.analysis.rules.metricsrule import MetricRegistration
 from kubegpu_tpu.analysis.rules.racer import HotPathPurity, Racer
 from kubegpu_tpu.analysis.rules.suppressions import UnusedSuppression
+from kubegpu_tpu.analysis.rules.twins import (MirrorMaintenance,
+                                              ReasonParity, TwinCoverage)
 from kubegpu_tpu.analysis.rules.wire import WireContract
 
 ALL_RULES = [
@@ -29,6 +31,9 @@ ALL_RULES = [
     WireContract(),
     Racer(),
     HotPathPurity(),
+    TwinCoverage(),
+    MirrorMaintenance(),
+    ReasonParity(),
     # always ordered last by the engine: it audits what the others used
     UnusedSuppression(),
 ]

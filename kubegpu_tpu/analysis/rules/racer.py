@@ -37,7 +37,7 @@ from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
 from kubegpu_tpu.analysis import locksets
 from kubegpu_tpu.analysis.dataflow import CallGraph
 from kubegpu_tpu.analysis.engine import (Context, Finding, SourceFile,
-                                         dotted_name)
+                                         bound_comments, dotted_name)
 from kubegpu_tpu.analysis.locksets import (Access, FieldKey, LocksetModel,
                                            field_write_sites, shared_model)
 
@@ -267,16 +267,13 @@ def _is_alloc_call(node: ast.Call) -> bool:
 
 def _pure_marks(src: SourceFile) -> Dict[int, int]:
     """def-line -> allocation budget for every ``# hot-path: pure``
-    comment in the file (on the def line or the line above)."""
+    comment in the file, via the shared def-bound comment walk (the
+    twin-of and guard declarations stack with the contract, and a
+    stacked comment must not silently unbind it)."""
     out: Dict[int, int] = {}
-    for i, text in enumerate(src.text.splitlines(), start=1):
-        if "hot-path" not in text:
-            continue
-        m = PURE_RE.search(text)
-        if m is not None:
-            budget = int(m.group("alloc") or DEFAULT_ALLOC_BUDGET)
-            out[i] = budget
-            out[i + 1] = budget  # comment directly above the def
+    for _cline, dline, m in bound_comments(src, PURE_RE):
+        if dline is not None:
+            out[dline] = int(m.group("alloc") or DEFAULT_ALLOC_BUDGET)
     return out
 
 

@@ -107,20 +107,24 @@ def _declare(*reads):
     return wrap
 
 
+# vector-gate: pod_eligible routes nodeName-pinned pods to the scalar chain
 def _p_host(args):
     return lambda ctx: predicates.pod_fits_host(ctx.kube_pod, ctx.snap.kube_node)
 
 
+# vector-gate: pod_eligible routes nodeSelector/required-affinity pods to the scalar chain
 def _p_selector(args):
     return lambda ctx: predicates.pod_matches_node_selector(
         ctx.kube_pod, ctx.snap.kube_node)
 
 
+# vector-gate: pod_eligible routes hostPort-requesting pods to the scalar chain
 def _p_ports(args):
     return lambda ctx: predicates.pod_fits_host_ports(
         ctx.kube_pod, ctx.snap.used_ports)
 
 
+# vector-gate: the tainted column drops NoSchedule/NoExecute nodes out of the mask
 def _p_taints(args):
     return lambda ctx: predicates.pod_tolerates_node_taints(
         ctx.kube_pod, ctx.snap.kube_node)
@@ -173,11 +177,13 @@ def _p_resources(args):
         ctx.kube_pod, ctx.snap.core_allocatable, ctx.snap.requested_core)
 
 
+# vector-gate: the vol_heavy column drops nodes with placed pod volumes; pod_eligible routes volume-carrying pods scalar
 def _p_disk_conflict(args):
     return lambda ctx: predicates.no_disk_conflict(
         ctx.kube_pod, ctx.snap.pod_volumes)
 
 
+# vector-gate: vol_heavy column + pod_eligible volume gate (see _p_disk_conflict)
 def _p_max_volumes(kind: str, default_cap: int):
     def build(args):
         cap = int((args or {}).get("maxVolumes") or default_cap)
@@ -187,11 +193,13 @@ def _p_max_volumes(kind: str, default_cap: int):
     return build
 
 
+# vector-gate: pod_eligible routes volume-carrying pods to the scalar chain
 def _p_volume_zone(args):
     return lambda ctx: predicates.no_volume_zone_conflict(
         ctx.kube_pod, ctx.snap.kube_node)
 
 
+# vector-gate: the devolumed-sibling split runs the masked pass volume-free; survivors pay the volume predicates scalar
 def _p_volume_binding(args):
     """CheckVolumeBinding (`predicates.go:1443-1465`): bound PVCs' PVs must
     tolerate the node; unbound PVCs must have a matchable available PV.
@@ -214,6 +222,7 @@ def _p_general(args):
         ctx.snap.core_allocatable, ctx.snap.requested_core)
 
 
+# vector-gate: find_nodes_that_fit nulls the columns whenever inter-pod metadata exists (meta is not None => scalar pass)
 def _p_interpod(args):
     def fn(ctx):
         if ctx.meta is None:

@@ -157,6 +157,7 @@ class VectorizedFitPass:
     # ---- masked filter ------------------------------------------------------
 
     # hot-path: pure alloc=12
+    # twin-of: kubegpu_tpu.scheduler.core.GenericScheduler._run_predicates
     def run_filter(self, kube_pod: dict, eq_class: str, cols: Any,
                    snaps: dict, nominated: Any,
                    pod_info_get: Any) -> tuple:
@@ -226,6 +227,10 @@ class VectorizedFitPass:
         return results, scalar_names
 
     # hot-path: pure alloc=12
+    # twin-of: kubegpu_tpu.scheduler.predicates.check_node_condition
+    # twin-of: kubegpu_tpu.scheduler.factory._p_memory_pressure
+    # twin-of: kubegpu_tpu.scheduler.factory._p_disk_pressure
+    # twin-of: kubegpu_tpu.scheduler.predicates.pod_fits_resources
     def _compute_rows(self, kube_pod: dict, cols: Any, snaps: dict,
                       pod_info_get: Any, comp_idx: Any, computed: dict,
                       results: dict) -> None:
@@ -361,6 +366,7 @@ class VectorizedFitPass:
 
     # ---- vectorized scoring -------------------------------------------------
 
+    # twin-of: kubegpu_tpu.scheduler.core.GenericScheduler.prioritize_nodes
     def run_scores(self, kube_pod: dict, feasible: dict, snaps: dict,
                    algorithm: Any, owner_selectors: Any) -> dict | None:
         """The default priority suite as array arithmetic over columns
@@ -437,6 +443,7 @@ def _fractions(cols: _ScoreColumns) -> tuple:
 
 
 # hot-path: pure alloc=8
+# twin-of: kubegpu_tpu.scheduler.priorities.least_requested
 def _kernel_least_requested(kube_pod, pod_requests, cols, node_snaps, sels):
     np = _np
     cpu_f, mem_f = _fractions(cols)
@@ -451,6 +458,7 @@ def _kernel_least_requested(kube_pod, pod_requests, cols, node_snaps, sels):
 
 
 # hot-path: pure alloc=8
+# twin-of: kubegpu_tpu.scheduler.priorities.most_requested
 def _kernel_most_requested(kube_pod, pod_requests, cols, node_snaps, sels):
     np = _np
     cpu_f, mem_f = _fractions(cols)
@@ -463,6 +471,7 @@ def _kernel_most_requested(kube_pod, pod_requests, cols, node_snaps, sels):
 
 
 # hot-path: pure alloc=8
+# twin-of: kubegpu_tpu.scheduler.priorities.balanced_allocation
 def _kernel_balanced(kube_pod, pod_requests, cols, node_snaps, sels):
     np = _np
     cpu_f, mem_f = _fractions(cols)
@@ -472,6 +481,7 @@ def _kernel_balanced(kube_pod, pod_requests, cols, node_snaps, sels):
                     prio_mod.MAX_PRIORITY / 2)
 
 
+# twin-of: kubegpu_tpu.scheduler.factory._pr_spreading
 def _kernel_spreading(kube_pod, pod_requests, cols, node_snaps, sels):
     np = _np
     n = len(node_snaps)
@@ -520,6 +530,7 @@ def _kernel_spreading(kube_pod, pod_requests, cols, node_snaps, sels):
     return out
 
 
+# twin-of: kubegpu_tpu.scheduler.priorities.node_affinity
 def _kernel_node_affinity(kube_pod, pod_requests, cols, node_snaps, sels):
     np = _np
     affinity = ((kube_pod.get("spec") or {}).get("affinity") or {}) \
@@ -543,6 +554,7 @@ def _kernel_node_affinity(kube_pod, pod_requests, cols, node_snaps, sels):
     return out
 
 
+# twin-of: kubegpu_tpu.scheduler.priorities.taint_toleration
 def _kernel_taints(kube_pod, pod_requests, cols, node_snaps, sels):
     np = _np
     from kubegpu_tpu.scheduler.predicates import _toleration_tolerates
@@ -562,6 +574,7 @@ def _kernel_taints(kube_pod, pod_requests, cols, node_snaps, sels):
     return out
 
 
+# twin-of: kubegpu_tpu.scheduler.priorities.node_prefer_avoid_pods
 def _kernel_avoid(kube_pod, pod_requests, cols, node_snaps, sels):
     np = _np
     out = np.full(len(node_snaps), prio_mod.MAX_PRIORITY)
@@ -582,6 +595,7 @@ def _kernel_avoid(kube_pod, pod_requests, cols, node_snaps, sels):
 
 
 # hot-path: pure alloc=4
+# twin-of: kubegpu_tpu.scheduler.factory._pr_interpod
 def _kernel_interpod(kube_pod, pod_requests, cols, node_snaps, sels):
     # only reachable with meta is None (the engine gates on it): the
     # scalar batch returns 0.0 everywhere in that case
@@ -589,6 +603,7 @@ def _kernel_interpod(kube_pod, pod_requests, cols, node_snaps, sels):
 
 
 # hot-path: pure alloc=4
+# twin-of: kubegpu_tpu.scheduler.priorities.equal_priority
 def _kernel_equal(kube_pod, pod_requests, cols, node_snaps, sels):
     return _np.ones(len(node_snaps))
 
@@ -734,6 +749,7 @@ class FastPreemptFit:
         return self.vec.cache.get_node(name)
 
     # hot-path: pure alloc=10
+    # twin-of: kubegpu_tpu.scheduler.core.GenericScheduler._fits_after_evictions
     def fits(self, snap: Any) -> "bool | None":
         """The full-chain verdict for the mutated snapshot, or None when
         this node needs the scalar chain after all."""

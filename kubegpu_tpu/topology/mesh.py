@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import itertools
 from functools import lru_cache
+from typing import Any, Iterable, Iterator, List
 
 try:  # optional acceleration; every caller falls back to the reference path
     import numpy as _np
@@ -52,7 +53,7 @@ LINK_DIRS = (
 class ICIMesh:
     """A slice-shaped chip grid with optional per-axis wraparound."""
 
-    def __init__(self, dims: tuple, wrap: tuple | bool = False):
+    def __init__(self, dims: tuple, wrap: "tuple | bool" = False) -> None:
         self.dims = tuple(int(d) for d in dims)
         if isinstance(wrap, bool):
             wrap = (wrap,) * len(self.dims)
@@ -103,7 +104,7 @@ class ICIMesh:
                 mask |= 1 << i
         return mask
 
-    def is_connected(self, coords) -> bool:
+    def is_connected(self, coords: Iterable[Coord]) -> bool:
         """Are these chips one ICI-connected component of the mesh?"""
         coords = set(map(tuple, coords))
         if not coords:
@@ -120,7 +121,7 @@ class ICIMesh:
                     stack.append(n)
         return seen == coords
 
-    def free_components(self, free) -> list:
+    def free_components(self, free: Iterable[Coord]) -> list:
         """Connected components of the free set, largest first."""
         free = set(map(tuple, free))
         comps = []
@@ -138,7 +139,7 @@ class ICIMesh:
         comps.sort(key=lambda c: (-len(c), min(c)))
         return comps
 
-    def fragmentation_score(self, free) -> float:
+    def fragmentation_score(self, free: Iterable[Coord]) -> float:
         """1.0 = all free chips form one block; lower = more fragmented."""
         free = set(map(tuple, free))
         if not free:
@@ -168,7 +169,8 @@ def _block_shapes(count: int) -> tuple:
         s[0] * s[1] + s[1] * s[2] + s[0] * s[2], s)))
 
 
-def _block_coords(origin: Coord, shape: tuple, mesh: ICIMesh):
+def _block_coords(origin: Coord, shape: tuple,
+                  mesh: ICIMesh) -> "list | None":
     """Coords of the axis-aligned block at origin; None if it leaves the mesh."""
     coords = []
     for dx in range(shape[0]):
@@ -188,7 +190,8 @@ def _block_coords(origin: Coord, shape: tuple, mesh: ICIMesh):
     return coords
 
 
-def _exposure(block, free, mesh: ICIMesh) -> int:
+def _exposure(block: Iterable[Coord], free: set,
+              mesh: ICIMesh) -> int:
     """Free chips adjacent to (but outside) the block — the fragmentation
     a placement causes. Lower is better: prefer corners and edges."""
     blockset = set(block)
@@ -218,7 +221,8 @@ class _ShapePlacements:
 
     __slots__ = ("shape", "blocks", "neighbors", "coords", "origins")
 
-    def __init__(self, shape, blocks, neighbors, coords, origins):
+    def __init__(self, shape: tuple, blocks: Any, neighbors: Any,
+                 coords: List[list], origins: List[Coord]) -> None:
         self.shape = shape
         self.blocks = blocks        # np.uint64 [P, W]
         self.neighbors = neighbors  # np.uint64 [P, W]
@@ -233,7 +237,7 @@ class _MaskTable:
 
     __slots__ = ("dims", "wrap", "count", "words", "shapes", "_bit")
 
-    def __init__(self, mesh: ICIMesh, count: int):
+    def __init__(self, mesh: ICIMesh, count: int) -> None:
         self.dims = mesh.dims
         self.wrap = mesh.wrap
         self.count = count
@@ -249,7 +253,8 @@ class _MaskTable:
             if placements is not None:
                 self.shapes.append(placements)
 
-    def _placements(self, mesh: ICIMesh, shape) -> "_ShapePlacements | None":
+    def _placements(self, mesh: ICIMesh,
+                    shape: tuple) -> "_ShapePlacements | None":
         rows_b, rows_n, coords_out, origins = [], [], [], []
         for origin in mesh.chips:  # ascending coord order == sorted(free)
             block = _block_coords(origin, shape, mesh)
@@ -277,14 +282,15 @@ class _MaskTable:
         return [(mask >> (64 * w)) & 0xFFFFFFFFFFFFFFFF
                 for w in range(self.words)]
 
-    def free_words(self, free) -> "_np.ndarray":
+    def free_words(self, free: Iterable[Coord]) -> "_np.ndarray":
         mask = 0
         bit = self._bit
         for c in free:
             mask |= 1 << bit(c)
         return _np.array(self._words(mask), dtype=_np.uint64)
 
-    def best_block(self, free_row: "_np.ndarray"):
+    # twin-of: kubegpu_tpu.topology.mesh._find_contiguous_block_reference
+    def best_block(self, free_row: "_np.ndarray") -> "list | None":
         """Most-compact-shape, least-exposure, smallest-origin placement
         fully inside the free mask — exactly the reference search's
         ``min((exposure, origin))`` over its box phase — or None."""
@@ -301,7 +307,9 @@ class _MaskTable:
             return sp.coords[idx[int(_np.argmin(exposure))]]
         return None
 
-    def ranked_blocks(self, free_row: "_np.ndarray"):
+    # twin-of: kubegpu_tpu.topology.mesh._candidate_blocks_reference
+    def ranked_blocks(self,
+                      free_row: "_np.ndarray") -> Iterator[list]:
         """Every feasible box placement, best-first ((exposure, origin)
         within each shape, shapes most-compact-first) — the masked twin
         of the reference's ranked ``candidate_blocks`` box phase."""
@@ -338,7 +346,8 @@ def _mask_table(mesh: ICIMesh, count: int) -> "_MaskTable | None":
     return table
 
 
-def find_contiguous_block(mesh: ICIMesh, free, count: int):
+def find_contiguous_block(mesh: ICIMesh, free: Iterable[Coord],
+                          count: int) -> "list | None":
     """Find ``count`` free chips forming an ICI-contiguous block.
 
     Strategy: try axis-aligned box shapes most-compact-first; among all
@@ -396,7 +405,8 @@ def find_contiguous_block(mesh: ICIMesh, free, count: int):
     return None
 
 
-def _find_contiguous_block_reference(mesh: ICIMesh, free, count: int):
+def _find_contiguous_block_reference(mesh: ICIMesh, free: Iterable[Coord],
+                                     count: int) -> "list | None":
     """The pre-convolution pure-Python search, preserved verbatim as the
     differential-test oracle for both the native core and the masked
     path (`tests/test_vectorized.py` proves block-for-block equality)."""
@@ -427,7 +437,8 @@ def _find_contiguous_block_reference(mesh: ICIMesh, free, count: int):
     return None
 
 
-def _greedy_blob(mesh: ICIMesh, comp, seed, count: int):
+def _greedy_blob(mesh: ICIMesh, comp: set, seed: Coord,
+                 count: int) -> "list | None":
     """Grow a compact connected blob of ``count`` chips from ``seed``
     within component ``comp``; sorted coord list or None."""
     selected = [seed]
@@ -446,7 +457,8 @@ def _greedy_blob(mesh: ICIMesh, comp, seed, count: int):
     return sorted(selected)
 
 
-def candidate_blocks(mesh: ICIMesh, free, count: int, limit: int = 64):
+def candidate_blocks(mesh: ICIMesh, free: Iterable[Coord], count: int,
+                     limit: int = 64) -> Iterator[list]:
     """Yield candidate contiguous blocks in preference order.
 
     The gang planner needs MORE than the single best block: its chosen
@@ -508,8 +520,9 @@ def candidate_blocks(mesh: ICIMesh, free, count: int, limit: int = 64):
                 return
 
 
-def _candidate_blocks_reference(mesh: ICIMesh, free, count: int,
-                                limit: int = 64):
+def _candidate_blocks_reference(mesh: ICIMesh, free: Iterable[Coord],
+                                count: int,
+                                limit: int = 64) -> Iterator[list]:
     """Pre-convolution ``candidate_blocks`` box+blob enumeration,
     preserved as the masked path's differential-test oracle."""
     free = set(map(tuple, free))

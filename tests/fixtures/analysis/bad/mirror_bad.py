@@ -1,0 +1,41 @@
+"""Mirror-maintenance violations: a generation bump with no columns
+update on the normal path, one reachable dirty through an exception
+edge, an invalidator that never propagates generations into the
+mirror, and a direct generation-map write bypassing the invalidator."""
+
+
+class MirrorlessCache:
+    def __init__(self):
+        self.columns = None
+        self._gen = {}
+        self._snap = {}
+        self.nodes = {}
+
+    def _invalidate_locked(self, name):
+        # bumps the generation but never mirrors it (set_gen) -> finding
+        self._gen[name] = self._gen.get(name, 0) + 1
+        self._snap.pop(name, None)
+
+    def set_node(self, node):
+        # no self.columns update anywhere before the bump -> finding
+        self.nodes[node["name"]] = node
+        self._invalidate_locked(node["name"])
+
+    def charge(self, name, pod):
+        # maintained on the normal path, but the swallowing handler
+        # falls through to the bump with the mirror stale -> finding
+        try:
+            self._apply(pod)
+            if self.columns is not None:
+                self.columns.charge(name)
+        except ValueError:
+            pass
+        self._invalidate_locked(name)
+
+    def rebump(self, name):
+        # direct generation-map write outside the invalidator -> finding
+        self._gen[name] = self._gen.get(name, 0) + 1
+
+    def _apply(self, pod):
+        if not pod:
+            raise ValueError("empty pod")

@@ -1,0 +1,20 @@
+"""Reason-parity violations: a ``_REASON*`` constant and a list-display
+literal in a twin-declared function that drifted from the scalar
+chain's literal set (``predicates.py`` in this tree)."""
+
+_REASON_UNSCHEDULABLE = "node(s) were cordoned"  # scalar says unschedulable
+
+
+def _masked_rows_reference(rows):
+    return [r for r in rows if r]
+
+
+# twin-of: reasons_bad._masked_rows_reference
+def best_block(rows):
+    out = {}
+    for i, row in enumerate(rows):
+        if not row:
+            out[i] = [f"Insufficient {row}!"]  # drifted: stray punctuation
+        else:
+            out[i] = ["node(s) were unschedulable"]  # verbatim: clean
+    return out

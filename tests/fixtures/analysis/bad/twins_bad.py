@@ -1,0 +1,43 @@
+"""Twin-contract violations the twin-coverage rule must flag: a
+dangling ``# twin-of:``, a declared pair the differential tests never
+exercise, and a DEFAULT-chain predicate with neither a vector twin nor
+a ``# vector-gate:`` declaration."""
+
+DEFAULT_PREDICATE_NAMES = ("CheckNodeCondition", "PodFitsResources")
+
+
+def _p_condition(args):
+    # no declared twin, no vector-gate: the masked pass's behavior for
+    # this predicate is an unchecked assumption -> finding
+    return lambda ctx: (True, [])
+
+
+def _p_resources(args):
+    return lambda ctx: masked_resources_reference(ctx)
+
+
+def masked_resources_reference(ctx):
+    return True, []
+
+
+FIT_PREDICATES = {
+    "CheckNodeCondition": _p_condition,
+    "PodFitsResources": _p_resources,
+}
+
+
+# twin-of: twins_bad._vanished_original
+def masked_rows(rows):
+    """The declared original does not exist anywhere in the tree."""
+    return rows
+
+
+# twin-of: twins_bad.masked_resources_reference
+def masked_resources(rows):
+    """Resolves, but neither half of the pair appears in the
+    differential tests — the pair is unexercised."""
+    return rows
+
+
+# twin-of: twins_bad.masked_resources_reference
+MASKED_ROWS_LIMIT = 64  # the comment above binds to no def: orphaned

@@ -1,0 +1,67 @@
+"""Clean mirror maintenance: every generation bump is preceded by a
+None-guarded columns update on all paths (finally cleanup, handler
+cleanup), and the invalidator propagates generations into the mirror."""
+
+
+class _Columns:
+    def set_gen(self, name, gen):
+        pass
+
+    def set_node(self, node):
+        pass
+
+    def charge(self, name):
+        pass
+
+
+class MirroredCache:
+    def __init__(self):
+        self.columns = _Columns()
+        self._gen = {}
+        self.nodes = {}
+
+    def _invalidate_locked(self, name):
+        self._gen[name] = self._gen.get(name, 0) + 1
+        if self.columns is not None:
+            self.columns.set_gen(name, self._gen[name])
+
+    def _invalidate_all_locked(self):
+        for name in self.nodes:
+            self._gen[name] = self._gen.get(name, 0) + 1
+        if self.columns is not None:
+            for name in self.nodes:
+                self.columns.set_gen(name, self._gen[name])
+
+    def set_node(self, node):
+        self.nodes[node["name"]] = node
+        if self.columns is not None:
+            self.columns.set_node(node)
+        self._invalidate_locked(node["name"])
+
+    def charge(self, name, pod):
+        try:
+            self._apply(pod)
+        finally:
+            if self.columns is not None:
+                self.columns.charge(name)
+        self._invalidate_locked(name)
+
+    def release(self, name, pod):
+        try:
+            self._apply(pod)
+            if self.columns is not None:
+                self.columns.charge(name)
+        except ValueError:
+            if self.columns is not None:
+                self.columns.charge(name)
+        self._invalidate_locked(name)
+
+    def relabel(self, node):
+        self.nodes[node["name"]] = node
+        if self.columns is not None:
+            self.columns.set_node(node)
+        self._invalidate_all_locked()
+
+    def _apply(self, pod):
+        if not pod:
+            raise ValueError("empty pod")

@@ -1,0 +1,15 @@
+"""Scalar-chain literal pool for the clean reason-parity twin."""
+
+
+def check_node_condition(kube_pod, kube_node):
+    if (kube_node.get("spec") or {}).get("unschedulable"):
+        return False, ["node(s) were unschedulable"]
+    return True, []
+
+
+def pod_fits_resources(requests, allocatable, used):
+    reasons = []
+    for res, req in requests.items():
+        if req + used.get(res, 0) > allocatable.get(res, 0):
+            reasons.append(f"Insufficient {res}")
+    return not reasons, reasons

@@ -1,0 +1,14 @@
+"""Clean twin: every vector-chain reason literal is drawn verbatim from
+the scalar chain's literal set."""
+
+_REASON_UNSCHEDULABLE = "node(s) were unschedulable"
+
+
+def _candidate_blocks_reference(rows):
+    return [r for r in rows if r]
+
+
+# twin-of: reasons_good._candidate_blocks_reference
+def ranked_blocks(rows):
+    return {i: ["node(s) were unschedulable", f"Insufficient {r}"]
+            for i, r in enumerate(rows)}

@@ -1,0 +1,34 @@
+"""Clean twin: every DEFAULT-chain predicate is twin-covered (directly
+or one builder hop away) or vector-gated, and every declared pair
+resolves and is exercised by the differential tests."""
+
+DEFAULT_PREDICATE_NAMES = ("CheckNodeCondition", "PodToleratesNodeTaints")
+
+
+def _p_condition(args):
+    return lambda ctx: check_node_condition(ctx)
+
+
+# vector-gate: the tainted column drops NoSchedule nodes out of the mask
+def _p_taints(args):
+    return lambda ctx: (True, [])
+
+
+FIT_PREDICATES = {
+    "CheckNodeCondition": _p_condition,
+    "PodToleratesNodeTaints": _p_taints,
+}
+
+
+def check_node_condition(ctx):
+    return True, []
+
+
+def _find_contiguous_block_reference(free):
+    return sorted(free)
+
+
+# twin-of: twins_good.check_node_condition
+# twin-of: twins_good._find_contiguous_block_reference
+def best_block(free):
+    return sorted(free)

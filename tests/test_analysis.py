@@ -23,7 +23,8 @@ TESTS_DIR = os.path.join(REPO, "tests")
 RULES = ["lock-discipline", "no-blocking-under-lock", "transitive-locks",
          "monotonic-time", "codec-pairing", "no-swallowed-exceptions",
          "metric-registration", "charge-pairing", "resource-lifecycle",
-         "wire-contract", "racer", "hot-path", "unused-suppression"]
+         "wire-contract", "racer", "hot-path", "twin-coverage",
+         "mirror-maintenance", "reason-parity", "unused-suppression"]
 
 
 # ---- static rules: bad fixtures flag, good twins pass ----------------------
@@ -173,6 +174,180 @@ def test_wire_contract_flags_each_one_sided_surface():
 
 def test_wire_contract_good_twin_is_clean():
     assert findings_for(GOOD, "wire-contract") == []
+
+
+# ---- the twin rules ---------------------------------------------------------
+
+def test_twin_coverage_flags_each_contract_breach():
+    hits = findings_for(BAD, "twin-coverage")
+    msgs = " ".join(f.message for f in hits)
+    assert "dangling" in msgs                      # unresolvable twin-of
+    assert "never appears in the differential tests" in msgs
+    assert "no declared vector twin and no `# vector-gate:`" in msgs
+    assert "binds to no function definition" in msgs  # orphaned comment
+    assert len(hits) == 4
+
+
+def test_twin_coverage_resolution_requires_the_right_owner(tmp_path):
+    """A target resolves only through its last two segments — a moved
+    original cannot hide behind a same-named function elsewhere."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "class Right:\n"
+        "    def original(self):\n"
+        "        pass\n"
+        "# twin-of: mod.Wrong.original\n"
+        "def masked(rows):\n"
+        "    return rows\n")
+    hits = run_analysis([str(src)], select=["twin-coverage"])
+    assert len(hits) == 1 and "does not resolve" in hits[0].message
+    src.write_text(
+        "class Right:\n"
+        "    def original(self):\n"
+        "        pass\n"
+        "# twin-of: pkg.mod.Right.original\n"
+        "def masked(rows):\n"
+        "    return rows\n")
+    hits = run_analysis([str(src)], select=["twin-coverage"])
+    assert all("does not resolve" not in f.message for f in hits)
+
+
+def test_hot_path_contract_binds_through_stacked_comments(tmp_path):
+    """A `# twin-of:` (or any comment) stacked between `# hot-path:
+    pure` and its def must not unbind the purity contract — the
+    silent-ratchet-regression class this PR's review caught."""
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import threading\n"
+        "lock = threading.Lock()\n"
+        "\n"
+        "# hot-path: pure\n"
+        "# twin-of: mod.scalar_original\n"
+        "def kernel(x):\n"
+        "    with lock:\n"
+        "        return x\n"
+        "\n"
+        "def scalar_original(x):\n"
+        "    return x\n")
+    hits = run_analysis([str(src)], select=["hot-path"])
+    assert hits and "contracted" in hits[0].message
+
+
+def test_twin_coverage_good_twin_is_clean():
+    """Gate comments, one-hop builder resolution, and pairs whose names
+    the differential tests reference all satisfy the contract."""
+    assert findings_for(GOOD, "twin-coverage") == []
+
+
+def test_mirror_maintenance_flags_all_path_shapes():
+    hits = findings_for(BAD, "mirror-maintenance")
+    msgs = " ".join(f.message for f in hits)
+    assert "never mirrors them into the fleet columns" in msgs
+    assert "a normal path" in msgs
+    assert "exception edge" in msgs
+    assert "writes the generation map directly" in msgs
+    assert len(hits) == 4
+
+
+def test_mirror_maintenance_good_twin_is_clean():
+    """finally-cleanup, handler-cleanup, and the None-guarded update
+    (credited at the guard) all discharge the mirror obligation."""
+    assert findings_for(GOOD, "mirror-maintenance") == []
+
+
+def test_reason_parity_flags_drifted_literals():
+    hits = findings_for(BAD, "reason-parity")
+    msgs = " ".join(f.message for f in hits)
+    assert "reason constant" in msgs               # drifted _REASON* const
+    assert "Insufficient" in msgs                  # drifted f-string
+    assert len(hits) == 2
+
+
+def test_reason_parity_good_twin_is_clean():
+    assert findings_for(GOOD, "reason-parity") == []
+
+
+# ---- the mutation engine ----------------------------------------------------
+
+def test_mutant_enumeration_is_deterministic_and_unique():
+    from kubegpu_tpu.analysis import mutate
+
+    a = mutate.enumerate_mutants()
+    b = mutate.enumerate_mutants()
+    assert [r.mutant_id for r in a] == [r.mutant_id for r in b]
+    assert len({r.mutant_id for r in a}) == len(a)
+    assert len(a) > 100  # the targeted closure is rich enough to matter
+    ops = {r.op for r in a}
+    assert ops == {"cmp", "boundary", "maskop", "minmax", "dropcall"}
+
+
+def test_mutant_apply_and_restore_roundtrip():
+    """Applying a mesh convolution mutant makes the kill suite fail;
+    restoring brings the original semantics back byte-for-byte."""
+    from kubegpu_tpu.analysis import mutate
+
+    refs = mutate.enumerate_mutants()
+    ref = next(r for r in refs if r.module.endswith("mesh")
+               and r.op == "maskop")
+    patch = mutate.apply_mutant(ref)
+    try:
+        failed = mutate._run_checks(60)
+        assert failed == "mesh-tables", failed
+    finally:
+        patch.restore()
+    assert mutate._run_checks(120) is None  # original tree clean again
+
+
+def test_unknown_mutant_id_is_a_typed_error():
+    from kubegpu_tpu.analysis import mutate
+
+    with pytest.raises(mutate.MutationError):
+        mutate.run_sweep(ids=["mesh.nope:cmp:00000000"])
+
+
+def test_waivers_and_smoke_pins_reference_live_mutants():
+    """A waiver or smoke pin naming a mutant that no longer exists is a
+    stale waiver — the same stance the unused-suppression audit takes."""
+    from kubegpu_tpu.analysis import mutate
+
+    ids = {r.mutant_id for r in mutate.enumerate_mutants()}
+    stale = set(mutate.WAIVERS) - ids
+    assert not stale, f"stale waivers: {sorted(stale)}"
+    assert set(mutate.PINNED_SMOKE) <= ids
+    assert mutate.PINNED_SMOKE, "CI's mutation smoke must pin something"
+    assert not set(mutate.PINNED_SMOKE) & set(mutate.WAIVERS)
+
+
+def test_cli_mutate_smoke_and_list_mutants():
+    """`--list-mutants` is deterministic across invocations, and the
+    exact command CI's PR-time job runs (`--mutate --mutate-smoke`)
+    exits 0 with every pinned mutant killed."""
+    argv = [sys.executable, "-m", "kubegpu_tpu.analysis"]
+    a = subprocess.run(argv + ["--list-mutants"], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    b = subprocess.run(argv + ["--list-mutants"], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert a.returncode == 0, a.stdout + a.stderr
+    assert a.stdout == b.stdout
+    assert "mutant(s):" in a.stdout
+    smoke = subprocess.run(argv + ["--mutate", "--mutate-smoke"],
+                           cwd=REPO, capture_output=True, text=True,
+                           timeout=300)
+    assert smoke.returncode == 0, smoke.stdout + smoke.stderr
+    assert "0 survived" in smoke.stdout
+    assert "kill rate 100.0%" in smoke.stdout
+
+
+def test_pinned_smoke_mutants_all_killed():
+    """The PR-time subset end to end, in process: every pinned mutant
+    dies, and the report says which check killed it."""
+    from kubegpu_tpu.analysis import mutate
+
+    report = mutate.run_sweep(ids=list(mutate.PINNED_SMOKE))
+    assert report["survived"] == 0, mutate.render_report(report)
+    assert report["killed"] == len(mutate.PINNED_SMOKE)
+    for m in report["mutants"]:
+        assert m["status"] == "killed" and m["killed_by"]
 
 
 # ---- the dataflow engine itself ---------------------------------------------

@@ -527,3 +527,300 @@ def test_convolution_ranking_matches_reference(wrap):
 def test_large_mesh_skips_table():
     big = ICIMesh((128, 128, 1), wrap=False)
     assert mesh_mod._mask_table(big, 4) is None
+
+
+# ---- twin-pair direct differentials -----------------------------------------
+#
+# The twin-coverage rule requires every `# twin-of:` pair to be
+# exercised here (AST-identifier-checked); these tests also carry the
+# mutation engine's kill burden for the per-kernel operators.
+
+
+def test_masked_reason_strings_match_scalar_predicates(monkeypatch):
+    """The masked chain's failure reasons, verbatim against the scalar
+    originals it declares: check_node_condition, _p_memory_pressure,
+    _p_disk_pressure, pod_fits_resources."""
+    from kubegpu_tpu.scheduler import factory, predicates
+
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    api = InMemoryAPIServer()
+    api.create_node(mesh_tpu_node("ok", (0, 0, 0)))
+    api.create_node(mesh_tpu_node("unsched", (2, 0, 0), unschedulable=True))
+    api.create_node(mesh_tpu_node("notready", (4, 0, 0), conditions=[
+        {"type": "Ready", "status": "False"}]))
+    api.create_node(mesh_tpu_node("mem", (0, 2, 0), conditions=[
+        {"type": "MemoryPressure", "status": "True"}]))
+    api.create_node(mesh_tpu_node("disk", (2, 2, 0), conditions=[
+        {"type": "DiskPressure", "status": "True"}]))
+    api.create_node(mesh_tpu_node("tiny", (4, 2, 0), cpu="1"))
+    sched = make_scheduler(api)
+    try:
+        pod = tpu_pod("p", 1, cpu="4")
+        _, failures, snaps, _ = sched.generic.find_nodes_that_fit(pod)
+        assert failures["unsched"] == predicates.check_node_condition(
+            pod, snaps["unsched"].kube_node)[1]
+        assert failures["notready"] == predicates.check_node_condition(
+            pod, snaps["notready"].kube_node)[1]
+        assert failures["disk"] == factory._p_disk_pressure(None)(
+            factory.PredicateContext(pod, snaps["disk"]))[1]
+        assert failures["tiny"] == predicates.pod_fits_resources(
+            pod, snaps["tiny"].core_allocatable,
+            snaps["tiny"].requested_core)[1]
+        # BestEffort probe: the QoS-gated MemoryPressure reason
+        be = {"metadata": {"name": "be"},
+              "spec": {"containers": [{"name": "m"}]}}
+        _, be_fail, be_snaps, _ = sched.generic.find_nodes_that_fit(be)
+        assert be_fail["mem"] == factory._p_memory_pressure(None)(
+            factory.PredicateContext(be, be_snaps["mem"]))[1]
+    finally:
+        sched.stop()
+
+
+def test_score_kernels_match_scalar_priorities():
+    """Every score kernel float-for-float against its declared scalar
+    original, over assembled snapshots with labels, zones, taints,
+    preferred affinity, avoid annotations, and placed labeled pods."""
+    from kubegpu_tpu.scheduler import factory, priorities
+    from kubegpu_tpu.scheduler.predicates import pod_core_requests
+    from tests.test_fit_memo import make_cache
+
+    cache = make_cache()
+    n0 = mesh_tpu_node("n0", (0, 0, 0), cpu="8")
+    n0["status"]["allocatable"]["memory"] = "16Gi"
+    n0["metadata"]["labels"] = {"topology.kubernetes.io/zone": "z1"}
+    n1 = mesh_tpu_node("n1", (2, 0, 0), cpu="4")
+    n1["status"]["allocatable"]["memory"] = "8Gi"
+    n1["metadata"]["labels"] = {"topology.kubernetes.io/zone": "z2",
+                                "tier": "gold"}
+    n2 = mesh_tpu_node("n2", (4, 0, 0), cpu="16",
+                       taints=[{"key": "k", "value": "v",
+                                "effect": "PreferNoSchedule"}])
+    n3 = mesh_tpu_node("n3", (0, 2, 0), cpu="8")
+    n3["metadata"]["annotations"] = dict(n3["metadata"].get("annotations")
+                                         or {})
+    n3["metadata"]["annotations"][
+        "scheduler.alpha.kubernetes.io/preferAvoidPods"] = \
+        '{"preferAvoidPods": []}'
+    for node in (n0, n1, n2, n3):
+        cache.set_node(node)
+    for i, (node, labels) in enumerate([("n0", {"app": "web"}),
+                                        ("n0", {"app": "web"}),
+                                        ("n1", {"app": "db"})]):
+        cache.add_pod({"metadata": {"name": f"b{i}", "labels": labels},
+                       "spec": {"containers": [
+                           {"name": "m",
+                            "resources": {"requests": {"cpu": "1"}}}]}},
+                      node)
+    pod = {"metadata": {"name": "probe", "labels": {"app": "web"},
+                        "ownerReferences": [{"uid": "u1",
+                                             "kind": "ReplicaSet",
+                                             "name": "rs"}]},
+           "spec": {"containers": [
+               {"name": "m", "resources": {"requests": {
+                   "cpu": "2", "memory": "1Gi"}}}],
+               "affinity": {"nodeAffinity": {
+                   "preferredDuringSchedulingIgnoredDuringExecution": [
+                       {"weight": 3, "preference": {"matchExpressions": [
+                           {"key": "tier", "operator": "In",
+                            "values": ["gold"]}]}}]}}}}
+    names = sorted(cache.nodes)
+    snaps = [cache.snapshot_node(n) for n in names]
+    facts = {n: priorities.NodeFacts(s.kube_node, s.core_allocatable,
+                                     s.requested_core, s.pod_labels)
+             for n, s in zip(names, snaps)}
+    req = pod_core_requests(pod)
+    cols = vectorized._ScoreColumns(snaps, req)
+    pairs = [
+        (vectorized._kernel_least_requested,
+         lambda n: priorities.least_requested(req, facts[n])),
+        (vectorized._kernel_most_requested,
+         lambda n: priorities.most_requested(req, facts[n])),
+        (vectorized._kernel_balanced,
+         lambda n: priorities.balanced_allocation(req, facts[n])),
+        (vectorized._kernel_node_affinity,
+         lambda n: priorities.node_affinity(pod, facts[n])),
+        (vectorized._kernel_taints,
+         lambda n: priorities.taint_toleration(pod, facts[n])),
+        (vectorized._kernel_avoid,
+         lambda n: priorities.node_prefer_avoid_pods(pod, facts[n])),
+        (vectorized._kernel_equal,
+         lambda n: priorities.equal_priority(pod, facts[n])),
+    ]
+    for kernel, scalar in pairs:
+        got = kernel(pod, req, cols, snaps, None)
+        assert [float(v) for v in got] == [scalar(n) for n in names], \
+            kernel.__name__
+    # spreading: label-equality fallback, owner selectors, no-owner form
+    for sels in (None, [{"app": "web"}], []):
+        ctx = factory.PriorityContext(None, owner_selectors=sels)
+        want = factory._pr_spreading(None)(pod, req, facts, ctx)
+        got = vectorized._kernel_spreading(pod, req, cols, snaps, sels)
+        assert {n: float(got[i]) for i, n in enumerate(names)} == want, sels
+    # interpod: only reachable with meta None — the scalar batch's
+    # all-zero column
+    want_ip = factory._pr_interpod(None)(pod, req, facts,
+                                         factory.PriorityContext(None))
+    got_ip = vectorized._kernel_interpod(pod, req, cols, snaps, None)
+    assert {n: float(got_ip[i]) for i, n in enumerate(names)} == want_ip
+
+
+def test_fast_preempt_fits_matches_scalar_chain(monkeypatch):
+    """FastPreemptFit.fits (twin-of _fits_after_evictions): verdict for
+    verdict against the scalar evict-and-reprieve chain on private
+    snapshots of the same fleet state."""
+    rng = random.Random(5)
+    api = build_cluster(rng)
+    vec_sched, scalar_sched = _engines_over(api, monkeypatch)
+    try:
+        for i in range(5):
+            api.create_pod(tpu_pod(f"s{i}", rng.choice([1, 2])))
+            vec_sched.run_until_idle()
+        pre = tpu_pod("pre", 2, priority=100)
+        gen = vec_sched.generic
+        names, _snaps, _gens, cols = gen.cache.cycle_snapshot(
+            with_columns=True)
+        assert cols is not None
+        fast = vectorized.FastPreemptFit(gen.vector, pre,
+                                         gen._pod_info_provider(pre), cols)
+        sgen = scalar_sched.generic
+        pig = sgen._pod_info_provider(pre)
+        dc = sgen._device_class(pre)
+        checked = 0
+        for name in names:
+            vsnap = gen.cache.snapshot_node(name)
+            ssnap = sgen.cache.snapshot_node(name)
+            if vsnap is None or ssnap is None:
+                continue
+            verdict = fast.fits(vsnap)
+            if verdict is None:
+                continue  # off-columns: the scalar chain runs there anyway
+            want = sgen._fits_after_evictions(pre, ssnap, None, set(),
+                                              pig, None, dc)
+            assert verdict == want, name
+            checked += 1
+        assert checked >= 4
+    finally:
+        vec_sched.stop()
+        scalar_sched.stop()
+
+
+def test_vector_verdicts_readable_through_equivalence(monkeypatch):
+    """Cross-path sharing: the masked pass must store its computed
+    verdicts through EquivalenceCache.store_many so the scalar path and
+    the preemption pruner's stored-negative reads can reuse them."""
+    from kubegpu_tpu.scheduler.equivalence import equivalence_class
+
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    api = InMemoryAPIServer()
+    for i in range(3):
+        api.create_node(flat_tpu_node(f"h{i}", chips=2))
+    sched = make_scheduler(api)
+    try:
+        pod = tpu_pod("a", 1)
+        feasible, _, _, _ = sched.generic.find_nodes_that_fit(pod)
+        assert set(feasible) == {"h0", "h1", "h2"}
+        eq = equivalence_class(pod)
+        cache = sched.cache
+        for n in ("h0", "h1", "h2"):
+            hit = cache.equivalence.lookup(n, eq, cache.node_generation(n),
+                                           record=False)
+            assert hit is not None and hit[0] is True, n
+    finally:
+        sched.stop()
+
+
+# ---- mutation-engine pins ---------------------------------------------------
+#
+# Each test below pins survivors found by `python -m kubegpu_tpu.analysis
+# --mutate` (PR 15): the named mutant IDs survived the original
+# differential suite, and the assertion that now kills each one lives
+# BOTH in the engine's kill suite (analysis/mutate.py) and here, where
+# tier-1 runs it on every change.
+
+
+def test_mask_memo_realigns_after_membership_swap(monkeypatch):
+    """Pins vectorized.run_filter:cmp:cc416c69 (epoch-gate flip): after
+    a same-size node swap the memo rows no longer align with the fleet
+    rows, and only the epoch gate stops a generation-collision reuse
+    from broadcasting one node's verdict as another's."""
+    api = InMemoryAPIServer()
+    api.create_node(mesh_tpu_node("a", (0, 0, 0), cpu="1"))
+    api.create_node(mesh_tpu_node("b", (2, 0, 0), cpu="8"))
+    vec_sched, scalar_sched = _engines_over(api, monkeypatch)
+    try:
+        probe = tpu_pod("align", 1, cpu="4")
+
+        def both():
+            vf, vfail, _vs, _vm = vec_sched.generic.find_nodes_that_fit(
+                probe)
+            sf, sfail, _ss, _sm = \
+                scalar_sched.generic.find_nodes_that_fit(probe)
+            assert vf == sf
+            assert vfail == sfail
+
+        both()
+        hits0 = vec_sched.cache.equivalence.hits
+        both()  # warm pass reuse must be folded into the hit counters
+        # (pins vectorized.run_filter:dropcall:bd4dcce8)
+        assert vec_sched.cache.equivalence.hits >= hits0 + 1
+        api.delete_node("a")
+        api.create_node(mesh_tpu_node("c", (4, 0, 0), cpu="1"))
+        vec_sched.run_until_idle()
+        scalar_sched.run_until_idle()
+        both()
+    finally:
+        vec_sched.stop()
+        scalar_sched.stop()
+
+
+def test_pinned_verdict_never_poisons_the_shape_memo():
+    """Pins vectorized._compute_rows:cmp:8ccff01c (pinned-guard flip):
+    a pinned pod's identity-specific device verdict stored under a
+    broadcast shape key would be served to a shape-identical node by
+    the NEXT same-class pinned pod. Runs the engine's kill check —
+    the single implementation both harnesses share."""
+    from kubegpu_tpu.analysis import mutate
+
+    mutate._check_pinned_poison()
+
+
+def test_memo_eviction_policy_is_quarter_oldest():
+    """Pins vectorized._shape_verdict:cmp:cfda14ce / boundary:319d521c
+    / minmax:7ebc7a4e and _store_mask:cmp:5847cceb — the PR 3
+    'evict quarter-oldest, not clear()' contract inherited by the
+    lock-free vectorized memos."""
+    from kubegpu_tpu.analysis import mutate
+
+    mutate._check_memo_capacity()
+
+
+def test_equivalence_equal_generation_store_overwrites():
+    """Pins equivalence.store:cmp:b17319a6 and store_many:cmp:9ef07a9d:
+    only a STRICTLY newer existing entry refuses a store — equal-
+    generation stores overwrite (the verdict-recompute paths rely on
+    replacing a timed-out verdict at the same generation)."""
+    from kubegpu_tpu.scheduler.equivalence import EquivalenceCache
+
+    eq = EquivalenceCache()
+    eq.store("n", "c", 5, ("first", [], 0.0))
+    eq.store("n", "c", 5, ("second", [], 0.0))
+    assert eq.lookup("n", "c", 5, record=False) == ("second", [], 0.0)
+    eq.store_many("c2", {"n": ("a", [], 0.0)}, {"n": 5})
+    eq.store_many("c2", {"n": ("b", [], 0.0)}, {"n": 5})
+    assert eq.lookup("n", "c2", 5, record=False) == ("b", [], 0.0)
+    eq.store("n", "c", 7, ("newer", [], 0.0))
+    eq.store("n", "c", 6, ("stale", [], 0.0))
+    assert eq.lookup("n", "c", 7, record=False) == ("newer", [], 0.0)
+
+
+def test_preemption_prune_is_exact(monkeypatch):
+    """Pins vectorized.might_fit_after_full_eviction:cmp:fea42415 /
+    cmp:79ed5886 and _chips_demand:minmax:113095ee / cf5d6d2f: the
+    chip-capacity prune must agree exactly with free+evictable vs the
+    init-max-folded demand, with the strict `<` victim-priority gate.
+    Runs the engine's preempt differential, whose oracle recomputes the
+    demand independently."""
+    from kubegpu_tpu.analysis import mutate
+
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    mutate._check_preempt_differential()
