@@ -1732,6 +1732,18 @@ def main():
             run_chaos_scenario(seed=0)["recovery_ms"]
     except Exception as e:  # noqa: BLE001
         per_config["node_loss_recovery_error"] = f"{type(e).__name__}: {e}"
+    # Partial-hardware-failure trajectory: one chip ALLOCATED to a
+    # running gang dies; time from injection to the gang checkpointed,
+    # gang-evicted by the RepairController, and rebound entirely on
+    # healthy chips (zero leaks/double-binds, dead chip excluded). See
+    # cmd/simulate.py --chaos chip-kill.
+    try:
+        from kubegpu_tpu.cmd.simulate import run_chip_kill_scenario
+
+        per_config["gang_repair_recovery_ms"] = \
+            run_chip_kill_scenario(seed=0)["recovery_ms"]
+    except Exception as e:  # noqa: BLE001
+        per_config["gang_repair_recovery_error"] = f"{type(e).__name__}: {e}"
     # Multi-tenant front door: mixed tenants churning while one abusive
     # tenant floods creates through the APF layer + DRF chip gate —
     # well-behaved p99 must hold within 2x of quiet (asserted inside

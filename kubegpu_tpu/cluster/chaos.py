@@ -227,6 +227,118 @@ class TenantFlood:
                     "errored": self.errored}
 
 
+class DeviceChaos:
+    """Seeded device-level fault injector: chip-kill, chip-flap, and
+    ICI-link-down against a set of ``FakeTPUBackend``s.
+
+    Where :class:`ChaosNetwork` breaks the *transport*, this breaks the
+    *hardware* under it — the advertiser then reports the damage through
+    the ordinary health/link annotations and the repair controller takes
+    it from there. All choice (which node, which chip, which link
+    direction) comes from one seeded RNG, so a schedule of N faults is a
+    pure function of the seed; :meth:`plan` materializes that schedule
+    up front for soak tests that want to log and replay it.
+    """
+
+    KINDS = ("chip-kill", "chip-flap", "link-down")
+
+    def __init__(self, backends: dict, seed: int = 0):
+        # {node_name: FakeTPUBackend}; iteration order is sorted so the
+        # draw sequence is independent of dict construction order
+        self._backends = dict(backends)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected: list = []  # (kind, node, chip_id, detail) in order
+
+    def _pick(self, node: str | None, chip_id: str | None):
+        """Resolve (node, backend, chip) — seeded draw for whatever the
+        caller left unspecified."""
+        node = node if node is not None \
+            else self._rng.choice(sorted(self._backends))
+        backend = self._backends[node]
+        chips = backend.inventory.chips
+        if chip_id is None:
+            chip = chips[self._rng.randrange(len(chips))]
+        else:
+            chip = backend.inventory.chip(chip_id)
+            if chip is None:
+                raise KeyError(f"chip {chip_id} not on node {node}")
+        return node, backend, chip
+
+    def kill_chip(self, node: str | None = None,
+                  chip_id: str | None = None) -> tuple:
+        """Permanently fail one chip (seeded pick when unspecified)."""
+        from kubegpu_tpu.node.backend import CHIP_FAILED
+
+        with self._lock:
+            node, backend, chip = self._pick(node, chip_id)
+            backend.set_chip_health(chip.chip_id, CHIP_FAILED)
+            self.injected.append(("chip-kill", node, chip.chip_id, ""))
+            return node, chip.chip_id
+
+    def flap_chip(self, node: str | None = None,
+                  chip_id: str | None = None, period: int = 2) -> tuple:
+        """Start a 1-in-``period`` health flapper on one chip."""
+        from kubegpu_tpu.node.backend import CHIP_DEGRADED
+
+        with self._lock:
+            node, backend, chip = self._pick(node, chip_id)
+            backend.set_chip_flapper(chip.chip_id, CHIP_DEGRADED,
+                                     period=period)
+            self.injected.append(
+                ("chip-flap", node, chip.chip_id, f"period={period}"))
+            return node, chip.chip_id
+
+    def cut_link(self, node: str | None = None,
+                 chip_id: str | None = None,
+                 direction: int | None = None) -> tuple:
+        """Cut one ICI link (bit index into ``mesh.LINK_DIRS``; seeded
+        pick among the chip's live links when unspecified). Cuts BOTH
+        endpoints when the neighbor chip lives on a known backend — a
+        physical link is shared hardware."""
+        from kubegpu_tpu.topology.mesh import LINK_DIRS
+
+        with self._lock:
+            node, backend, chip = self._pick(node, chip_id)
+            if direction is None:
+                direction = self._rng.randrange(len(LINK_DIRS))
+            mask = 1 << direction
+            dead = dict(backend.link_health()).get(chip.chip_id, 0)
+            backend.set_link_health(chip.chip_id, dead | mask)
+            # the far endpoint sees the same cut, in the opposite
+            # direction (LINK_DIRS pairs are (+,-) per axis: 0<->1 etc.)
+            d = LINK_DIRS[direction]
+            far = tuple(chip.coords[i] + d[i] for i in range(3))
+            opposite = 1 << (direction ^ 1)
+            for other_node in sorted(self._backends):
+                other = self._backends[other_node]
+                for c in other.inventory.chips:
+                    if c.coords == far:
+                        fdead = dict(other.link_health()).get(c.chip_id, 0)
+                        other.set_link_health(c.chip_id, fdead | opposite)
+            self.injected.append(
+                ("link-down", node, chip.chip_id, f"dir={direction}"))
+            return node, chip.chip_id, direction
+
+    def plan(self, n: int, kinds: tuple = KINDS) -> list:
+        """Materialize a deterministic schedule of ``n`` fault kinds
+        (the targets are still drawn at injection time, from the same
+        RNG, so plan+step is as reproducible as calling the injectors
+        directly)."""
+        with self._lock:
+            return [self._rng.choice(list(kinds)) for _ in range(n)]
+
+    def step(self, kind: str) -> tuple:
+        """Apply one planned fault kind with seeded targeting."""
+        if kind == "chip-kill":
+            return self.kill_chip()
+        if kind == "chip-flap":
+            return self.flap_chip()
+        if kind == "link-down":
+            return self.cut_link()
+        raise ValueError(f"unknown device fault kind: {kind}")
+
+
 class ChaosProxy:
     """Duck-typed stand-in for the API client it wraps: every callable
     attribute goes through the chaos network first."""

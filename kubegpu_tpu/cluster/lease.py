@@ -57,6 +57,7 @@ HolderFn = Callable[[str], Optional[str]]
 
 SHARD_LEASE_PREFIX = "kgtpu-sched-shard"
 LIFECYCLE_LEASE = "kgtpu-lifecycle"
+REPAIR_LEASE = "kgtpu-repair"
 
 
 def shard_of(pod_name: str, replicas: int) -> int:

@@ -30,8 +30,8 @@ import signal
 import threading
 
 from kubegpu_tpu.cluster.httpapi import HTTPAPIClient
-from kubegpu_tpu.cluster.lease import (LIFECYCLE_LEASE, Elector,
-                                       ShardCoordinator)
+from kubegpu_tpu.cluster.lease import (LIFECYCLE_LEASE, REPAIR_LEASE,
+                                       Elector, ShardCoordinator)
 from kubegpu_tpu.cmd import common
 from kubegpu_tpu.scheduler.core import Scheduler
 from kubegpu_tpu.scheduler.registry import DevicesScheduler
@@ -109,6 +109,22 @@ def start_lifecycle_elector(client, args, holder: str) -> Elector | None:
     return elector
 
 
+def start_repair_elector(client, args, holder: str) -> Elector | None:
+    """Device-fault repair controller, gated on --repair and singleton-
+    elected on its own lease (same shape as the lifecycle elector): two
+    controllers planning the same gang migration would double-evict."""
+    if not getattr(args, "repair", False):
+        return None
+    from kubegpu_tpu.scheduler.repair import RepairController
+
+    controller = RepairController(client)
+    elector = Elector(client.acquire_lease, REPAIR_LEASE, holder,
+                      args.lease_ttl, on_acquire=controller.start,
+                      on_lose=controller.stop)
+    elector.start()
+    return elector
+
+
 def main(argv=None) -> int:
     # Latency-sensitive control loop sharing its process with watch,
     # binder, and fit-pool threads: the default 5 ms GIL switch interval
@@ -162,6 +178,12 @@ def main(argv=None) -> int:
     parser.add_argument("--node-stale-s", type=float, default=0.0,
                         help="heartbeat age marking a node Stale "
                              "(default: node-grace-s / 3)")
+    parser.add_argument("--repair", action="store_true",
+                        help="device-fault repair controller: migrate "
+                             "gangs off degraded chips / dead ICI links "
+                             "(checkpoint, evict, requeue) with typed "
+                             "parking when no feasible target exists. "
+                             "Singleton-elected on its own lease.")
     parser.add_argument("--healthz-port", type=int, default=0,
                         help="healthz + /metrics + /debug/traces + "
                              "/debug/pod/<name> server; 0 disables")
@@ -211,6 +233,7 @@ def main(argv=None) -> int:
         obs.FLIGHT.configure(args.flight_dir)
     common.serve_health(args.healthz_port, extra_status=lambda: True)
     lifecycle_elector = start_lifecycle_elector(client, args, holder)
+    repair_elector = start_repair_elector(client, args, holder)
 
     if args.replicas > 1:
         # Active/active sharded replicas: build the coordinator first
@@ -232,6 +255,8 @@ def main(argv=None) -> int:
         coord.stop()
         if lifecycle_elector is not None:
             lifecycle_elector.stop()
+        if repair_elector is not None:
+            repair_elector.stop()
         sched.stop()
         stop_obs()
         return 0
@@ -243,6 +268,8 @@ def main(argv=None) -> int:
         stop.wait()
         if lifecycle_elector is not None:
             lifecycle_elector.stop()
+        if repair_elector is not None:
+            repair_elector.stop()
         sched.stop()
         stop_obs()
         return 0
@@ -273,6 +300,8 @@ def main(argv=None) -> int:
         stop.wait(args.lease_ttl / 3.0)
     if lifecycle_elector is not None:
         lifecycle_elector.stop()
+    if repair_elector is not None:
+        repair_elector.stop()
     elector.stop()  # demotes (stops the scheduler) if still leading
     stop_obs()
     return 0

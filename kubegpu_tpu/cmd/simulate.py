@@ -7,11 +7,17 @@ from the runtime hook. ``--schedulers N`` runs N optimistic scheduler
 replicas over the same API server, each owning a pod-name-hash shard
 under a lease (the HA control plane in one process).
 
-``--chaos`` runs the node-loss recovery scenario instead: a 4-host
-cluster under a seeded chaos transport, a 2-node gang placed, one node
-agent killed mid-gang — measuring how long the NodeLifecycle controller
-takes to detect the loss, evict the gang, and rebind it entirely on
-surviving nodes with zero leaked chips.
+``--chaos [node-loss]`` runs the node-loss recovery scenario instead: a
+4-host cluster under a seeded chaos transport, a 2-node gang placed, one
+node agent killed mid-gang — measuring how long the NodeLifecycle
+controller takes to detect the loss, evict the gang, and rebind it
+entirely on surviving nodes with zero leaked chips.
+
+``--chaos chip-kill`` runs the partial-hardware-failure scenario: one
+chip ALLOCATED to a running gang dies (seeded fault injector); the
+advertiser stamps the failure, the RepairController checkpoints and
+gang-evicts, and the scheduler re-plans onto healthy chips — zero
+leaked chips, zero double-binds, zero relists, the dead chip excluded.
 
 ``--chaos-ha`` runs the HA control-plane chaos scenario: two scheduler
 replicas over a WAL-backed HTTP apiserver; replica 0 is killed
@@ -233,6 +239,127 @@ def run_chaos_scenario(seed: int = 0, lost_after_s: float = 0.9,
                                  in sorted(net.faults.items())}}
     finally:
         lifecycle.stop()
+        for adv in advs.values():
+            adv.stop()
+        sched.stop()
+
+
+def run_chip_kill_scenario(seed: int = 0,
+                           advertise_interval_s: float = 0.05,
+                           deadline_s: float = 30.0):
+    """Kill one ALLOCATED chip under a running gang; measure the device-
+    fault repair path end to end: advertiser stamps the failed chip,
+    the RepairController checkpoints + gang-evicts, the scheduler
+    re-plans onto healthy chips.
+
+    Returns a dict with ``recovery_ms``, the victim (node, chip), and
+    the placements — raises if the gang fails to recover, lands back on
+    the dead chip, leaks or double-binds chips, the checkpoint signal
+    never fired, or the watch relisted.
+    """
+    import random
+
+    from kubegpu_tpu.cluster.chaos import DeviceChaos
+    from kubegpu_tpu.scheduler.repair import RepairController
+
+    api = InMemoryAPIServer()
+    # 2x2 grid of 4-chip hosts: after one chip dies on the gang's pair,
+    # the OTHER adjacent pair still offers a contiguous 8-chip block
+    origins = [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)]
+    backends = {}
+    advs = {}
+    for i, origin in enumerate(origins):
+        name = f"host{i}"
+        api.create_node({"metadata": {"name": name},
+                         "status": {"allocatable": {"cpu": "64",
+                                                    "pods": 100}}})
+        backend = FakeTPUBackend(
+            v5p_host_inventory(host_origin=origin, mesh_dims=(4, 4, 1)))
+        backends[name] = backend
+        mgr = DevicesManager()
+        mgr.add_device(TPUDeviceManager(backend))
+        mgr.start()
+        adv = DeviceAdvertiser(api, mgr, name)
+        adv.start(interval_s=advertise_interval_s, retry_s=0.03)
+        advs[name] = adv
+    ds = DevicesScheduler()
+    ds.add_device(TPUScheduler())
+    sched = Scheduler(api, ds)
+    sched.start()
+    repair = RepairController(api)
+    repair.start(interval_s=0.05)
+    names = ["ck-gang-0", "ck-gang-1"]
+    try:
+        for name in names:
+            api.create_pod(make_pod(name, 4,
+                                    pod_requests={RESOURCE_GANG: 77,
+                                                  RESOURCE_GANG_SIZE: 2}))
+
+        def placements(deadline, forbidden_chip=None):
+            stop_at = time.monotonic() + deadline
+            while time.monotonic() < stop_at:
+                bound = {}
+                for name in names:
+                    try:
+                        pod = api.get_pod(name)
+                    except KeyError:
+                        break  # mid-eviction: replacement not landed yet
+                    node = (pod.get("spec") or {}).get("nodeName")
+                    if not node:
+                        break
+                    chips = _gang_chips(api, name)
+                    if len(chips) != 4 or (
+                            forbidden_chip and
+                            forbidden_chip in [(node, c) for c in chips]):
+                        break
+                    bound[name] = node
+                else:
+                    return bound
+                time.sleep(0.02)
+            raise RuntimeError(
+                f"gang did not (re)bind clean of the dead chip in "
+                f"{deadline}s (forbidden={forbidden_chip}, "
+                f"parked={repair.parked()})")
+
+        first = placements(20.0)
+        # deterministic victim: seeded choice among the ALLOCATED chips,
+        # injected through the seeded fault injector
+        allocated = sorted(
+            (first[name], chip)
+            for name in names for chip in _gang_chips(api, name))
+        victim_node, victim_chip = random.Random(seed).choice(allocated)
+        chaos = DeviceChaos(backends, seed=seed)
+        chaos.kill_chip(node=victim_node, chip_id=victim_chip)
+        t0 = time.monotonic()
+        final = placements(deadline_s,
+                           forbidden_chip=(victim_node, victim_chip))
+        recovery_ms = (time.monotonic() - t0) * 1e3
+        chips = _bound_chips(api, names)
+        flat = [c for cs in chips.values() for c in cs]
+        if sorted(len(c) for c in chips.values()) != [4, 4] or \
+                len(set(flat)) != 8:
+            raise RuntimeError(f"chip leak/double-bind: {chips}")
+        if (victim_node, victim_chip) in set(flat):
+            raise RuntimeError(f"gang rebound onto dead chip: {chips}")
+        for name in names:
+            events = [e for e in api.list_events(involved_name=name)
+                      if e.get("reason") == "CheckpointRequested"]
+            if not events:
+                raise RuntimeError(
+                    f"no CheckpointRequested event for {name}")
+        if sched.resync_count:
+            raise RuntimeError(f"watch relisted {sched.resync_count}x")
+        return {"recovery_ms": round(recovery_ms, 1),
+                "victim": {"node": victim_node, "chip": victim_chip},
+                "first_placement": first,
+                "final_placement": final,
+                "repairs": repair.repaired_total,
+                "relists": sched.resync_count,
+                "injected": [list(f[:3]) for f in chaos.injected],
+                "fit_cache": _fit_cache_summary(),
+                "data_plane": _data_plane_summary()}
+    finally:
+        repair.stop()
         for adv in advs.values():
             adv.stop()
         sched.stop()
@@ -718,9 +845,15 @@ def main(argv=None) -> int:
                         help="optimistic scheduler replicas over one API "
                              "server (shard leases + conflict commits)")
     parser.add_argument("--json", action="store_true", help="machine output")
-    parser.add_argument("--chaos", action="store_true",
-                        help="run the node-loss recovery scenario under "
-                             "the seeded chaos transport")
+    parser.add_argument("--chaos", nargs="?", const="node-loss",
+                        choices=("node-loss", "chip-kill"), default=None,
+                        help="run a device-failure recovery scenario: "
+                             "node-loss (the default when the flag is "
+                             "bare — node agent killed mid-gang under "
+                             "the seeded chaos transport) or chip-kill "
+                             "(an allocated chip dies; the repair "
+                             "controller checkpoints + migrates the "
+                             "gang)")
     parser.add_argument("--chaos-ha", action="store_true",
                         help="run the HA scenario: scheduler-kill + "
                              "WAL-backed apiserver restart under 2 "
@@ -767,6 +900,19 @@ def main(argv=None) -> int:
 
 
 def _run_simulation(args) -> int:
+    if args.chaos == "chip-kill":
+        result = run_chip_kill_scenario(seed=args.seed)
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            print(f"chip {result['victim']['chip']} on "
+                  f"{result['victim']['node']} killed mid-gang; "
+                  f"checkpointed + migrated in "
+                  f"{result['recovery_ms']:.0f} ms "
+                  f"({result['first_placement']} -> "
+                  f"{result['final_placement']})")
+        return 0
+
     if args.chaos:
         result = run_chaos_scenario(seed=args.seed)
         if args.json:

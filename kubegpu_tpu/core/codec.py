@@ -36,6 +36,12 @@ NODE_HEARTBEAT_ANNOTATION = "node.alpha/Heartbeat"
 # the device backend. A non-healthy chip is withheld from the advertised
 # allocatable inventory (the node shrinks, it does not vanish).
 NODE_CHIP_HEALTH_ANNOTATION = "node.alpha/ChipHealth"
+# Per-chip ICI link health {chip_id: dead-direction bitmask}, bit i set
+# when the link toward ``topology.mesh.LINK_DIRS[i]`` is down. The
+# advertiser clears dead bits from the advertised ``enumLinks`` masks
+# (so the mesh search routes around them) and stamps the raw map here so
+# the repair controller can tell a dead link from a mesh edge.
+NODE_LINK_HEALTH_ANNOTATION = "node.alpha/LinkHealth"
 
 # Kubernetes quantity suffixes -> multiplier. Serialized pods carry requests
 # as quantity strings ("500m", "1Gi"); the reference reads them through
@@ -141,6 +147,35 @@ def annotation_to_chip_health(meta: dict) -> dict:
     return decoded if isinstance(decoded, dict) else {}
 
 
+def link_health_to_annotation(meta: dict, dead_links: dict) -> None:
+    """Serialize the backend's per-chip dead-link bitmask map. Zero
+    masks are dropped — absence means every link up."""
+    _annotations(meta)[NODE_LINK_HEALTH_ANNOTATION] = json.dumps(
+        {k: int(v) for k, v in dict(dead_links).items() if int(v)},
+        sort_keys=True)
+
+
+def annotation_to_link_health(meta: dict) -> dict:
+    """Decode the per-chip dead-link map; {} = every link up."""
+    raw = (meta.get("annotations") or {}).get(NODE_LINK_HEALTH_ANNOTATION)
+    if not raw:
+        return {}
+    try:
+        decoded = json.loads(raw)
+    except (TypeError, ValueError):
+        return {}
+    if not isinstance(decoded, dict):
+        return {}
+    out = {}
+    for chip_id, mask in decoded.items():
+        try:
+            if int(mask):
+                out[str(chip_id)] = int(mask)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
 def pod_info_to_annotation(meta: dict, pod_info: PodInfo) -> None:
     """Serialize the scheduler's decision into pod metadata annotations.
 
@@ -235,6 +270,8 @@ _STATIC_STRINGS: "tuple[str, ...]" = (
     # protocol, so existing indexes must never shift)
     "retry_after_s", "tenant", "kgtpu.io/tenant", "quota", "weight",
     "hard_chips", "chips_created",
+    # device-fault repair (appended last — same shift rule as above)
+    NODE_LINK_HEALTH_ANNOTATION,
 )
 _STATIC_INDEX = {s: i for i, s in enumerate(_STATIC_STRINGS)}
 

@@ -271,6 +271,15 @@ NATIVE_FALLBACKS = Counter("allocator_native_fallbacks_total")
 NODE_READY = Gauge("scheduler_node_ready")
 NODE_LOST = Counter("scheduler_node_lost_total")
 EVICTIONS = Counter("scheduler_evictions_total")
+# Device-fault repair (scheduler/repair.py): gang-atomic migration
+# outcomes — repaired (checkpoint-signaled, evicted, requeued),
+# failed (a write in the eviction chain exhausted its retries),
+# deferred_pdb (voluntary disruption blocked this tick),
+# parked_unrepairable (no feasible target exists; re-planned on
+# heal/growth) and parked_budget (per-gang retry budget exhausted) —
+# plus detection->requeued latency per repaired gang.
+REPAIRS = LabeledCounter("scheduler_repairs_total", ("outcome",))
+REPAIR_LATENCY_MS = Histogram("repair_latency_ms", start_us=0.25)
 # Scheduling hot path (scheduler/cache.py + scheduler/equivalence.py):
 # fit-memo effectiveness. Hits/misses count equivalence-cache lookups in
 # the filter pass; invalidations count per-node generation bumps — every

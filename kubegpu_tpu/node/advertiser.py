@@ -61,6 +61,9 @@ class DeviceAdvertiser:
         health_probe = getattr(self.dev_mgr, "chip_health", None)
         if health_probe is not None:
             codec.chip_health_to_annotation(meta, health_probe())
+        link_probe = getattr(self.dev_mgr, "link_health", None)
+        if link_probe is not None:
+            codec.link_health_to_annotation(meta, link_probe())
         if self.address:
             meta.setdefault("annotations", {})[
                 codec.NODE_ADDRESS_ANNOTATION] = self.address

@@ -68,3 +68,12 @@ class TPUBackend:
         vanishing wholesale). Backends without health telemetry inherit
         this all-healthy default."""
         return {}
+
+    def link_health(self) -> dict:
+        """Per-chip dead-ICI-link bitmasks, ``{chip_id: mask}`` with bit
+        i set when the link toward ``topology.mesh.LINK_DIRS[i]`` is
+        down. Chips absent from the map have all links up. A dead link
+        is cleared from the advertised ``enumLinks`` mask, so the mesh
+        search never places a block across it. Backends without link
+        telemetry inherit this all-links-up default."""
+        return {}

@@ -7,6 +7,8 @@ standard v5p/v4 host shapes plus a failure-injecting variant.
 
 from __future__ import annotations
 
+import threading
+
 from kubegpu_tpu.node.backend import ChipInfo, TPUBackend, TPUInventory
 
 GIB = 2**30
@@ -55,13 +57,27 @@ def single_chip_inventory() -> TPUInventory:
 
 class FakeTPUBackend(TPUBackend):
     """Backend returning a canned inventory; can simulate discovery
-    failure and per-chip health degradation."""
+    failure, per-chip health degradation, flapping health probes, and
+    dead ICI links."""
 
     def __init__(self, inventory: TPUInventory | None = None, fail: bool = False):
         self.inventory = inventory if inventory is not None else v5p_host_inventory()
         self.fail = fail
         self.enumerate_calls = 0
+        # Fault state is shared between the advertise loop (reads) and
+        # the chaos injector (writes): guard it so a mid-write read can't
+        # see a half-applied fault.
+        self._fault_lock = threading.Lock()
+        # guarded-by: self._fault_lock
         self._health: dict = {}
+        # guarded-by: self._fault_lock -- chip_id -> dead-direction bitmask
+        self._dead_links: dict = {}
+        # guarded-by: self._fault_lock -- chip_id -> (state, period); the
+        # probe reports `state` on every `period`-th call (1-in-period
+        # flapper), healthy otherwise
+        self._flappers: dict = {}
+        # guarded-by: self._fault_lock -- flapper phase counter
+        self._probe_calls = 0
 
     def enumerate(self) -> TPUInventory:
         # racer: single-writer -- test-observability counter; the
@@ -75,10 +91,47 @@ class FakeTPUBackend(TPUBackend):
         """Inject a health state for one chip (``healthy`` clears it)."""
         from kubegpu_tpu.node.backend import CHIP_HEALTHY
 
-        if state == CHIP_HEALTHY:
-            self._health.pop(chip_id, None)
-        else:
-            self._health[chip_id] = state
+        with self._fault_lock:
+            if state == CHIP_HEALTHY:
+                self._health.pop(chip_id, None)
+            else:
+                self._health[chip_id] = state
+
+    def set_chip_flapper(self, chip_id: str, state: str | None,
+                         period: int = 2) -> None:
+        """Make ``chip_health()`` report ``state`` for this chip on every
+        ``period``-th probe and healthy in between (a 1-in-``period``
+        flapper — the telemetry pattern the manager's debounce exists
+        to absorb). ``state=None`` clears the flapper."""
+        with self._fault_lock:
+            if state is None:
+                self._flappers.pop(chip_id, None)
+            else:
+                self._flappers[chip_id] = (state, max(1, int(period)))
 
     def chip_health(self) -> dict:
-        return dict(self._health)
+        with self._fault_lock:
+            out = dict(self._health)
+            self._probe_calls += 1
+            for chip_id, (state, period) in self._flappers.items():
+                if self._probe_calls % period == 0:
+                    out[chip_id] = state
+                else:
+                    out.pop(chip_id, None)
+            return out
+
+    def set_link_health(self, chip_id: str, dead_mask: int) -> None:
+        """Inject dead ICI links for one chip: bit i of ``dead_mask``
+        kills the link toward ``mesh.LINK_DIRS[i]`` (0 heals them all).
+        Physical links are shared: killing a link here does NOT touch
+        the neighbor chip's mask — callers modelling a bidirectional
+        cut should cut both endpoints (see ``chaos.DeviceChaos``)."""
+        with self._fault_lock:
+            if dead_mask:
+                self._dead_links[chip_id] = int(dead_mask)
+            else:
+                self._dead_links.pop(chip_id, None)
+
+    def link_health(self) -> dict:
+        with self._fault_lock:
+            return dict(self._dead_links)

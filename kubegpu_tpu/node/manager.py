@@ -39,12 +39,23 @@ class TPUDeviceManager:
     they are stable across restarts.
     """
 
-    def __init__(self, backend: TPUBackend, name: str = "tpu"):
+    def __init__(self, backend: TPUBackend, name: str = "tpu",
+                 health_debounce: int = 1):
         self.backend = backend
         self.name = name
         self.inventory: TPUInventory | None = None
         self.mesh: ICIMesh | None = None
         self.health: dict = {}  # chip_id -> state (absent = healthy)
+        self.dead_links: dict = {}  # chip_id -> dead-direction bitmask
+        # Hysteresis: a health TRANSITION only lands after the backend
+        # reports the same new state ``health_debounce`` consecutive
+        # probes in a row — a 1-in-2 flapping probe can never thrash
+        # allocatable (or the repair controller downstream). 1 = land
+        # immediately (the pre-debounce behavior).
+        self.health_debounce = max(1, int(health_debounce))
+        # racer: single-writer -- advertise-loop-owned debounce ledger:
+        # chip_id -> (candidate state, consecutive observations)
+        self._health_streak: dict = {}
 
     def get_name(self) -> str:
         return self.name
@@ -63,20 +74,62 @@ class TPUDeviceManager:
     def _refresh(self) -> None:
         # discovery state is owned by the node agent's advertise loop
         # (start() runs before the loop exists); peers only read
+        from kubegpu_tpu.node.backend import CHIP_HEALTHY
+
         inv = self.backend.enumerate()
         self.inventory = inv     # racer: single-writer
         dims = inv.mesh_dims if all(inv.mesh_dims) else (1, 1, 1)
         self.mesh = ICIMesh(dims, inv.mesh_wrap)  # racer: single-writer
         try:
-            self.health = dict(self.backend.chip_health() or {})  # racer: single-writer
+            observed = dict(self.backend.chip_health() or {})
         except Exception:
             # health telemetry is advisory: a broken probe must not take
             # the whole inventory down with it
-            self.health = {}
+            observed = {}
+        self.health = self._debounced_health(observed, CHIP_HEALTHY)  # racer: single-writer
+        try:
+            self.dead_links = {  # racer: single-writer
+                k: int(v)
+                for k, v in dict(self.backend.link_health() or {}).items()
+                if int(v)}
+        except Exception:
+            # same advisory contract as the health probe above
+            self.dead_links = {}
+
+    def _debounced_health(self, observed: dict, healthy: str) -> dict:
+        """Fold one raw health observation into the landed health map:
+        each chip's transition (in EITHER direction — degrading or
+        healing) requires ``health_debounce`` consecutive identical
+        observations of the new state before it lands."""
+        if self.health_debounce <= 1:
+            self._health_streak = {}
+            return observed
+        landed = dict(self.health)
+        for chip_id in set(observed) | set(landed) | set(self._health_streak):
+            candidate = observed.get(chip_id, healthy)
+            current = landed.get(chip_id, healthy)
+            if candidate == current:
+                self._health_streak.pop(chip_id, None)
+                continue
+            state, streak = self._health_streak.get(chip_id, (None, 0))
+            streak = streak + 1 if state == candidate else 1
+            if streak >= self.health_debounce:
+                self._health_streak.pop(chip_id, None)
+                if candidate == healthy:
+                    landed.pop(chip_id, None)
+                else:
+                    landed[chip_id] = candidate
+            else:
+                self._health_streak[chip_id] = (candidate, streak)
+        return landed
 
     def chip_health(self) -> dict:
         """Last-known per-chip health, for the advertiser's annotation."""
         return dict(self.health)
+
+    def link_health(self) -> dict:
+        """Last-known per-chip dead-link masks, for the advertiser."""
+        return dict(self.dead_links)
 
     def _tray_index(self, coords: tuple) -> int:
         """Linear index of the tray block containing ``coords``."""
@@ -116,16 +169,36 @@ class TPUDeviceManager:
         node_info.capacity[grammar.RESOURCE_NUM_CHIPS] = len(inv.chips)
         node_info.allocatable[grammar.RESOURCE_NUM_CHIPS] = len(healthy)
         healthy_ids = {c.chip_id for c in healthy}
+        # Chip coords are slice-absolute. When inv.mesh_dims spans the
+        # whole slice they index self.mesh directly; when the dims are
+        # host-local (an off-origin host's coords fall outside them) the
+        # masks must be computed at origin-relative cells, or the host
+        # advertises garbage masks and the gang planner's link filter
+        # rejects every block on it.
+        origin = (0, 0, 0)
+        if inv.chips and not all(
+                0 <= c < d for chip in inv.chips
+                for c, d in zip(chip.coords, self.mesh.dims)):
+            origin = tuple(min(c.coords[i] for c in inv.chips)
+                           for i in range(3))
         for chip in inv.chips:
             base = self.chip_group_path(chip)
             res_lists = (node_info.capacity, node_info.allocatable) \
                 if chip.chip_id in healthy_ids else (node_info.capacity,)
+            # A dead ICI link drops out of the advertised mask: the mesh
+            # search only accepts blocks whose internal adjacency is
+            # link-backed, so clearing the bit is what routes placement
+            # around the fault. (A dead wrap link therefore reads as a
+            # non-torus axis downstream — conservative by construction.)
+            local = tuple(c - o for c, o in zip(chip.coords, origin))
+            links = self.mesh.link_mask(local) & \
+                ~self.dead_links.get(chip.chip_id, 0)
             for res_list in res_lists:
                 add_group_resource(res_list, f"{base}/{grammar.CHIPS_SUFFIX}", 1)
                 add_group_resource(res_list, f"{base}/{grammar.HBM_SUFFIX}",
                                    chip.hbm_bytes)
                 add_group_resource(res_list, f"{base}/{grammar.LINKS_SUFFIX}",
-                                   self.mesh.link_mask(chip.coords))
+                                   links)
 
     def allocate(self, pod, container) -> tuple[list, list, dict]:
         """Turn ``allocate_from`` into (volumes, device paths, env).
@@ -235,6 +308,25 @@ class DevicesManager:
                 # a dead probe means this device's chips report as
                 # healthy-by-omission — the degradation signal is gone
                 log.warning("chip health probe failed for device %s",
+                            dev.get_name(), exc_info=True)
+                continue
+        return out
+
+    def link_health(self) -> dict:
+        """Merged per-chip dead-link masks across operational devices
+        (same keying contract as :meth:`chip_health`)."""
+        out: dict = {}
+        for dev in self.devices:
+            if not self.operational.get(dev.get_name()):
+                continue
+            probe = getattr(dev, "link_health", None)
+            if probe is None:
+                continue
+            try:
+                out.update(probe() or {})
+            except Exception:
+                # dead probe = links report as up-by-omission
+                log.warning("link health probe failed for device %s",
                             dev.get_name(), exc_info=True)
                 continue
         return out

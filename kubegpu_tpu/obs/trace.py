@@ -448,6 +448,7 @@ def explain_pod(pod_name: str,
     conflicts = 0
     parks = 0
     bound_span = None
+    unrepairable = None
     for s in spans:
         if s.name == "unschedulable":
             last_failure = dict(s.attrs)
@@ -455,6 +456,13 @@ def explain_pod(pod_name: str,
             conflicts += 1
         elif s.name == "backoff_park":
             parks += 1
+        elif s.name == "unrepairable":
+            # the repair controller parked this pod's gang with a typed
+            # reason instead of evict-looping (scheduler/repair.py);
+            # latest wins — a later heal clears it with a repair span
+            unrepairable = dict(s.attrs)
+        elif s.name == "repair_eviction":
+            unrepairable = None
         elif s.name in ("bind_commit", "arbiter_commit") and \
                 s.attrs.get("outcome", "committed") == "committed":
             bound_span = s
@@ -471,6 +479,8 @@ def explain_pod(pod_name: str,
         out["node"] = bound_span.attrs["node"]
     if last_failure is not None:
         out["last_failure"] = last_failure
+    if unrepairable is not None:
+        out["unrepairable"] = unrepairable
     if not spans:
         out["note"] = ("no spans recorded for this pod in this process "
                        "(never seen here, or aged out of the ring)")

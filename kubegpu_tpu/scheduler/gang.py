@@ -231,6 +231,45 @@ class GangPlanner:
         return sizes, sum(sizes.values()), hbm_floor, all_chips, mesh, origin
 
     @staticmethod
+    def _link_of(all_chips: list, origin: tuple):
+        """``link_of`` predicate over RELATIVE coordinates for
+        ``ICIMesh.block_respects_links``: the chip's advertised
+        ``enumLinks`` mask (dead links already cleared node-side), with
+        mask 0 read as "no link info" (legacy advertisers, degenerate
+        1-chip meshes) — unknown never rejects a block.
+
+        Advertisers come in two mask schemes. A slice-global scheme
+        claims bits for inter-host ICI too — there a missing cross-node
+        bit means a dead link and must reject. A host-local scheme only
+        describes links inside the host's own mesh — there cross-node
+        bits are simply never claimed, and treating their absence as a
+        fault would reject every multi-host block. If no chip anywhere
+        claims a bit toward another node's cell, the fleet is host-local
+        and cross-node bits are backfilled as unknown-live."""
+        from kubegpu_tpu.topology.mesh import LINK_DIRS
+
+        links = {}
+        node_of = {}
+        for c in all_chips:
+            rel = tuple(c.coords[i] - origin[i] for i in range(3))
+            links[rel] = c.links
+            node_of[rel] = c.node_name
+        def cross_node_bits(rel, claimed_only):
+            mask = 0
+            for i, d in enumerate(LINK_DIRS):
+                nb = tuple(rel[j] + d[j] for j in range(3))
+                if nb in node_of and node_of[nb] != node_of[rel] and \
+                        (not claimed_only or links[rel] & (1 << i)):
+                    mask |= 1 << i
+            return mask
+        slice_global = any(cross_node_bits(rel, claimed_only=True)
+                           for rel in links)
+        if not slice_global:
+            links = {rel: mask | cross_node_bits(rel, claimed_only=False)
+                     for rel, mask in links.items()}
+        return lambda rel: links.get(rel) or None
+
+    @staticmethod
     def _apply_reservation(free: dict, reserved: dict | None) -> dict:
         """Hold back ``reserved[node]`` free chips per node — room a
         nominated preemptor is owed. Deterministic: the highest-sorted
@@ -275,9 +314,14 @@ class GangPlanner:
         if len(free) < total:
             return None
         rel_free = {tuple(c[i] - origin[i] for i in range(3)) for c in free}
+        link_of = self._link_of(all_chips, origin)
 
         for block in candidate_blocks(mesh, rel_free, total,
                                       limit=self.MAX_CANDIDATE_BLOCKS):
+            # a block spanning a dead ICI link would hand the gang a
+            # collective that can never form — try the next candidate
+            if not mesh.block_respects_links(block, link_of):
+                continue
             assignment = self._split_block(block, free, origin, sizes)
             if assignment is not None:
                 return assignment
@@ -326,10 +370,13 @@ class GangPlanner:
         if len(free) < total:
             return None
         rel_free = {tuple(c[i] - origin[i] for i in range(3)) for c in free}
+        link_of = self._link_of(all_chips, origin)
 
         best = None
         for block in candidate_blocks(mesh, rel_free, total,
                                       limit=self.MAX_CANDIDATE_BLOCKS):
+            if not mesh.block_respects_links(block, link_of):
+                continue
             victims = frozenset(
                 victim_of[tuple(rel[i] + origin[i] for i in range(3))]
                 for rel in block

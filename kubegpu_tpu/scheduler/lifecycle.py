@@ -64,6 +64,7 @@ def requeued_copy(kube_pod: dict) -> dict:
     stripped; device intent (including gang membership) kept."""
     from kubegpu_tpu.scheduler.core import Scheduler
     from kubegpu_tpu.scheduler.gang import GANG_PROCESS_ANNOTATION
+    from kubegpu_tpu.scheduler.repair import CHECKPOINT_REQUEST_ANNOTATION
 
     fresh = copy.deepcopy(kube_pod)
     (fresh.setdefault("spec", {})).pop("nodeName", None)
@@ -72,6 +73,11 @@ def requeued_copy(kube_pod: dict) -> dict:
     ann = dict(meta.get("annotations") or {})
     ann.pop(GANG_PROCESS_ANNOTATION, None)
     ann.pop(Scheduler.NOMINATED_NODE_ANNOTATION, None)
+    # The checkpoint request was serviced by the eviction that produced
+    # this copy; carrying it over would make the replacement checkpoint
+    # itself on startup. Everything else — tenant label (DRF accounting),
+    # user annotations, priority, gang membership — survives verbatim.
+    ann.pop(CHECKPOINT_REQUEST_ANNOTATION, None)
     meta["annotations"] = ann
     if codec.POD_ANNOTATION_KEY in ann:
         # invalidate: allocate_from cleared, dev_requests reset to the

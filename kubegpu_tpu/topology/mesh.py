@@ -121,6 +121,25 @@ class ICIMesh:
                     stack.append(n)
         return seen == coords
 
+    def block_respects_links(self, block: Iterable[Coord],
+                             link_of) -> bool:
+        """Is every internal adjacency of ``block`` backed by a live,
+        advertised ICI link? ``link_of(coord)`` returns the chip's
+        advertised ``enumLinks`` mask (dead links already cleared by the
+        node manager), or None when link info is unavailable — unknown
+        never rejects, so legacy advertisers keep placing. Each edge is
+        checked from BOTH endpoints: a one-sided cut (only one chip has
+        reported the fault so far) is enough to exclude the block."""
+        cells = set(map(tuple, block))
+        for cell in cells:
+            mask = link_of(cell)
+            if mask is None:
+                continue
+            for i, d in enumerate(LINK_DIRS):
+                if self.neighbor(cell, d) in cells and not mask & (1 << i):
+                    return False
+        return True
+
     def free_components(self, free: Iterable[Coord]) -> list:
         """Connected components of the free set, largest first."""
         free = set(map(tuple, free))

@@ -185,6 +185,19 @@ def test_chip_health_annotation_roundtrip():
     assert codec.annotation_to_chip_health(broken) == {}
 
 
+def test_link_health_annotation_roundtrip():
+    dead = {"tpu-0.0.0": 0b10, "tpu-1.0.0": 0b1}
+    meta = {"name": "host0"}
+    codec.link_health_to_annotation(meta, dead)
+    assert codec.annotation_to_link_health(meta) == dead
+    # zero masks mean "every link up" and are dropped on both sides
+    codec.link_health_to_annotation(meta, {"tpu-0.0.0": 0})
+    assert codec.annotation_to_link_health(meta) == {}
+    assert codec.annotation_to_link_health({"name": "bare"}) == {}
+    broken = {"annotations": {codec.NODE_LINK_HEALTH_ANNOTATION: "nope"}}
+    assert codec.annotation_to_link_health(broken) == {}
+
+
 def test_pod_info_annotation_raw_roundtrip():
     """annotation_to_pod_info is the exact inverse of pod_info_to_annotation
     (no spec merge, no invalidation) — the persisted decision reads back

@@ -657,6 +657,124 @@ def test_stream_push_reconnect_never_drops_or_doubles_deltas():
     assert res.ok, res.failure.render()
 
 
+# ---- repair eviction vs racing bind (device-fault repair seam) -------------
+
+
+class CorrectRepairEvict:
+    """The repair controller's eviction shape: delete the bound member,
+    then re-create it PENDING via ``requeued_copy`` (allocation
+    stripped), so a rival bind landing in the window is arbitrated."""
+
+    def evict(self, api, pod):
+        from kubegpu_tpu.scheduler.lifecycle import requeued_copy
+
+        fresh = requeued_copy(pod)
+        try:
+            api.delete_pod(pod["metadata"]["name"])
+        except KeyError:
+            return  # externally gone: never resurrect
+        ex.probe("repair.requeue")  # the controller's delete->create seam
+        api.create_pod(fresh)
+
+
+class ForgetfulEvictRepair(CorrectRepairEvict):
+    """Mutant: the fix mutated out — the replacement is re-created
+    STILL BOUND with its chip claims kept. ``create_pod`` indexes
+    claims without arbitration, so a rival bind that took the chips in
+    the delete->create window ends up double-charged."""
+
+    def evict(self, api, pod):
+        import copy as _copy
+
+        try:
+            api.delete_pod(pod["metadata"]["name"])
+        except KeyError:
+            return
+        ex.probe("repair.requeue")
+        api.create_pod(_copy.deepcopy(pod))
+
+
+def _repair_vs_bind_scenario(evictor_cls):
+    """Repair eviction of a bound 2-member gang racing a scheduler bind
+    of a rival pod onto one of the gang's chips. Safety on EVERY
+    schedule: bound pods' committed chip claims stay pairwise disjoint
+    (exactly-once, zero double-charge) and the gang stays atomic at
+    quiescence."""
+
+    def scenario():
+        api = InMemoryAPIServer()
+        api.create_node({"metadata": {"name": "n1"}})
+        g0 = pinned_pod("g0", None, ["0.0.0"])
+        g1 = pinned_pod("g1", None, ["1.0.0"])
+        rival = pinned_pod("rv", None, ["1.0.0"])  # wants g1's chip
+        for p in (g0, g1, rival):
+            api.create_pod(p)
+        api.bind_many({"g0": "n1", "g1": "n1"},
+                      {"g0": _ann(g0), "g1": _ann(g1)})
+        bound = [api.get_pod("g0"), api.get_pod("g1")]
+        evictor = evictor_cls()
+
+        def repair():
+            for pod in bound:
+                evictor.evict(api, pod)
+
+        def rival_bind():
+            try:
+                api.bind_many({"rv": "n1"}, {"rv": _ann(rival)})
+            except (Conflict, KeyError):
+                pass  # gang still holds the chip / mid-delete: a loss
+
+        def invariant():
+            claims: dict = {}
+            bound_now = {}
+            for name in ("g0", "g1", "rv"):
+                pod = api.get_pod(name)
+                node = (pod.get("spec") or {}).get("nodeName")
+                bound_now[name] = bool(node)
+                if not node:
+                    continue
+                pi = codec.annotation_to_pod_info(pod["metadata"])
+                for cont in pi.running_containers.values():
+                    for path in cont.allocate_from.values():
+                        key = (node, grammar.chip_prefix_from_path(
+                            str(path)))
+                        claims.setdefault(key, []).append(name)
+            for key, owners in claims.items():
+                assert len(owners) == 1, (
+                    f"chip double-charged after repair: {key} claimed "
+                    f"by {owners}")
+            assert bound_now["g0"] == bound_now["g1"], (
+                f"gang split by repair eviction: {bound_now}")
+
+        return [repair, rival_bind], invariant
+
+    scenario.__name__ = f"repair_vs_bind_{evictor_cls.__name__}"
+    return scenario
+
+
+def test_explorer_rediscovers_forgetful_repair_double_charge():
+    res = sch.explore(_repair_vs_bind_scenario(ForgetfulEvictRepair),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.failure is not None, (
+        f"mutant not found in {res.schedules} schedules")
+    assert "double-charged" in res.failure.summary
+    # deterministic rediscovery: the same seed finds the same schedule
+    res2 = sch.explore(_repair_vs_bind_scenario(ForgetfulEvictRepair),
+                       max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                       seed=0)
+    assert res2.failure is not None
+    assert res2.failure.schedule_index == res.failure.schedule_index
+
+
+def test_unmutated_repair_eviction_preserves_chip_conservation():
+    res = sch.explore(_repair_vs_bind_scenario(CorrectRepairEvict),
+                      max_schedules=BUDGET, preemption_bound=PREEMPTIONS,
+                      seed=0)
+    assert res.ok, res.failure.render()
+    assert res.exhausted
+
+
 # ---- exploration budget sanity ---------------------------------------------
 
 
@@ -670,7 +788,9 @@ def test_mutants_found_within_small_deterministic_budget():
             (_conservation_scenario(LostConflictCache),
              "chip accounting corrupted"),
             (_annotation_rewrite_scenario(UnguardedAPIServer),
-             "rewritten")):
+             "rewritten"),
+            (_repair_vs_bind_scenario(ForgetfulEvictRepair),
+             "double-charged")):
         res = sch.explore(scenario, max_schedules=200,
                           preemption_bound=2, seed=0)
         assert res.failure is not None, scenario.__name__
@@ -685,7 +805,8 @@ def test_deep_exploration_of_clean_scenarios():
     for scenario in (
             _conservation_scenario(SchedulerCache),
             _annotation_rewrite_scenario(InMemoryAPIServer),
-            _gang_atomicity_scenario(InMemoryAPIServer)):
+            _gang_atomicity_scenario(InMemoryAPIServer),
+            _repair_vs_bind_scenario(CorrectRepairEvict)):
         res = sch.explore(scenario, max_schedules=8000,
                           preemption_bound=3, seed=0)
         assert res.ok, f"{scenario.__name__}: {res.failure.render()}"
