@@ -20,10 +20,13 @@ check: analyze lint type test
 analyze:
 	$(PY) -m kubegpu_tpu.analysis --stats --budget-s 120 kubegpu_tpu
 
-# the ranked vectorization-blockers inventory for the hot-path closure
-# (the worklist the vectorized-core refactor burns down)
+# the ranked inventories: hot-path's vectorization blockers and
+# host-sync's syncs-per-loop-iteration worklist (the serving rewrite's
+# blocker list — rank 1 is the loop paying the most dispatch RTTs
+# per token)
 report:
 	$(PY) -m kubegpu_tpu.analysis --rule hot-path --report kubegpu_tpu
+	$(PY) -m kubegpu_tpu.analysis --rule host-sync --report kubegpu_tpu
 
 # the dynamic half of the dual-path drift defense: AST mutants over
 # the vector/scalar twin closure, each killed by the differential

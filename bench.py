@@ -940,6 +940,10 @@ if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     except Exception:
         pass
+# count jit compiles/dispatches per section (device-boundary analyzer,
+# dynamic half) — installed before any workload module creates a jit
+from kubegpu_tpu.analysis import dispatchcount as _dc
+_dc.install()
 from kubegpu_tpu.workload.model import TransformerConfig
 from kubegpu_tpu.workload.train import init_sharded, make_train_step
 from kubegpu_tpu.workload.decode import make_generate
@@ -1174,7 +1178,8 @@ def serve_run(srv):
 srv = DecodeServer(dec_cfg, dec_params, slots=4)
 serve_run(srv)  # compile pass (prefill buckets + decode step)
 t0 = time.perf_counter()
-sv_toks, sv_util = serve_run(srv)
+with _dc.section("serve"):
+    sv_toks, sv_util = serve_run(srv)
 serve_s = time.perf_counter() - t0  # every step() host-transfers tokens
 serve_tok_s = sv_toks / serve_s
 
@@ -1187,9 +1192,10 @@ pt = jnp.asarray(_prng.integers(1, DEC["vocab"], (mbu_B, mbu_prompt)),
 o = dec_gen(dec_params, pt, mbu_new)
 jax.device_get(o)
 t0 = time.perf_counter()
-for _ in range(decode_iters):
-    o = dec_gen(dec_params, pt, mbu_new)
-jax.device_get(o)
+with _dc.section("decode_fixed"):
+    for _ in range(decode_iters):
+        o = dec_gen(dec_params, pt, mbu_new)
+    jax.device_get(o)
 fixed_dec_s = (time.perf_counter() - t0) / decode_iters
 fixed_dec_tok_s = mbu_B * mbu_new / fixed_dec_s
 d_, L_, dff_, V_ = (DEC["d_model"], DEC["n_layers"], DEC["d_ff"],
@@ -1269,6 +1275,24 @@ serve_out = {
     "speculative_draft": "truncated-target (%d of %d layers; "
                          "distillation proxy)" % (spec_L, L_),
 }
+# dispatch-count keys: the serving rewrite's trajectory metric (ROADMAP
+# item 1 drives dispatches-per-token toward 0 = the fused-scan rate)
+_dcounts = _dc.counts()
+_sv_dc = _dcounts["sections"].get("serve", {"dispatches": 0, "compiles": 0})
+_fd_dc = _dcounts["sections"].get(
+    "decode_fixed", {"dispatches": 0, "compiles": 0})
+serve_out["serve_dispatches_per_token"] = round(
+    _sv_dc["dispatches"] / max(1, sv_toks), 4)
+serve_out["decode_dispatches_per_token"] = round(
+    _fd_dc["dispatches"] / (decode_iters * mbu_new), 4)
+serve_out["workload_recompiles_total"] = _dcounts["recompiles_total"]
+if _fd_dc["compiles"] > 1:
+    # the fixed-shape decode loop was warmed up above this section: a
+    # post-warmup retrace means a traced-shapes contract is being broken
+    # live (the static retrace-hazard rule's dynamic gate)
+    raise RuntimeError(
+        "fixed-shape decode section recompiled %d times after warmup — "
+        "retrace hazard" % _fd_dc["compiles"])
 if decode_mbu is not None:
     serve_out["decode_mbu"] = round(decode_mbu, 4)
 if backend == "tpu" and os.environ.get("PALLAS_AXON_POOL_IPS"):

@@ -239,13 +239,18 @@ def main(argv: list | None = None) -> int:
     else:
         print(report)
     if args.report:
-        from kubegpu_tpu.analysis.rules.racer import render_report
+        from kubegpu_tpu.analysis.rules import deviceflow, racer
 
+        rendered = False
         if "hot-path" in reports:
-            print(render_report(reports["hot-path"]))
-        else:
-            print("no side-reports (run with --rule hot-path)",
-                  file=sys.stderr)
+            print(racer.render_report(reports["hot-path"]))
+            rendered = True
+        if "host-sync" in reports:
+            print(deviceflow.render_report(reports["host-sync"]))
+            rendered = True
+        if not rendered:
+            print("no side-reports (run with --rule hot-path or "
+                  "--rule host-sync)", file=sys.stderr)
     if args.stats:
         print(render_stats(stats), file=sys.stderr)
     if args.budget_s is not None and stats["total_s"] > args.budget_s:

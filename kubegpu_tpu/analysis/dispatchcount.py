@@ -1,0 +1,247 @@
+"""Dispatch/compile counting at the ``jax.jit`` seam — the dynamic twin
+of the static device-boundary rules (host-sync / retrace-hazard).
+
+``install()`` replaces ``jax.jit`` with a wrapper that counts, per
+named :func:`section`:
+
+* **dispatches** — calls into a jitted callable. The serving rewrite's
+  target metric is dispatches per token: every dispatch from the host
+  is a scheduling round trip, and the static host-sync report's ranked
+  sync sites are exactly where they come from.
+* **compiles** — actual traces of the wrapped function. A fixed-shape
+  section that compiles after its warmup is a retrace-hazard caught
+  live (the static rule's ``# traced-shapes:`` contract was wrong).
+
+``recompiles_total()`` counts, across every wrapper created since
+install, compiles beyond each wrapper's first — bucketed prefill
+legitimately traces once per bucket, so this is an inventory metric;
+the hard gate is per-section (``compiles == 0`` inside a post-warmup
+fixed-shape section, enforced by ``--smoke`` and the bench smoke gate).
+
+Same lifecycle contract as :mod:`kubegpu_tpu.analysis.lockgraph` /
+``leakguard``: ``install()`` is idempotent, ``uninstall()`` restores
+the original ``jax.jit`` (already-wrapped callables keep counting —
+harmless, their cells just stop being reported), and importing this
+module never imports jax; only ``install()`` does.
+
+CLI::
+
+    python -m kubegpu_tpu.analysis.dispatchcount --smoke
+
+runs a tiny fixed-shape decode loop on whatever backend is available
+(``JAX_PLATFORMS=cpu`` works), prints the bench JSON keys, and exits 1
+if the fixed-shape section recompiled after warmup. When jax is not
+importable/usable it prints ``{"skipped": ...}`` and exits 0 — CI
+without an accelerator stack must not fail on the counter's own smoke.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+_lock = threading.Lock()
+_installed = False
+_orig_jit: Any = None
+_section_stack: List[str] = []
+_sections: Dict[str, Dict[str, int]] = {}
+_compile_cells: List[Dict[str, int]] = []
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Zero every counter (the wrapper stays installed)."""
+    with _lock:
+        _sections.clear()
+        _compile_cells.clear()
+
+
+def _bump(kind: str) -> None:
+    with _lock:
+        if not _section_stack:
+            return
+        sec = _section_stack[-1]
+        counts = _sections.setdefault(sec, {"dispatches": 0, "compiles": 0})
+        counts[kind] += 1
+
+
+@contextlib.contextmanager
+def section(name: str) -> Iterator[None]:
+    """Attribute dispatches/compiles inside the block to ``name``.
+    Nestable; the innermost section wins (bench wraps whole phases, so
+    nesting only appears when a phase times a sub-loop)."""
+    with _lock:
+        _section_stack.append(name)
+        _sections.setdefault(name, {"dispatches": 0, "compiles": 0})
+    try:
+        yield
+    finally:
+        with _lock:
+            _section_stack.pop()
+
+
+class _CountingJit:
+    """Proxy over the object ``jax.jit`` returned: ``__call__`` counts a
+    dispatch; everything else (``.lower()``, ``.trace()``, attributes)
+    forwards, so callers cannot tell the counter is there."""
+
+    def __init__(self, wrapped: Any) -> None:
+        self._kgtpu_wrapped = wrapped
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        _bump("dispatches")
+        return self._kgtpu_wrapped(*args, **kwargs)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._kgtpu_wrapped, name)
+
+
+def install() -> None:
+    """Swap ``jax.jit`` for the counting wrapper (idempotent)."""
+    global _installed, _orig_jit
+    if _installed:
+        return
+    import jax
+
+    _orig_jit = jax.jit
+
+    def counting_jit(fun: Any = None, *args: Any, **kwargs: Any) -> Any:
+        if fun is None:
+            # @jax.jit(static_argnums=...) decorator-factory form
+            def deco(f: Any) -> Any:
+                return counting_jit(f, *args, **kwargs)
+
+            return deco
+        cell = {"compiles": 0}
+        with _lock:
+            _compile_cells.append(cell)
+
+        def traced(*fargs: Any, **fkwargs: Any) -> Any:
+            # runs once per TRACE (jit caches by shape/dtype/static
+            # args), so each increment is one compilation
+            cell["compiles"] += 1
+            _bump("compiles")
+            return fun(*fargs, **fkwargs)
+
+        # partial/bound callables may lack __name__ etc; update_wrapper
+        # skips missing attributes, which is exactly what we want
+        functools.update_wrapper(traced, fun)
+        return _CountingJit(_orig_jit(traced, *args, **kwargs))
+
+    jax.jit = counting_jit
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    import jax
+
+    jax.jit = _orig_jit
+    _installed = False
+
+
+def counts() -> dict:
+    """Snapshot: per-section dispatch/compile counts plus the global
+    beyond-first-compile total."""
+    with _lock:
+        return {
+            "sections": {name: dict(c) for name, c in _sections.items()},
+            "recompiles_total": sum(
+                max(0, cell["compiles"] - 1) for cell in _compile_cells),
+        }
+
+
+def section_counts(name: str) -> Dict[str, int]:
+    with _lock:
+        return dict(_sections.get(name, {"dispatches": 0, "compiles": 0}))
+
+
+def _jax_usable() -> Optional[str]:
+    """None when jax can build arrays on some backend, else the reason —
+    the smoke must skip (rc 0), not fail, on a jax-less environment."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        jnp.zeros((1,)).block_until_ready()
+        del jax
+    except Exception as exc:  # noqa: BLE001 - any init failure = skip
+        return f"{type(exc).__name__}: {exc}"
+    return None
+
+
+def smoke(tokens: int = 8) -> int:
+    """Fixed-shape decode loop under the counter; prints the bench JSON
+    keys; rc 1 when the fixed-shape section recompiled after warmup."""
+    reason = _jax_usable()
+    if reason is not None:
+        print(json.dumps({"skipped": f"jax unusable: {reason}"}))
+        return 0
+    install()
+    reset()
+    import jax
+    import jax.numpy as jnp
+
+    # tiny decode-shaped step: fixed [S] token/pos vectors, carried
+    # cache, one jitted call per token — the shape discipline serve.py's
+    # _decode contract declares
+    def step(cache: Any, tok: Any, pos: Any) -> Any:
+        cache = cache + tok[None, :].astype(cache.dtype)
+        return cache, (tok + 1) % 7, pos + 1
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+    cache = jnp.zeros((4, 4), jnp.float32)
+    tok = jnp.zeros(4, jnp.int32)
+    pos = jnp.zeros(4, jnp.int32)
+    with section("warmup"):
+        cache, tok, pos = jstep(cache, tok, pos)
+    with section("decode_fixed"):
+        for _ in range(tokens):
+            cache, tok, pos = jstep(cache, tok, pos)
+        jax.block_until_ready(cache)
+    dec = section_counts("decode_fixed")
+    out = {
+        "decode_dispatches_per_token": dec["dispatches"] / tokens,
+        "serve_dispatches_per_token": dec["dispatches"] / tokens,
+        "workload_recompiles_total": counts()["recompiles_total"],
+        "decode_fixed_recompiles": dec["compiles"],
+    }
+    print(json.dumps(out))
+    if dec["compiles"] > 0:
+        print(f"error: fixed-shape decode section recompiled "
+              f"{dec['compiles']}x after warmup — a retrace hazard the "
+              "`# traced-shapes:` contracts should have caught")
+        return 1
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="jit dispatch/compile counter (device-boundary "
+                    "analyzer, dynamic half)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the fixed-shape decode smoke and gate "
+                             "on zero post-warmup recompiles")
+    parser.add_argument("--tokens", type=int, default=8,
+                        help="smoke decode-loop length (default 8)")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        return smoke(args.tokens)
+    parser.error("nothing to do: pass --smoke")
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

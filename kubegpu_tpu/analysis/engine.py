@@ -202,6 +202,10 @@ class Context:
         # rule name -> structured side-report (the hot-path rule's ranked
         # vectorization-blockers inventory rides here; --report renders it)
         self.reports: dict = {}
+        # rule name -> [{"path","line","used"}] for comment-waiver forms
+        # that are not `# analysis: disable=` (host-sync's
+        # `# host-sync: allowed`); unused-suppression audits these too
+        self.waiver_audits: dict = {}
 
 
 def _collect_files(root: str) -> list:

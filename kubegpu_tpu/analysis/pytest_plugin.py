@@ -16,6 +16,12 @@ Registered from ``tests/conftest.py`` (``pytest_plugins``). Two layers:
   or a package socket open FAILS at teardown, with the creation site
   in the message. Disable with ``KGTPU_LEAKGUARD=0`` (e.g. when
   bisecting an unrelated failure).
+
+* **dispatch counter** (opt-in, ``KGTPU_DISPATCHCOUNT=1``) — wraps
+  ``jax.jit`` via :mod:`kubegpu_tpu.analysis.dispatchcount` for the
+  whole session and prints the recompile inventory at the end. OFF by
+  default: it perturbs the jit seam, and the tier-1 suite must run
+  byte-identically with and without the analysis layer.
 """
 
 from __future__ import annotations
@@ -25,10 +31,11 @@ from typing import Iterator
 
 import pytest
 
-from kubegpu_tpu.analysis import leakguard, lockgraph
+from kubegpu_tpu.analysis import dispatchcount, leakguard, lockgraph
 
 _ENV_FLAG = "KGTPU_LOCKGRAPH"
 _LEAK_FLAG = "KGTPU_LEAKGUARD"
+_DISPATCH_FLAG = "KGTPU_DISPATCHCOUNT"
 
 
 def _enabled() -> bool:
@@ -39,16 +46,31 @@ def _leak_enabled() -> bool:
     return os.environ.get(_LEAK_FLAG, "1") not in ("0", "false", "no")
 
 
+def _dispatch_enabled() -> bool:
+    # opt-in, unlike the other two: wrapping jax.jit must never be on
+    # during a default tier-1 run
+    return os.environ.get(_DISPATCH_FLAG, "0") in ("1", "true", "yes")
+
+
 def pytest_configure(config: object) -> None:
     if _enabled():
         lockgraph.install()
     if _leak_enabled():
         leakguard.install()
+    if _dispatch_enabled():
+        try:
+            dispatchcount.install()
+        except Exception:
+            # no jax in this environment — the counter has nothing to
+            # wrap; the flag is best-effort by design
+            pass
 
 
 def pytest_unconfigure(config: object) -> None:
     lockgraph.uninstall()
     leakguard.uninstall()
+    if dispatchcount.installed():
+        dispatchcount.uninstall()
 
 
 @pytest.fixture(autouse=True)
@@ -81,6 +103,12 @@ def _kgtpu_leakguard(request: object) -> Iterator[None]:
 
 def pytest_terminal_summary(terminalreporter: object, exitstatus: int,
                             config: object) -> None:
+    if dispatchcount.installed():
+        snap = dispatchcount.counts()
+        terminalreporter.write_line(
+            f"dispatchcount: {snap['recompiles_total']} beyond-first "
+            f"recompile(s) across the session "
+            f"({len(snap['sections'])} section(s))")
     if not lockgraph.installed():
         return
     edges = len(lockgraph.GLOBAL_GRAPH.edges)

@@ -6,6 +6,8 @@ from __future__ import annotations
 from kubegpu_tpu.analysis.rules.charges import ChargePairing
 from kubegpu_tpu.analysis.rules.clocks import MonotonicTime
 from kubegpu_tpu.analysis.rules.codecs import CodecPairing
+from kubegpu_tpu.analysis.rules.deviceflow import (DonationDiscipline,
+                                                   HostSync, RetraceHazard)
 from kubegpu_tpu.analysis.rules.exceptions import NoSwallowedExceptions
 from kubegpu_tpu.analysis.rules.lifecycle import ResourceLifecycle
 from kubegpu_tpu.analysis.rules.locks import (LockDiscipline,
@@ -34,6 +36,9 @@ ALL_RULES = [
     TwinCoverage(),
     MirrorMaintenance(),
     ReasonParity(),
+    HostSync(),
+    RetraceHazard(),
+    DonationDiscipline(),
     # always ordered last by the engine: it audits what the others used
     UnusedSuppression(),
 ]

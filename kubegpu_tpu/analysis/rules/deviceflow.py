@@ -1,0 +1,1042 @@
+"""Device-boundary rules for the JAX workload layer.
+
+The serving gap this PR makes statically checkable: BENCH_r05 measured
+``serve_tokens_per_s`` 54.3 against ``decode_fixed_tokens_per_s`` 2931
+because the slot step pays a host dispatch round trip per token. Three
+rules over ``workload/`` (and any explicitly analyzed single file that
+imports jax) turn the device boundary into a contract:
+
+**host-sync** — a forward typestate pass over the per-function CFG
+tracks which values are TRACED (live on device: results of
+``jax.jit``-wrapped entry points, ``jnp.*``/``jax.*``/``lax.*``
+producers, and anything derived from them) and flags every
+host-materialization sink reached by a traced value *inside a
+per-iteration loop*: ``jax.device_get``, ``np.asarray``,
+``int()/float()/bool()``, ``.item()/.tolist()``, an ``if``/``while``
+test on a traced value (implicit blocking ``__bool__``), or iterating
+one. Sinks are findings unless annotated
+``# host-sync: allowed -- justification`` (the waiver is audited: one
+that no longer covers a boundary call is flagged stale by
+unused-suppression). ``--rule host-sync --report`` renders the ranked
+syncs-per-loop-iteration inventory — the serving-rewrite worklist, the
+same shape as ``hot-path --report``'s vectorization blockers.
+
+**retrace-hazard** — every ``jax.jit`` site must carry a checkable
+``# traced-shapes:`` contract declaring the traced argument shapes; a
+call site that feeds a jitted entry an argument whose Python-side shape
+varies per call (``.reshape(..., -1)``, an ``np.zeros``-built buffer
+with a non-constant dim) retraces per distinct shape and must be
+declared ``varies`` in the entry's contract (bucketing is the fix, and
+the contract is where the bucket story is written down). A jitted
+entry that closes over a local rebound *after* the wrap is flagged:
+the trace pinned the old value.
+
+**donation-discipline** — typestate on ``donate_argnums``: a donated
+buffer read on any CFG path after the call (before a rebind) is a
+use-after-donate finding, and a jitted state-threading step — one that
+returns a parameter it also takes (cache in/cache out, params
+in/params out) — that does NOT donate the carried position is flagged:
+each missed donation is a full HBM copy per step.
+
+Scope: a file is in scope iff it imports jax AND lives under a
+``workload`` tree (or is analyzed as an explicit single file) — the
+control plane has no device boundary and ``cmd/`` demos are host-paced
+by design. Function bodies handed to ``jax.jit`` are excluded from the
+host-sync pass: they run traced, where these sinks are errors jax
+itself raises.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from kubegpu_tpu.analysis import dataflow
+from kubegpu_tpu.analysis.engine import Finding, dotted_name, walk_functions
+
+WAIVER_RE = re.compile(r"#\s*host-sync:\s*allowed(?P<rest>.*)")
+CONTRACT_RE = re.compile(r"#\s*traced-shapes:(?P<spec>.*)")
+
+# dotted call names that move a traced value to host (block + transfer)
+_SINK_CALLS = frozenset({"jax.device_get", "np.asarray", "numpy.asarray",
+                         "np.array", "numpy.array", "onp.asarray"})
+# bare builtins that force a traced scalar onto the host
+_SINK_BUILTINS = frozenset({"int", "float", "bool"})
+# method calls on a traced value that materialize it
+_SINK_METHODS = frozenset({"item", "tolist"})
+# attribute reads that are host metadata, not device data
+_METADATA_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "sharding"})
+# producers whose results live on device
+_PRODUCER_PREFIXES = ("jnp.", "lax.", "jax.")
+# jnp./jax. calls that return host metadata (python ints/tuples), not
+# device arrays — the call itself never blocks
+_METADATA_CALLS = frozenset({"jnp.shape", "jnp.ndim", "jnp.size",
+                             "jax.eval_shape"})
+# device uploads counted as the report's secondary metric (H2D per
+# iteration): each is a separate host->device transfer the batched-
+# transfer rewrite folds together
+_H2D_CALLS = frozenset({"jnp.asarray", "jnp.array", "jax.device_put"})
+
+# call names never expanded through the per-iteration closure (the same
+# stance as racer's generic-name guard: `get` could be anything)
+_GENERIC = frozenset({
+    "append", "extend", "pop", "popitem", "insert", "remove", "add",
+    "get", "items", "keys", "values", "update", "setdefault", "copy",
+    "split", "join", "strip", "format", "sum", "min", "max", "len",
+    "range", "sorted", "reversed", "zip", "enumerate", "isinstance",
+    "int", "float", "bool", "str", "list", "dict", "set", "tuple",
+    "abs", "print", "move_to_end", "startswith", "endswith",
+})
+
+
+def _imports_jax(src) -> bool:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                return True
+    return False
+
+
+def _in_scope(src) -> bool:
+    """workload trees, plus explicit single-file invocations (fixtures,
+    `python -m kubegpu_tpu.analysis some_file.py`) — never cmd/ or the
+    control plane, which have no device boundary to police."""
+    if not ("workload" in src.relparts or len(src.relparts) == 1):
+        return False
+    return _imports_jax(src)
+
+
+# --------------------------------------------------------------------------
+# per-file device model
+
+
+class _JitEntry:
+    """One ``jax.jit(...)`` call: where it is, what it wraps, what it
+    donates, and the names its result is callable under."""
+
+    __slots__ = ("call", "stmt", "line", "wrapped_name", "donate",
+                 "keys", "contract")
+
+    def __init__(self, call: ast.Call, stmt: ast.stmt) -> None:
+        self.call = call
+        self.stmt = stmt
+        self.line = getattr(call, "lineno", stmt.lineno)
+        self.wrapped_name = None
+        if call.args and isinstance(call.args[0], ast.Name):
+            self.wrapped_name = call.args[0].id
+        self.donate: tuple = ()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                if isinstance(kw.value, ast.Tuple):
+                    self.donate = tuple(
+                        el.value for el in kw.value.elts
+                        if isinstance(el, ast.Constant))
+                elif isinstance(kw.value, ast.Constant):
+                    self.donate = (kw.value.value,)
+        self.keys: set = set()      # callable names: "draft_propose",
+        self.contract = None        # "self._decode", ...
+
+
+class _Sink:
+    __slots__ = ("line", "kind", "desc", "fn", "in_loop")
+
+    def __init__(self, line: int, kind: str, desc: str, fn: str) -> None:
+        self.line = line
+        self.kind = kind
+        self.desc = desc
+        self.fn = fn
+        self.in_loop = False
+
+
+class _FnInfo:
+    __slots__ = ("qualname", "name", "cfg", "sinks", "h2d", "loop_h2d",
+                 "loop_lines", "loop_calls", "all_calls", "node")
+
+    def __init__(self, qualname: str, node) -> None:
+        self.qualname = qualname
+        self.name = qualname.rsplit(".", 1)[-1]
+        self.node = node
+        self.cfg = None
+        self.sinks: list = []        # every _Sink in the function
+        self.h2d: list = []          # every H2D upload line
+        self.loop_h2d: list = []     # ... the subset inside an own loop
+        self.loop_lines: list = []   # header line per loop
+        self.loop_calls: set = set()  # simple call names inside loop bodies
+        self.all_calls: set = set()  # simple call names anywhere
+
+
+class _FileModel:
+    __slots__ = ("src", "entries", "wrapped_names", "functions",
+                 "waivers", "boundary_lines", "contracts")
+
+    def __init__(self, src) -> None:
+        self.src = src
+        self.entries: list = []
+        self.wrapped_names: set = set()
+        self.functions: dict = {}     # qualname -> _FnInfo
+        self.waivers: list = []       # (line, justified: bool)
+        self.boundary_lines: set = set()
+        self.contracts: list = []     # (line, spec)
+
+
+def _model(ctx, sources):
+    cached = getattr(ctx, "_deviceflow_model", None)
+    if cached is not None and cached[0] is sources:
+        return cached[1]
+    models = {s.path: _build_file_model(s)
+              for s in sources if _in_scope(s)}
+    ctx._deviceflow_model = (sources, models)
+    return models
+
+
+def _parent_stmt(tree):
+    """Map every ast node id to its nearest enclosing statement."""
+    owner: dict = {}
+
+    def visit(node, stmt):
+        for child in ast.iter_child_nodes(node):
+            child_stmt = child if isinstance(child, ast.stmt) else stmt
+            owner[id(child)] = child_stmt
+            visit(child, child_stmt)
+
+    visit(tree, None)
+    return owner
+
+
+def _collect_entries(model) -> None:
+    src = model.src
+    owner = _parent_stmt(src.tree)
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) == "jax.jit":
+            stmt = owner.get(id(node))
+            if stmt is None:
+                continue
+            entry = _JitEntry(node, stmt)
+            if entry.wrapped_name:
+                model.wrapped_names.add(entry.wrapped_name)
+            # callable keys: assignment targets of the wrapping statement
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        entry.keys.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        entry.keys.add(f"self.{tgt.attr}")
+            model.entries.append(entry)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if dotted_name(target) == "jax.jit":
+                    entry = _JitEntry(
+                        ast.Call(func=target, args=[], keywords=(
+                            dec.keywords if isinstance(dec, ast.Call)
+                            else [])), node)
+                    entry.line = node.lineno
+                    entry.wrapped_name = node.name
+                    entry.keys.add(node.name)
+                    model.wrapped_names.add(node.name)
+                    model.entries.append(entry)
+
+
+def _collect_comments(model) -> None:
+    lines = model.src.text.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = WAIVER_RE.search(text)
+        if m is not None:
+            # the waiver covers its own line (trailing form) or the
+            # next code line after its comment block (block form)
+            cover = i
+            for j in range(i, min(i + 8, len(lines))):
+                nxt = lines[j].strip()
+                if nxt and not nxt.startswith("#"):
+                    cover = j + 1
+                    break
+            model.waivers.append((i, "--" in m.group("rest"), cover))
+        m = CONTRACT_RE.search(text)
+        if m is not None:
+            model.contracts.append((i, m.group("spec").strip()))
+
+
+def _bind_contracts(model) -> list:
+    """Attach each ``# traced-shapes:`` comment to the jit statement it
+    annotates (trailing on any line of the statement, or above it with
+    only comment/decorator/blank lines between); return the orphans."""
+    lines = model.src.text.splitlines()
+    orphans = []
+    for cline, spec in model.contracts:
+        bound = None
+        for entry in model.entries:
+            lo = entry.stmt.lineno
+            hi = getattr(entry.stmt, "end_lineno", lo)
+            if lo <= cline <= hi:
+                bound = entry
+                break
+            if cline < lo:
+                gap = lines[cline:lo - 1]
+                if all(not g.strip() or g.strip().startswith(("#", "@"))
+                       for g in gap) and lo - cline <= 16:
+                    bound = entry
+                    break
+        if bound is not None:
+            bound.contract = spec
+        else:
+            orphans.append((cline, spec))
+    return orphans
+
+
+class _Typestate:
+    """Forward may-analysis: which local names / ``self.attr`` tokens
+    hold traced (device) values at each CFG point."""
+
+    def __init__(self, model: _FileModel, info: _FnInfo) -> None:
+        self.model = model
+        self.info = info
+        # a _Typestate lives entirely inside one rule-pool worker's
+        # run() call — never shared across threads
+        self.events: list = []  # racer: single-writer -- per-call local
+        self._node_idx = -1     # racer: single-writer -- per-call local
+
+    # -- expression evaluation (returns True when traced) -------------------
+
+    def _token(self, expr):
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return f"self.{expr.attr}"
+        return None
+
+    def _is_jit_call(self, func) -> bool:
+        tok = self._token(func)
+        if tok is None:
+            return False
+        return any(tok in e.keys for e in self.model.entries)
+
+    def _eval(self, expr, state: set, record) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in state
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _METADATA_ATTRS:
+                self._eval(expr.value, state, record)
+                return False
+            tok = self._token(expr)
+            if tok is not None:
+                return tok in state
+            return self._eval(expr.value, state, record)
+        if isinstance(expr, ast.Subscript):
+            self._eval(expr.slice, state, record)
+            return self._eval(expr.value, state, record)
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, state, record)
+        if isinstance(expr, (ast.BinOp,)):
+            left = self._eval(expr.left, state, record)
+            right = self._eval(expr.right, state, record)
+            return left or right
+        if isinstance(expr, ast.UnaryOp):
+            return self._eval(expr.operand, state, record)
+        if isinstance(expr, ast.BoolOp):
+            return any([self._eval(v, state, record) for v in expr.values])
+        if isinstance(expr, ast.Compare):
+            vals = [expr.left] + list(expr.comparators)
+            return any([self._eval(v, state, record) for v in vals])
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any([self._eval(e, state, record) for e in expr.elts])
+        if isinstance(expr, ast.IfExp):
+            self._eval(expr.test, state, record)
+            a = self._eval(expr.body, state, record)
+            b = self._eval(expr.orelse, state, record)
+            return a or b
+        if isinstance(expr, ast.Starred):
+            return self._eval(expr.value, state, record)
+        if isinstance(expr, (ast.JoinedStr, ast.FormattedValue)):
+            for child in ast.iter_child_nodes(expr):
+                self._eval(child, state, record)
+            return False
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            # comprehension vars are fresh; evaluate the iterables only
+            for gen in expr.generators:
+                self._eval(gen.iter, state, record)
+            return False
+        if isinstance(expr, ast.Dict):
+            return any([self._eval(v, state, record)
+                        for v in list(expr.keys) + list(expr.values)
+                        if v is not None])
+        if isinstance(expr, (ast.Lambda, ast.Constant)):
+            return False
+        return False
+
+    def _eval_call(self, call: ast.Call, state: set, record) -> bool:
+        name = dotted_name(call.func)
+        args_traced = [self._eval(a, state, record) for a in call.args]
+        for kw in call.keywords:
+            args_traced.append(self._eval(kw.value, state, record))
+        any_traced = any(args_traced)
+
+        if name in _SINK_CALLS or (name in _SINK_BUILTINS and
+                                   isinstance(call.func, ast.Name)):
+            if any_traced and record:
+                self.events.append((self._node_idx, _Sink(
+                    call.lineno, "call",
+                    f"{name}() materializes a traced value on host",
+                    self.info.qualname)))
+            return False
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _SINK_METHODS:
+            if self._eval(call.func.value, state, record) and record:
+                self.events.append((self._node_idx, _Sink(
+                    call.lineno, "method",
+                    f".{call.func.attr}() materializes a traced value "
+                    "on host", self.info.qualname)))
+            return False
+        if name in _METADATA_CALLS:
+            return False
+        if name is not None and name.startswith(_PRODUCER_PREFIXES):
+            if record and name in _H2D_CALLS and not any_traced:
+                self.events.append((self._node_idx, ("h2d", call.lineno)))
+            return True
+        if self._is_jit_call(call.func):
+            return True
+        # unknown call: traced in -> assume traced out (helper wrappers
+        # like decode._select_token stay device-side)
+        return any_traced
+
+    # -- statement transfer --------------------------------------------------
+
+    def _assign_target(self, tgt, traced: bool, state: set) -> None:
+        tok = self._token(tgt)
+        if tok is not None:
+            if traced:
+                state.add(tok)
+            else:
+                state.discard(tok)
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._assign_target(el, traced, state)
+        # subscript/attribute-chain stores mutate containers, not bindings
+
+    def transfer(self, node, state: set, record: bool) -> set:
+        state = set(state)
+        self._node_idx = node.idx
+        stmt = node.stmt
+        if stmt is None or node.kind not in ("stmt", "handler"):
+            return state
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state
+        if isinstance(stmt, ast.Assign):
+            traced = self._eval(stmt.value, state, record)
+            # tuple-unpack of one call result: every target inherits
+            for tgt in stmt.targets:
+                self._assign_target(tgt, traced, state)
+            return state
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            traced = self._eval(stmt.value, state, record)
+            self._assign_target(stmt.target, traced, state)
+            return state
+        if isinstance(stmt, ast.AugAssign):
+            traced = self._eval(stmt.value, state, record)
+            tok = self._token(stmt.target)
+            if tok is not None and (traced or tok in state):
+                state.add(tok)
+            return state
+        if isinstance(stmt, (ast.If, ast.While)):
+            test = stmt.test
+            traced = self._eval(test, state, record)
+            if traced and self._bare_device_test(test, state) and record:
+                self.events.append((self._node_idx, _Sink(
+                    test.lineno, "implicit",
+                    "branching on a traced value forces a blocking "
+                    "host sync (implicit bool())", self.info.qualname)))
+            return state
+        if isinstance(stmt, ast.For) and node.kind == "stmt" and \
+                node.effect:
+            traced = self._eval(stmt.iter, state, record)
+            if traced and self._token(stmt.iter) is not None and record:
+                self.events.append((self._node_idx, _Sink(
+                    stmt.iter.lineno, "implicit",
+                    "iterating a traced value materializes it on host",
+                    self.info.qualname)))
+            self._assign_target(stmt.target, traced, state)
+            return state
+        # effect_asts yields header sub-EXPRESSIONS for compound
+        # statements but the whole STATEMENT for simple ones — unwrap
+        # the simple forms so a bare `log.append(float(x))` still sinks
+        for sub in node.effect_asts():
+            if isinstance(sub, ast.expr):
+                self._eval(sub, state, record)
+            elif isinstance(sub, ast.Expr):
+                self._eval(sub.value, state, record)
+            elif isinstance(sub, ast.Return) and sub.value is not None:
+                self._eval(sub.value, state, record)
+            elif isinstance(sub, ast.Assert):
+                self._eval(sub.test, state, record)
+        return state
+
+    @staticmethod
+    def _bare_device_test(test, state) -> bool:
+        """Only a test that IS a traced value (or a comparison of one)
+        blocks; `x is None` / `len(x)` style tests do not."""
+        if isinstance(test, ast.Name):
+            return test.id in state
+        if isinstance(test, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return False
+            return True
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return _Typestate._bare_device_test(test.operand, state)
+        return False
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> None:
+        cfg = self.info.cfg
+        in_states: dict = {n.idx: set() for n in cfg.nodes}
+        work = [cfg.entry.idx]
+        out_cache: dict = {}
+        while work:
+            idx = work.pop()
+            node = cfg.nodes[idx]
+            out = frozenset(self.transfer(node, in_states[idx], False))
+            if out_cache.get(idx) == out:
+                continue
+            out_cache[idx] = out
+            for edge in cfg.succs.get(idx, []):
+                dst = edge.dst
+                before = len(in_states[dst])
+                merged = in_states[dst] | out
+                if len(merged) != before or dst not in out_cache:
+                    in_states[dst] = merged
+                    work.append(dst)
+        # final pass: record events with the converged in-states
+        for node in cfg.nodes:
+            self.transfer(node, in_states[node.idx], True)
+
+
+def _build_file_model(src) -> _FileModel:
+    model = _FileModel(src)
+    _collect_entries(model)
+    _collect_comments(model)
+
+    for i, text in enumerate(src.text.splitlines(), start=1):
+        # syntactic boundary calls, for the waiver-usage audit: a waiver
+        # is "used" while a boundary call remains on its line(s)
+        if re.search(r"device_get|asarray\(|\.item\(\)|\.tolist\(\)"
+                     r"|\bint\(|\bfloat\(|\bbool\(", text):
+            model.boundary_lines.add(i)
+
+    for qualname, fn in walk_functions(src.tree):
+        parts = qualname.split(".")
+        if any(p in model.wrapped_names for p in parts):
+            continue  # jitted bodies run traced — not host code
+        info = _FnInfo(qualname, fn)
+        info.cfg = dataflow.build_cfg(fn)
+        ts = _Typestate(model, info)
+        ts.run()
+        loop_body_nodes: set = set()
+        for loop in info.cfg.loops:
+            loop_body_nodes |= set(loop.body_nodes)
+            loop_body_nodes.add(loop.header)
+            info.loop_lines.append(loop.stmt.lineno)
+            for idx in loop.body_nodes:
+                for sub in info.cfg.nodes[idx].effect_asts():
+                    for cname in dataflow.call_names(sub):
+                        simple = cname.rsplit(".", 1)[-1]
+                        if simple not in _GENERIC:
+                            info.loop_calls.add(simple)
+        for node_idx, ev in ts.events:
+            in_loop = node_idx in loop_body_nodes
+            if isinstance(ev, _Sink):
+                ev.in_loop = in_loop
+                info.sinks.append(ev)
+            else:
+                _, line = ev
+                info.h2d.append(line)
+                if in_loop:
+                    info.loop_h2d.append(line)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                cname = dotted_name(node.func)
+                if cname:
+                    simple = cname.rsplit(".", 1)[-1]
+                    if simple not in _GENERIC:
+                        info.all_calls.add(simple)
+        model.functions[qualname] = info
+    return model
+
+
+def _name_map(models) -> dict:
+    """simple function name -> [(path, _FnInfo)] over every in-scope
+    file (the closure is name-keyed, like CallGraph: an over-
+    approximation that trades precision for zero config)."""
+    by_name: dict = {}
+    for path, model in models.items():
+        for info in model.functions.values():
+            by_name.setdefault(info.name, []).append((path, model, info))
+    return by_name
+
+
+def _expand(seeds: set, by_name: dict) -> set:
+    """Transitive closure of callee names through in-scope functions."""
+    closure = set(seeds)
+    work = list(seeds)
+    while work:
+        name = work.pop()
+        for _, _, info in by_name.get(name, []):
+            for callee in info.all_calls:
+                if callee not in closure:
+                    closure.add(callee)
+                    work.append(callee)
+    return frozenset(closure)
+
+
+def _waived(model, line: int) -> bool:
+    return any(line in (wline, cover) and justified
+               for wline, justified, cover in model.waivers)
+
+
+# --------------------------------------------------------------------------
+# rule 1: host-sync
+
+
+class HostSync:
+    """Traced values crossing to host inside a per-iteration loop."""
+
+    name = "host-sync"
+    description = ("traced JAX values must not cross to host inside a "
+                   "per-token/per-step loop — each sink is a blocking "
+                   "dispatch RTT; waive with "
+                   "`# host-sync: allowed -- <why>`")
+
+    def run(self, sources, ctx):
+        findings: list = []
+        models = _model(ctx, sources)
+        by_name = _name_map(models)
+        audits = getattr(ctx, "waiver_audits", None)
+        if audits is not None:
+            audits.setdefault(self.name, [])
+
+        # the per-iteration closure: every function reachable from a
+        # call inside some in-scope loop body
+        loop_seeds: set = set()
+        for model in models.values():
+            for info in model.functions.values():
+                loop_seeds |= info.loop_calls
+        per_iteration = _expand(loop_seeds, by_name)
+
+        report_roots: list = []
+        for path, model in sorted(models.items()):
+            for info in model.functions.values():
+                for sink in info.sinks:
+                    if not (sink.in_loop or info.name in per_iteration):
+                        continue
+                    if _waived(model, sink.line):
+                        continue
+                    findings.append(Finding(
+                        self.name, path, sink.line,
+                        f"{sink.desc} inside a per-iteration loop "
+                        f"({info.qualname}); batch the transfer or "
+                        "annotate `# host-sync: allowed -- <why>`"))
+            # malformed waiver: the justification is the contract
+            for wline, justified, cover in model.waivers:
+                if not justified:
+                    findings.append(Finding(
+                        self.name, path, wline,
+                        "host-sync waiver without a justification — "
+                        "write `# host-sync: allowed -- <why>`"))
+                if audits is not None:
+                    used = any(b in (wline, cover)
+                               for b in model.boundary_lines)
+                    audits[self.name].append(
+                        {"path": path, "line": wline, "used": used})
+            # report: one entry per loop root, aggregating its own
+            # in-loop sinks plus every sink of the per-iteration callees
+            for info in model.functions.values():
+                if not info.cfg.loops:
+                    continue
+                sites: dict = {}
+                for sink in info.sinks:
+                    if sink.in_loop:
+                        sites[(path, sink.line)] = (sink, model)
+                closure = _expand(info.loop_calls, by_name)
+                for callee in closure:
+                    for cpath, cmodel, cinfo in by_name.get(callee, []):
+                        for sink in cinfo.sinks:
+                            sites[(cpath, sink.line)] = (sink, cmodel)
+                if not sites:
+                    continue
+                # uploads per iteration: own in-loop H2D plus every
+                # upload of the per-iteration callees (their whole body
+                # runs each iteration of this root's loop)
+                h2d = len(info.loop_h2d)
+                for callee in closure:
+                    for _, _, cinfo in by_name.get(callee, []):
+                        h2d += len(cinfo.h2d)
+                report_roots.append({
+                    "function": info.qualname,
+                    "path": path,
+                    "line": info.loop_lines[0] if info.loop_lines else
+                    info.node.lineno,
+                    "syncs_per_iteration": len(sites),
+                    "h2d_per_iteration": h2d,
+                    "sites": [
+                        {"path": p, "line": ln, "desc": s.desc,
+                         "function": s.fn, "waived": _waived(m, ln)}
+                        for (p, ln), (s, m) in sorted(sites.items())],
+                })
+        report_roots.sort(key=lambda r: (-r["syncs_per_iteration"],
+                                         -r["h2d_per_iteration"],
+                                         r["path"], r["line"]))
+        ctx.reports[self.name] = {"roots": report_roots}
+        return findings
+
+
+def render_report(report: dict) -> str:
+    """Human rendering of the host-sync inventory (``--report``): the
+    serving-rewrite worklist, ranked by syncs per loop iteration."""
+    lines = ["host-sync report: host round trips per loop iteration",
+             "(rank 1 = the loop paying the most dispatch RTTs per "
+             "token — the rewrite target)", ""]
+    if not report.get("roots"):
+        lines.append("  no per-iteration host syncs found")
+        return "\n".join(lines)
+    for rank, root in enumerate(report["roots"], start=1):
+        lines.append(
+            f"  #{rank} {root['function']} ({root['path']}:{root['line']})"
+            f" — {root['syncs_per_iteration']} sync(s) + "
+            f"{root['h2d_per_iteration']} upload(s) per iteration")
+        for site in root["sites"]:
+            mark = " [waived]" if site["waived"] else ""
+            lines.append(f"       {site['path']}:{site['line']}: "
+                         f"{site['desc']} ({site['function']}){mark}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# rule 2: retrace-hazard
+
+
+def _shape_hazard(expr) -> str | None:
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "reshape":
+            for a in node.args:
+                if isinstance(a, ast.UnaryOp) and \
+                        isinstance(a.op, ast.USub) and \
+                        isinstance(a.operand, ast.Constant) and \
+                        a.operand.value == 1:
+                    return "reshape(..., -1) infers a data-dependent dim"
+                if isinstance(a, ast.Constant) and a.value == -1:
+                    return "reshape(..., -1) infers a data-dependent dim"
+        name = dotted_name(func)
+        if name in ("np.zeros", "np.empty", "np.ones", "np.full",
+                    "numpy.zeros", "numpy.empty"):
+            shape = node.args[0] if node.args else None
+            if isinstance(shape, ast.Tuple) and any(
+                    not isinstance(el, ast.Constant) for el in shape.elts):
+                return "host buffer whose shape varies per call"
+    return None
+
+
+class RetraceHazard:
+    """jax.jit entry points without shape contracts, and call sites
+    feeding them shapes that vary per call."""
+
+    name = "retrace-hazard"
+    description = ("every jax.jit site carries a `# traced-shapes:` "
+                   "contract; call sites feeding per-call-varying "
+                   "shapes must be declared `varies` (bucketed)")
+
+    def run(self, sources, ctx):
+        findings: list = []
+        models = _model(ctx, sources)
+        for path, model in sorted(models.items()):
+            orphans = _bind_contracts(model)
+            for line, _spec in orphans:
+                findings.append(Finding(
+                    self.name, path, line,
+                    "`# traced-shapes:` contract binds to no jax.jit "
+                    "site (stale — move or delete it)"))
+            for entry in model.entries:
+                label = entry.wrapped_name or \
+                    (sorted(entry.keys)[0] if entry.keys else "<lambda>")
+                if entry.contract is None:
+                    findings.append(Finding(
+                        self.name, path, entry.line,
+                        f"jax.jit entry `{label}` has no `# traced-"
+                        "shapes:` contract; declare the traced argument "
+                        "shapes (append `varies` when a shape is "
+                        "data-dependent and bucketed)"))
+                elif not entry.contract:
+                    findings.append(Finding(
+                        self.name, path, entry.line,
+                        f"empty `# traced-shapes:` contract on `{label}`"
+                        " — declare the shapes or delete the comment"))
+            findings.extend(self._call_site_hazards(path, model))
+            findings.extend(self._mutated_closures(path, model))
+        return findings
+
+    def _call_site_hazards(self, path, model):
+        out = []
+        key_to_entry: dict = {}
+        for entry in model.entries:
+            for key in entry.keys:
+                key_to_entry[key] = entry
+        for info in model.functions.values():
+            # own-body walks: a nested def is its own _FnInfo — walking
+            # it from the parent too would double-report every call
+            assigns: list = []  # (lineno, name, value)
+            for node in _own_body_walk(info.node):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            assigns.append((node.lineno, tgt.id,
+                                            node.value))
+            for node in _own_body_walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                tok = _Typestate(model, info)._token(node.func)
+                entry = key_to_entry.get(tok) if tok else None
+                if entry is None:
+                    continue
+                for arg in node.args:
+                    why = _shape_hazard(arg)
+                    if why is None:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Name):
+                                prior = [v for ln, n, v in assigns
+                                         if n == sub.id and
+                                         ln < node.lineno]
+                                if prior:
+                                    why = _shape_hazard(prior[-1])
+                                if why:
+                                    break
+                    if why is None:
+                        continue
+                    contract = entry.contract or ""
+                    if "varies" in contract:
+                        continue
+                    label = entry.wrapped_name or tok
+                    out.append(Finding(
+                        self.name, path, node.lineno,
+                        f"argument to jitted `{label}` has a data-"
+                        f"dependent shape ({why}); every distinct shape "
+                        "retraces — bucket it and declare `varies` in "
+                        "the entry's `# traced-shapes:` contract"))
+                    break
+        return out
+
+    def _mutated_closures(self, path, model):
+        """A jitted nested def reading an enclosing local that is
+        rebound AFTER the jit wrap: the trace pinned the old value."""
+        out = []
+        for info in model.functions.values():
+            wrapped_here = [e for e in model.entries
+                            if e.wrapped_name and
+                            any(isinstance(s, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)) and
+                                s.name == e.wrapped_name
+                                for s in ast.walk(info.node))]
+            if not wrapped_here:
+                continue
+            for entry in wrapped_here:
+                wrapped_def = next(
+                    (s for s in ast.walk(info.node)
+                     if isinstance(s, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) and
+                     s.name == entry.wrapped_name), None)
+                if wrapped_def is None or \
+                        entry.stmt.lineno < wrapped_def.lineno:
+                    continue
+                # closure reads = Loads minus params minus the wrapped
+                # def's own locals (anything it Stores, incl.
+                # comprehension targets)
+                bound = {a.arg for a in wrapped_def.args.args}
+                bound |= {n.id for n in ast.walk(wrapped_def)
+                          if isinstance(n, ast.Name) and
+                          isinstance(n.ctx, ast.Store)}
+                reads = {n.id for n in ast.walk(wrapped_def)
+                         if isinstance(n, ast.Name) and
+                         isinstance(n.ctx, ast.Load)} - bound
+                # only rebinds in the ENCLOSING function's own body count
+                # — an Assign inside a sibling nested def is a different
+                # scope, not a mutation of the closed-over cell
+                for node in _own_body_walk(info.node):
+                    if isinstance(node, ast.Assign) and \
+                            node.lineno > entry.stmt.lineno:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name) and \
+                                    tgt.id in reads and \
+                                    tgt.id != entry.wrapped_name:
+                                out.append(Finding(
+                                    self.name, path, node.lineno,
+                                    f"`{tgt.id}` is rebound after "
+                                    f"`{entry.wrapped_name}` was jitted "
+                                    "over it — the trace pinned the old "
+                                    "value; thread it as an argument"))
+        return out
+
+
+# --------------------------------------------------------------------------
+# rule 3: donation-discipline
+
+
+class DonationDiscipline:
+    """Use-after-donate, and state-threading steps that skip donation."""
+
+    name = "donation-discipline"
+    description = ("donated buffers are invalid after the call "
+                   "(use-after-donate), and a jitted step threading "
+                   "state in and out must donate the carried position")
+
+    def run(self, sources, ctx):
+        findings: list = []
+        models = _model(ctx, sources)
+        for path, model in sorted(models.items()):
+            findings.extend(self._missed_donations(path, model))
+            findings.extend(self._use_after_donate(path, model))
+        return findings
+
+    def _missed_donations(self, path, model):
+        out = []
+        defs = {}
+        for node in ast.walk(model.src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        for entry in model.entries:
+            fn = defs.get(entry.wrapped_name or "")
+            if fn is None:
+                continue
+            params = [a.arg for a in fn.args.args if a.arg != "self"]
+            returned: set = set()
+            for node in _own_body_walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    val = node.value
+                    elts = val.elts if isinstance(val, ast.Tuple) else [val]
+                    for el in elts:
+                        if isinstance(el, ast.Name):
+                            returned.add(el.id)
+            for i, p in enumerate(params):
+                if p in returned and i not in entry.donate:
+                    out.append(Finding(
+                        self.name, path, entry.line,
+                        f"jitted step `{entry.wrapped_name}` threads "
+                        f"`{p}` (arg {i}) in and out without donating "
+                        "it — every call copies the buffer; add "
+                        f"donate_argnums=({i},)"))
+        return out
+
+    def _use_after_donate(self, path, model):
+        out = []
+        key_to_entry: dict = {}
+        for entry in model.entries:
+            for key in entry.keys:
+                key_to_entry[key] = entry
+        for info in model.functions.values():
+            ts = _Typestate(model, info)
+            cfg = info.cfg
+            for node in cfg.nodes:
+                if node.kind != "stmt" or node.stmt is None:
+                    continue
+                for sub in node.effect_asts():
+                    for call in ast.walk(sub):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        tok = ts._token(call.func)
+                        entry = key_to_entry.get(tok) if tok else None
+                        if entry is None or not entry.donate:
+                            continue
+                        for i in entry.donate:
+                            if i >= len(call.args):
+                                continue
+                            donated = ts._token(call.args[i])
+                            if donated is None:
+                                continue
+                            if self._rebound_here(node.stmt, donated):
+                                continue
+                            bad = self._read_before_rebind(
+                                cfg, node, donated, ts)
+                            if bad is not None:
+                                out.append(Finding(
+                                    self.name, path, bad,
+                                    f"`{donated}` was donated to "
+                                    f"`{tok}` (donate_argnums) and is "
+                                    "read here before being rebound — "
+                                    "donated buffers are invalid after "
+                                    "the call"))
+        return out
+
+    @staticmethod
+    def _rebound_here(stmt, token: str) -> bool:
+        if not isinstance(stmt, ast.Assign):
+            return False
+        for tgt in stmt.targets:
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            for el in elts:
+                if isinstance(el, ast.Name) and el.id == token:
+                    return True
+                if isinstance(el, ast.Attribute) and \
+                        isinstance(el.value, ast.Name) and \
+                        el.value.id == "self" and \
+                        f"self.{el.attr}" == token:
+                    return True
+        return False
+
+    def _read_before_rebind(self, cfg, start, token: str, ts):
+        """BFS the CFG from the donating call: a Load of ``token`` on
+        any path before an Assign to it is a use-after-donate; return
+        the offending line (or None)."""
+        seen = {start.idx}
+        work = [e.dst for e in cfg.succs.get(start.idx, [])]
+        while work:
+            idx = work.pop()
+            if idx in seen:
+                continue
+            seen.add(idx)
+            node = cfg.nodes[idx]
+            read = self._reads(node, token, ts)
+            if read is not None:
+                return read
+            if node.stmt is not None and \
+                    self._rebound_here(node.stmt, token):
+                continue  # rebound: this path is clean
+            for e in cfg.succs.get(idx, []):
+                work.append(e.dst)
+        return None
+
+    @staticmethod
+    def _reads(node, token: str, ts) -> int | None:
+        for sub in node.effect_asts():
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, ast.Load) and n.id == token:
+                    return n.lineno
+                if isinstance(n, ast.Attribute) and \
+                        isinstance(n.ctx, ast.Load) and \
+                        isinstance(n.value, ast.Name) and \
+                        n.value.id == "self" and \
+                        f"self.{n.attr}" == token:
+                    return n.lineno
+        return None
+
+
+def _own_body_walk(fn):
+    """Walk a function body without descending into nested defs."""
+    work = list(ast.iter_child_nodes(fn))
+    while work:
+        node = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        work.extend(ast.iter_child_nodes(node))

@@ -16,6 +16,11 @@ during this invocation; this rule (always run last) flags:
 
 Suppressions naming rules excluded by ``--select`` are left alone: the
 evidence to audit them was not collected.
+
+Rules with their own waiver grammar register usage evidence in
+``ctx.waiver_audits`` (host-sync's ``# host-sync: allowed -- why``);
+those waivers are audited here under the same gate: only when the
+owning rule ran, a waiver covering no boundary call is stale.
 """
 
 from __future__ import annotations
@@ -59,3 +64,13 @@ class UnusedSuppression:
                             f"suppression of `{rule}` no longer "
                             f"suppresses anything here; remove it (the "
                             f"waived invariant may have been fixed)")
+        for rule, audits in sorted(ctx.waiver_audits.items()):
+            if rule not in ran:
+                continue  # no evidence collected this invocation
+            for audit in audits:
+                if not audit["used"]:
+                    yield Finding(
+                        self.name, audit["path"], audit["line"],
+                        f"`# {rule}: allowed` waiver no longer covers a "
+                        "boundary call; remove it (the waived sync may "
+                        "have been fixed)")

@@ -13,18 +13,19 @@ from __future__ import annotations
 import json
 import logging
 import os
+from typing import Any, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-def _flatten(tree):
+def _flatten(tree: Any) -> Tuple[List[Any], Any]:
     leaves, treedef = jax.tree.flatten(tree)
     return leaves, treedef
 
 
-def save_checkpoint(path: str, state, step: int) -> str:
+def save_checkpoint(path: str, state: Any, step: int) -> str:
     """Write ``state`` (any pytree) at ``path``; returns the final path."""
     try:
         import orbax.checkpoint as ocp
@@ -39,7 +40,7 @@ def save_checkpoint(path: str, state, step: int) -> str:
     return full
 
 
-def _save_numpy(path: str, state, step: int) -> str:
+def _save_numpy(path: str, state: Any, step: int) -> str:
     """Atomic: write into a temp dir, then rename — a pod SIGKILLed
     mid-save must never leave a half-written ``step_N`` that the
     replacement pod picks as latest and dies on (crash loop)."""
@@ -59,7 +60,7 @@ def _save_numpy(path: str, state, step: int) -> str:
     return full
 
 
-def restore_checkpoint(path: str, like):
+def restore_checkpoint(path: str, like: Any) -> Tuple[Any, int]:
     """Restore the NEWEST readable ``step_*`` under ``path`` into the
     structure of ``like``; returns (state, step) or (None, -1) when
     absent. A corrupt/partial newest step (crashed writer, torn copy)
@@ -99,7 +100,7 @@ def restore_checkpoint(path: str, like):
 
             ckpt = ocp.StandardCheckpointer()
 
-            def abstract(x):
+            def abstract(x: Any) -> jax.ShapeDtypeStruct:
                 # carry the live shardings so orbax restores each leaf
                 # straight onto the mesh layout `like` uses (without
                 # this it falls back to the saved-topology layout, which

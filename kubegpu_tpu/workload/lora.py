@@ -118,7 +118,11 @@ def make_lora_train_step(cfg: TransformerConfig, mesh, rank: int,
         return lora, opt_state, loss
 
     if mesh is None:
-        return jax.jit(step)
+        # donate the carried adapters + optimizer state exactly as the
+        # mesh path below; the frozen base params (arg 2) stay undonated
+        # traced-shapes: lora/opt_state adapter pytrees fixed by
+        # cfg+rank; params pytree fixed by cfg; tokens [B, S] int32
+        return jax.jit(step, donate_argnums=(0, 1))
     from jax.sharding import NamedSharding, PartitionSpec
 
     def named(tree):
@@ -133,6 +137,8 @@ def make_lora_train_step(cfg: TransformerConfig, mesh, rank: int,
     # resharding
     l_shard = named(lora_pspecs(cfg, targets))
     batch_shard = NamedSharding(mesh, spmd.batch_pspec())
+    # traced-shapes: lora/opt_state adapter pytrees fixed by cfg+rank;
+    # params pytree fixed by cfg; tokens [B, S] int32
     return jax.jit(
         step,
         in_shardings=(l_shard, None, p_shard, batch_shard),

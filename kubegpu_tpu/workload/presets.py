@@ -21,12 +21,14 @@ the TPU build's workload families, every one exercised by tests.
 
 from __future__ import annotations
 
+from typing import Any, Dict
+
 from kubegpu_tpu.workload.model import TransformerConfig
 
-_BASE = dict(vocab=512, d_model=128, n_heads=8, n_layers=2, d_ff=384,
-             max_seq=512)
+_BASE: Dict[str, Any] = dict(vocab=512, d_model=128, n_heads=8,
+                             n_layers=2, d_ff=384, max_seq=512)
 
-PRESETS = {
+PRESETS: Dict[str, Dict[str, Any]] = {
     "dense": dict(_BASE),
     "gqa": dict(_BASE, n_kv_heads=2),
     "windowed": dict(_BASE, attn_window=64),
@@ -36,11 +38,11 @@ PRESETS = {
 }
 
 
-def preset_names() -> list:
+def preset_names() -> list[str]:
     return sorted(PRESETS)
 
 
-def make_config(name: str, **overrides) -> TransformerConfig:
+def make_config(name: str, **overrides: Any) -> TransformerConfig:
     """Build a preset's config; keyword overrides win (e.g. d_model)."""
     if name not in PRESETS:
         raise KeyError(

@@ -125,9 +125,11 @@ class DecodeServer:
         self._next_rid = 0
         self._tick = 0
 
-        def prefill(params, cache, tokens, slot, true_len):
+        def prefill(params, cache, tokens, slot, true_len, key):
             """Pad-to-bucket prompt pass for ONE slot; returns the updated
-            big cache and the logits row at the prompt's true end."""
+            big cache and the slot's sampled first token. Selection runs
+            inside the trace so admission pays ONE scalar readback, not a
+            vocab-row transfer + eager select per request."""
             small = init_cache(cfg, 1, tokens.shape[1])
             logits, small = self._fstep(params, small, tokens, 0)
             new_cache = []
@@ -137,11 +139,16 @@ class DecodeServer:
                         big[k], sm[k], (slot, 0, 0, 0)) for k in ("k", "v")})
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], true_len - 1, axis=0, keepdims=False)
-            return new_cache, last
+            first = _select_token(last[None, :], key, self.temperature,
+                                  self.top_k, self.top_p)[0]
+            return new_cache, first.astype(jnp.int32)
 
         # donate the cache: it is threaded through every call and the old
         # reference is dropped on reassignment, so XLA updates it in
         # place instead of copying the whole multi-slot cache per token
+        # traced-shapes: tokens [1, bucket] int32 — varies per prefill
+        # bucket (one trace per bucket by design); slot/true_len scalar
+        # int32, key [2] uint32
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
         # -- prefix reuse: stored K/V of previously-served prompts lets a
@@ -162,10 +169,12 @@ class DecodeServer:
         self.prefix_misses = 0
 
         def rem_prefill(params, cache, stored, rem_tokens, slot, plen,
-                        rem_true):
+                        rem_true, key):
             """Splice a stored prefix (``[1, b, ...]`` per layer) into a
             fresh row, run the remainder chunk at position ``plen``, and
-            write the row back into the big cache at ``slot``."""
+            write the row back into the big cache at ``slot``; returns
+            the cache and the sampled first token (device-side selection,
+            as in ``prefill``)."""
             s_max = cache[0]["k"].shape[1]
             row = []
             for big, st in zip(cache, stored):
@@ -182,8 +191,13 @@ class DecodeServer:
                         big[k], rw[k], (slot, 0, 0, 0)) for k in ("k", "v")})
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], rem_true - 1, axis=0, keepdims=False)
-            return new_cache, last
+            first = _select_token(last[None, :], key, self.temperature,
+                                  self.top_k, self.top_p)[0]
+            return new_cache, first.astype(jnp.int32)
 
+        # traced-shapes: rem_tokens [1, bucket] int32 — varies per
+        # remainder bucket; stored pytree [1, plen_bucket] per layer —
+        # varies per stored-prefix bucket; scalars int32, key [2] uint32
         self._rem_prefill = jax.jit(rem_prefill, donate_argnums=(1,))
 
         def snapshot_prefix(cache, slot, b: int):
@@ -205,6 +219,8 @@ class DecodeServer:
                                 self.top_k, self.top_p)
             return cache, nxt.astype(jnp.int32)
 
+        # traced-shapes: tok/pos [S] int32, key [2] uint32 — fixed per
+        # server (S = slots), one trace for the server's lifetime
         self._decode = jax.jit(decode, donate_argnums=(1,))
 
         # -- speculative mode: a draft model proposes k tokens per slot,
@@ -296,7 +312,11 @@ class DecodeServer:
                     greedy, n_acc[:, None], axis=1)[:, 0]
                 return cache, n_acc, extra
 
+            # traced-shapes: prev/tok/pos [S] int32, key [2] uint32 —
+            # fixed per server, one trace for the server's lifetime
             self._spec_propose = jax.jit(spec_propose, donate_argnums=(1,))
+            # traced-shapes: chunk [S, k+1] int32, pos [S] int32, q_rows
+            # [S, k, V] f32 (or scalar when greedy) — fixed per server
             self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
 
             def dprefill(dparams, dcache, tokens, slot):
@@ -310,6 +330,8 @@ class DecodeServer:
                         for kk in ("k", "v")})
                 return new_cache
 
+            # traced-shapes: tokens [1, bucket] int32 — varies per
+            # prefill bucket (one trace per bucket by design)
             self._dprefill = jax.jit(dprefill, donate_argnums=(1,))
 
     # -- public API ----------------------------------------------------------
@@ -366,9 +388,15 @@ class DecodeServer:
             return self._spec_step(active)
         key = jax.random.fold_in(self.rng, self._tick)
         self._tick += 1
-        self.cache, nxt = self._decode(
-            self.params, self.cache, jnp.asarray(self.tok),
-            jnp.asarray(self.pos), key)
+        # ONE upload per step: tok and pos ride a single [2, S] transfer
+        # and are sliced apart device-side (two jnp.asarray calls were
+        # two host->device dispatches per token)
+        tp = jnp.asarray(np.stack([self.tok, self.pos]))
+        self.cache, nxt = self._decode(self.params, self.cache, tp[0],
+                                       tp[1], key)
+        # host-sync: allowed -- the per-step token readback is the
+        # product: EOS tests and per-request output append are host
+        # decisions (ONE batched [S] transfer per step)
         nxt = np.asarray(nxt)
         for s in active:
             req = self.slot_req[s]
@@ -387,15 +415,18 @@ class DecodeServer:
         key = jax.random.fold_in(self.rng, self._tick)
         self._tick += 1
         kd, kv = jax.random.split(key)
+        # ONE upload per round: prev/tok/pos ride a single [3, S]
+        # transfer and are sliced apart device-side (the previous four
+        # jnp.asarray calls were four host->device dispatches per round)
+        htp = jnp.asarray(np.stack([self.prev, self.tok, self.pos]))
         self.dcache, drafts, q_rows = self._spec_propose(
-            self.draft_params, self.dcache, jnp.asarray(self.prev),
-            jnp.asarray(self.tok), jnp.asarray(self.pos), kd)
-        chunk = jnp.concatenate(
-            [jnp.asarray(self.tok)[:, None], drafts], axis=1)
+            self.draft_params, self.dcache, htp[0], htp[1], htp[2], kd)
+        chunk = jnp.concatenate([htp[1][:, None], drafts], axis=1)
         self.cache, n_acc, extra = self._spec_verify(
-            self.params, self.cache, chunk, jnp.asarray(self.pos), kv,
-            q_rows)
-        # one host transfer per round (remote rigs pay RTT per fetch)
+            self.params, self.cache, chunk, htp[2], kv, q_rows)
+        # host-sync: allowed -- one batched transfer per round (remote
+        # rigs pay RTT per fetch; three sequential gets tripled the
+        # round's latency floor)
         n_acc, extra, chunk_np = jax.device_get((n_acc, extra, chunk))
         for s in active:
             req = self.slot_req[s]
@@ -480,25 +511,27 @@ class DecodeServer:
                 # decode.make_generate refuses up front) — full prefill
                 # instead of a corrupting shortcut
                 hit = None
+        key = jax.random.fold_in(self.rng, self._tick)
+        self._tick += 1
         if hit is not None:
             rem_padded = np.zeros((1, rb), np.int32)
             rem_padded[0, :len(rem)] = rem
-            self.cache, last = self._rem_prefill(
+            self.cache, first_t = self._rem_prefill(
                 self.params, self.cache, stored, jnp.asarray(rem_padded),
-                jnp.int32(slot), jnp.int32(plen), jnp.int32(len(rem)))
+                jnp.int32(slot), jnp.int32(plen), jnp.int32(len(rem)), key)
             self.prefix_hits += 1
         else:
-            self.cache, last = self._prefill(
+            self.cache, first_t = self._prefill(
                 self.params, self.cache, jnp.asarray(padded),
-                jnp.int32(slot), jnp.int32(n))
+                jnp.int32(slot), jnp.int32(n), key)
             if self.prefix_cache_size:
                 self.prefix_misses += 1
         if self.prefix_cache_size:
             self._prefix_store(req.prompt, slot)
-        key = jax.random.fold_in(self.rng, self._tick)
-        self._tick += 1
-        first = int(np.asarray(_select_token(
-            last[None, :], key, self.temperature, self.top_k, self.top_p))[0])
+        # host-sync: allowed -- admission readback: ONE scalar per
+        # admitted request (selection already ran inside the prefill
+        # trace); the host must see the token for EOS + output append
+        first = int(first_t)
         req.out.append(first)
         self.slot_req[slot] = req
         self.tok[slot] = first

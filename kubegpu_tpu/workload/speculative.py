@@ -130,8 +130,11 @@ def make_speculative_generate(target_cfg: TransformerConfig,
                             top_p)
         return cache, tok
 
+    # traced-shapes: prompt [1, T] int32 — T varies per prompt (one
+    # trace per distinct prompt length; prefill runs once per generate)
     prefill_t = jax.jit(lambda p, c, x, s: prefill(p, t_step, c, x, s),
                         donate_argnums=(1,))
+    # traced-shapes: prompt [1, T] int32 — varies, as prefill_t
     prefill_d = jax.jit(lambda p, c, x, s: prefill(p, d_step, c, x, s),
                         donate_argnums=(1,))
 
@@ -182,6 +185,8 @@ def make_speculative_generate(target_cfg: TransformerConfig,
     # undonated copy per round is pure overhead on the HBM-bandwidth-
     # bound decode path this module exists to speed up (serve.py donates
     # for the same reason)
+    # traced-shapes: prev/token [1] int32, pos scalar int32, key [2]
+    # uint32 — fixed; one trace per generate horizon
     draft_propose = jax.jit(draft_propose, donate_argnums=(1,))
 
     def verify(params, cache, chunk, pos):
@@ -200,7 +205,11 @@ def make_speculative_generate(target_cfg: TransformerConfig,
             [agree, jnp.array([False])]).astype(jnp.int32))
         return cache, greedy, n_acc
 
+    # traced-shapes: chunk [1, k+1] int32, pos scalar int32 — fixed per
+    # lookahead k; one trace per generate horizon
     verify = jax.jit(verify, donate_argnums=(1,))
+    # traced-shapes: p_rows [k+1, V] f32, q_rows [k, V] f32, drafts [k]
+    # int32, key [2] uint32 — fixed per lookahead k
     accept_jit = jax.jit(accept_resample)
 
     def generate(target_params, draft_params, prompt, n_new: int,
@@ -252,11 +261,15 @@ def make_speculative_generate(target_cfg: TransformerConfig,
                 n_acc, extra = accept_jit(
                     tout, q_rows, drafts,
                     jax.random.fold_in(rkey, 10_000))
+                # host-sync: allowed -- one batched transfer per round
+                # (acceptance length decides the host-side loop bound)
                 n_acc, extra_tok, drafts_np = jax.device_get(
                     (n_acc, extra, drafts))
                 n_acc = int(n_acc)
                 extra_tok = int(extra_tok)
             else:
+                # host-sync: allowed -- one batched transfer per round
+                # (acceptance length decides the host-side loop bound)
                 n_acc, tout_np, drafts_np = jax.device_get(
                     (n_acc, tout, drafts))
                 n_acc = int(n_acc)

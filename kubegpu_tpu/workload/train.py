@@ -39,10 +39,12 @@ def init_sharded(rng, cfg: TransformerConfig, mesh, optimizer=None,
 
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, PartitionSpec))
+    # traced-shapes: rng [2] uint32; one-shot setup trace, never retraced
     init = jax.jit(partial(init_params, cfg=cfg), out_shardings=shardings)
     params = init(rng)
     if not init_optimizer:
         return params, None, optimizer
+    # traced-shapes: params pytree, fixed by cfg; one-shot setup trace
     opt_state = jax.jit(optimizer.init)(params)
     # moment leaves inherit the params' NamedShardings, but scalar state
     # (Adam's count) falls out of jit committed to device 0 — replicate
@@ -103,7 +105,13 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer=None,
         return params, opt_state, loss
 
     if mesh is None:
-        return jax.jit(step)
+        # donate exactly as the mesh path below: params and opt_state
+        # are threaded through every call and the caller drops the old
+        # references on rebind, so XLA may update both in place instead
+        # of paying a full HBM copy per step
+        # traced-shapes: params/opt_state pytrees fixed by cfg; tokens
+        # [B, S] int32, fixed per training run
+        return jax.jit(step, donate_argnums=(0, 1))
     from jax.sharding import NamedSharding, PartitionSpec
 
     pspecs = spmd.param_pspecs(cfg)
@@ -111,6 +119,8 @@ def make_train_step(cfg: TransformerConfig, mesh, optimizer=None,
         lambda s: NamedSharding(mesh, s), pspecs,
         is_leaf=lambda x: isinstance(x, PartitionSpec))
     batch_shard = NamedSharding(mesh, spmd.batch_pspec())
+    # traced-shapes: params/opt_state pytrees fixed by cfg; tokens
+    # [B, S] int32, fixed per training run
     return jax.jit(
         step,
         in_shardings=(p_shard, None, batch_shard),

@@ -1,0 +1,72 @@
+"""Bad twin for the device-boundary rules: per-iteration host syncs, a
+contract-less jit, a per-call-varying shape fed to a jitted entry, a
+closure rebound after tracing, a missed donation, a use-after-donate,
+an unjustified waiver, and a stale one. Analyzed, never imported."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def token_step(cache, tok):
+    cache = cache + tok
+    return cache, tok + 1
+
+
+# donation-discipline: threads `cache` in and out without donating it;
+# retrace-hazard: carries no shape contract at all
+step = jax.jit(token_step)
+
+# traced-shapes: cache [4] f32, tok [] i32 — fixed for the demo server
+fused = jax.jit(token_step, donate_argnums=(0,))
+
+
+def serve_loop(cache, tok):
+    out = []
+    for _ in range(8):
+        cache, tok = step(cache, tok)
+        out.append(float(tok))  # host-sync: scalar readback per token
+        if tok > 0:  # host-sync: implicit bool() blocks on device value
+            out.append(1)
+    return cache, out
+
+
+def warm_start(state, x):
+    new_state, nxt = fused(state, x)
+    return new_state + state  # donation-discipline: `state` was donated
+
+
+def bucket_free_prefill(prompts):
+    outs = []
+    for p in prompts:
+        # retrace-hazard: buffer shape varies per prompt, contract on
+        # `fused` does not say `varies`
+        buf = np.zeros((len(p), 4), np.float32)
+        outs.append(fused(jnp.asarray(buf), jnp.asarray(buf)))
+    return outs
+
+
+def make_decoder(params):
+    scale = jnp.float32(0.5)
+
+    def decode(tok):
+        return tok * scale + params
+
+    # traced-shapes: tok [4] i32 — fixed
+    djit = jax.jit(decode)
+    scale = jnp.float32(0.25)  # retrace-hazard: trace pinned 0.5
+    return djit, scale
+
+
+def report_step(metrics):
+    total = jnp.sum(metrics)
+    # host-sync: allowed
+    host_total = float(total)  # waiver above has no `-- justification`
+    return host_total
+
+
+def batched_flush(vals):
+    # host-sync: allowed -- the flush used to read back per step (fixed
+    # by the batched rewrite; this waiver is now stale)
+    total = jnp.add(vals, vals)
+    return total

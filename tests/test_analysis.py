@@ -24,7 +24,8 @@ RULES = ["lock-discipline", "no-blocking-under-lock", "transitive-locks",
          "monotonic-time", "codec-pairing", "no-swallowed-exceptions",
          "metric-registration", "charge-pairing", "resource-lifecycle",
          "wire-contract", "racer", "hot-path", "twin-coverage",
-         "mirror-maintenance", "reason-parity", "unused-suppression"]
+         "mirror-maintenance", "reason-parity", "host-sync",
+         "retrace-hazard", "donation-discipline", "unused-suppression"]
 
 
 # ---- static rules: bad fixtures flag, good twins pass ----------------------
@@ -265,6 +266,121 @@ def test_reason_parity_flags_drifted_literals():
 
 def test_reason_parity_good_twin_is_clean():
     assert findings_for(GOOD, "reason-parity") == []
+
+
+# ---- device-boundary rules (deviceflow) -------------------------------------
+
+def test_host_sync_details():
+    hits = findings_for(BAD, "host-sync")
+    assert all(f.path.endswith("deviceflow_bad.py") for f in hits)
+    msgs = " ".join(f.message for f in hits)
+    assert "float() materializes a traced value" in msgs
+    assert "implicit bool()" in msgs
+    assert "waiver without a justification" in msgs
+    assert len(hits) == 3
+
+
+def test_retrace_hazard_details():
+    hits = findings_for(BAD, "retrace-hazard")
+    msgs = " ".join(f.message for f in hits)
+    assert "has no `# traced-shapes:` contract" in msgs
+    assert "data-dependent shape" in msgs      # np.zeros((len(p), 4))
+    assert "rebound after" in msgs             # closure pinned by trace
+    assert len(hits) == 3
+
+
+def test_donation_discipline_details():
+    hits = findings_for(BAD, "donation-discipline")
+    msgs = " ".join(f.message for f in hits)
+    assert "without donating it" in msgs       # state-threading step
+    assert "invalid after the call" in msgs    # use-after-donate
+    assert len(hits) == 2
+
+
+def test_deviceflow_good_twin_is_clean():
+    for rule in ("host-sync", "retrace-hazard", "donation-discipline"):
+        assert findings_for(GOOD, rule) == [], rule
+
+
+def test_stale_host_sync_waiver_flagged_by_audit():
+    """A justified waiver whose covered line no longer has a boundary
+    call is stale — unused-suppression flags it, but only when host-sync
+    actually ran (no evidence, no verdict)."""
+    hits = run_analysis([BAD], select=["host-sync", "unused-suppression"],
+                        tests_dir=TESTS_DIR)
+    stale = [f for f in hits if f.rule == "unused-suppression" and
+             "no longer covers a boundary call" in f.message]
+    assert len(stale) == 1
+    assert stale[0].path.endswith("deviceflow_bad.py")
+    alone = findings_for(BAD, "unused-suppression")
+    assert not [f for f in alone
+                if "no longer covers a boundary call" in f.message]
+
+
+def test_host_sync_report_ranks_serving_loop_first():
+    """The acceptance criterion: `--rule host-sync --report` over the
+    real tree ranks the slot-serving loop #1 (it pays the most dispatch
+    round trips per token), with every site deliberately waived."""
+    reports = {}
+    run_analysis([os.path.join(REPO, "kubegpu_tpu")], select=["host-sync"],
+                 tests_dir=TESTS_DIR, reports=reports)
+    roots = reports["host-sync"]["roots"]
+    assert roots, "the serving loops must appear in the inventory"
+    top = roots[0]
+    assert top["function"] == "DecodeServer.run"
+    assert top["syncs_per_iteration"] == 3
+    assert top["h2d_per_iteration"] >= 1
+    assert all(site["waived"] for site in top["sites"])
+    from kubegpu_tpu.analysis.rules import deviceflow
+
+    text = deviceflow.render_report(reports["host-sync"])
+    assert "#1 DecodeServer.run" in text
+    assert "[waived]" in text
+
+
+def test_host_sync_report_cli():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubegpu_tpu.analysis", "--rule",
+         "host-sync", "--report", "kubegpu_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "host-sync report" in proc.stdout
+    assert "#1 DecodeServer.run" in proc.stdout
+
+
+@pytest.mark.parametrize("module", ["train.py", "lora.py"])
+def test_workload_donation_fix_is_pinned(module, tmp_path):
+    """Regression pin for the PR's donation fixes: stripping
+    donate_argnums from the jitted step reintroduces the
+    missed-donation finding; the checked-in module stays clean."""
+    path = os.path.join(REPO, "kubegpu_tpu", "workload", module)
+    src = open(path).read()
+    assert "donate_argnums=(0, 1)" in src
+    mutated = tmp_path / module
+    mutated.write_text(src.replace(", donate_argnums=(0, 1)", ""))
+    hits = run_analysis([str(mutated)], select=["donation-discipline"],
+                        tests_dir=TESTS_DIR)
+    assert any("without donating it" in f.message for f in hits)
+    assert run_analysis([path], select=["donation-discipline"],
+                        tests_dir=TESTS_DIR) == []
+
+
+def test_serve_batched_transfer_waivers_are_load_bearing(tmp_path):
+    """Regression pin for the serve.py batching fix: the per-step
+    readbacks are real sinks (de-justifying the waivers resurfaces
+    them), and the checked-in file is clean because each remaining sink
+    is ONE batched transfer, justified in place."""
+    path = os.path.join(REPO, "kubegpu_tpu", "workload", "serve.py")
+    src = open(path).read()
+    assert "# host-sync: allowed -- " in src
+    mutated = tmp_path / "serve.py"
+    mutated.write_text(src.replace("# host-sync: allowed -- ",
+                                   "# boundary note: "))
+    hits = run_analysis([str(mutated)], select=["host-sync"],
+                        tests_dir=TESTS_DIR)
+    assert len(hits) >= 3, [f.line for f in hits]
+    assert run_analysis([path], select=["host-sync"],
+                        tests_dir=TESTS_DIR) == []
 
 
 # ---- the mutation engine ----------------------------------------------------
