@@ -100,6 +100,21 @@ TARGETS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("kubegpu_tpu.scheduler.equivalence", (
         "EquivalenceCache",
     )),
+    ("kubegpu_tpu.scheduler.batch", (
+        "CapacityLedger",
+        "ClassPass",
+        "batch_class",
+        "pod_chip_demand",
+        "free_chip_count",
+        "open_class_pass",
+        "refresh_class_pass",
+        "pick_host",
+        "scores_decompose",
+    )),
+    ("kubegpu_tpu.scheduler.queue", (
+        "SchedulingQueue.push_many",
+        "SchedulingQueue.pop_many",
+    )),
 )
 
 #: Equivalent mutants: behavior provably unobservable through any
@@ -168,6 +183,16 @@ WAIVERS: Dict[str, str] = {
     "equivalence.lookup_many:cmp:f0936fe9":
         "the guard only avoids a zero-increment metrics call; "
         "inc(0) is a no-op, so >= changes nothing observable",
+    "queue.pop_many:cmp:0b349004":
+        "remaining is a monotonic-clock difference that is strictly "
+        "negative once the deadline passes; the ==0 instant is "
+        "unobservable (the next loop iteration returns regardless), so "
+        "<= vs < cannot change any caller-visible outcome",
+    "queue.pop_many:minmax:2bfbf42c":
+        "the wait chunk only sets the spurious-wakeup poll granularity: "
+        "a push notifies the condition and wakes the waiter in either "
+        "case, and the deadline check still bounds the return time, so "
+        "min vs max is timing-equivalent to within one poll interval",
 }
 
 #: Fast PR-time subset (CI's mutation smoke): one representative per
@@ -182,6 +207,13 @@ PINNED_SMOKE: List[str] = [
     "equivalence.lookup:cmp:a798df36",           # generation serving flip
     "vectorized._shape_verdict:cmp:cfda14ce",    # memo bound flip
     "vectorized._kernel_balanced:maskop:6d9eed74",  # score kernel drift
+    "batch.covers:cmp:6498e94e",                 # capacity off-by-one
+    "batch.note_award:dropcall:fa03ddf1",        # award never charged
+    "batch.batch_class:cmp:aa1011d1",            # class-key routing flip
+    "batch.pick_host:minmax:dc5046e9",           # selection flip
+    "batch.refresh_class_pass:cmp:04b5675b",     # stale-host refit skip
+    "queue.push_many:cmp:50c0e104",              # lost batch admission
+    "queue.pop_many:cmp:c85049f5",               # drain-bound off-by-one
 ]
 
 
@@ -1705,7 +1737,184 @@ def _drive_stream(vectorize: bool) -> Dict[str, Any]:
     return placements
 
 
+def _check_batch_model() -> None:
+    """Direct drives of the batch cycle's cycle-local pieces: the
+    capacity ledger's exact decrement/boundary behavior (off-by-one
+    mutants die here) and `pick_host`'s cursor-threaded tie-break."""
+    from kubegpu_tpu.scheduler import batch as batch_mod
+
+    led = batch_mod.CapacityLedger()
+    assert led.covers("n", 99, {"cpu": 10 ** 9}), "unseeded must not prune"
+    node_ex = types.SimpleNamespace(
+        allocatable={f"{G}/tpu/dev{i}/chips": 1 for i in range(4)},
+        used={f"{G}/tpu/dev0/chips": 1})
+    snap = types.SimpleNamespace(node_ex=node_ex,
+                                 core_allocatable={"cpu": 8000},
+                                 requested_core={"cpu": 2000})
+    led.seed("n", snap)  # 3 chips free, 6000 cpu headroom
+    assert led.covers("n", 3, {"cpu": 6000}), "exact fit must cover"
+    assert not led.covers("n", 4, {}), "chip over-ask must prune"
+    assert not led.covers("n", 0, {"cpu": 6001}), "core over-ask must prune"
+    led.charge("n", 2, {"cpu": 4000})
+    assert led.covers("n", 1, {"cpu": 2000}), "post-charge exact fit"
+    assert not led.covers("n", 2, {}), "charge must decrement chips"
+    assert not led.covers("n", 0, {"cpu": 2001}), "charge must decrement core"
+    # note_award: FIRST touch seeds from the post-award snapshot (award
+    # already subtracted there — seeding AND charging would double-count)
+    led2 = batch_mod.CapacityLedger()
+    led2.note_award("n", snap, 2, {"cpu": 1000})
+    assert led2.covers("n", 3, {}), "first award must not double-charge"
+    led2.note_award("n", snap, 1, {})
+    assert led2.covers("n", 2, {}) and not led2.covers("n", 3, {}), \
+        "second award must charge"
+
+    cp = batch_mod.ClassPass()
+    cp.feasible = {"a": 1.0, "b": 1.0, "c": 0.5}
+    cp.scored = {"a": 2.0, "b": 2.0, "c": 1.0}
+    g = types.SimpleNamespace(_last_node_index=0)
+    assert batch_mod.pick_host(g, cp) == "b", "tie-break cursor step 1"
+    assert batch_mod.pick_host(g, cp) == "a", "tie-break cursor wrap"
+    single = batch_mod.ClassPass()
+    single.feasible = {"z": 9.0}
+    single.scored = None
+    assert batch_mod.pick_host(g, single) == "z"
+    assert g._last_node_index == 2, "single-node fast path must not bump"
+    none = batch_mod.ClassPass()
+    none.feasible = {}
+    assert batch_mod.pick_host(g, none) is None
+
+    # class routing: a pod holding a live nomination must NOT take the
+    # batch path (its preemption-freed reservation would be charged
+    # against it by a shared representative pass)
+    stub = types.SimpleNamespace(
+        vector=types.SimpleNamespace(pod_eligible=lambda pod, inv: True),
+        _memo_safe=True,
+        extenders=(),
+        _requests_auto_topology=lambda pod: False,
+        cache=types.SimpleNamespace(has_affinity_pods=lambda: False),
+        _volume_snapshot=lambda pod: None,
+        _nominations={"nom": object()})
+    assert batch_mod.batch_class(stub, _tpu_pod("nom", 1)) is None, \
+        "nominated pod must route serial"
+    assert isinstance(batch_mod.batch_class(stub, _tpu_pod("plain", 1)),
+                      str), "eligible pod must get a class key"
+
+    # score decomposition: single-node rescore is only sound when no
+    # configured priority normalizes across the candidate set
+    from kubegpu_tpu.scheduler import factory as factory_mod
+    spread = next(iter(factory_mod.SPREADING_PRIORITY_NAMES))
+
+    def decompose(priorities, labels, sels):
+        gen = types.SimpleNamespace(
+            algorithm=types.SimpleNamespace(vector_priorities=True,
+                                            priorities=priorities),
+            _owner_selectors=lambda pod: sels)
+        pod = {"metadata": {"name": "d", "labels": labels}}
+        return batch_mod.scores_decompose(gen, pod)
+
+    other = ("other", None, 1)
+    assert decompose([other], {"app": "x"}, None), \
+        "no spreading configured => decomposable"
+    assert not decompose([(spread, None, 1), other], {"app": "x"}, None), \
+        "identifying label under spreading => full rescore"
+    assert decompose([(spread, None, 1), other], {"name": "d"}, None), \
+        "'name' label alone keeps spreading flat"
+    assert not decompose([(spread, None, 1)], {"name": "d", "app": "x"},
+                         None), "mixed labels => full rescore"
+    assert decompose([(spread, None, 1)], {"app": "x"}, []), \
+        "empty owner selectors keep spreading flat"
+    assert not decompose([(spread, None, 1)], {}, [object()]), \
+        "owner selectors => full rescore"
+
+
+def _check_queue_model() -> None:
+    """Direct drives of the batch queue intake: bounded heap-order
+    drain, queue-wait admission accounting, replace-in-place on
+    re-push, and the pop timeout actually being honored."""
+    from kubegpu_tpu.scheduler import queue as queue_mod
+
+    q = queue_mod.SchedulingQueue()
+    q.push_many([_tpu_pod("qa", 1, priority=1), _tpu_pod("qb", 1)])
+    assert "qa" in q._enqueued and "qb" in q._enqueued, \
+        "push_many must start queue-wait accounting"
+    got = [p["metadata"]["name"] for p in q.pop_many(1, timeout=0.0)]
+    assert got == ["qa"], "bounded drain, heap order"
+    got = [p["metadata"]["name"] for p in q.pop_many(5, timeout=0.0)]
+    assert got == ["qb"], "drain remainder"
+    t0 = time.monotonic()
+    assert q.pop_many(4, timeout=0.0) == []
+    assert time.monotonic() - t0 < 0.5, "timeout=0 must not block"
+    t0 = time.monotonic()
+    assert q.pop_many(4, timeout=0.2) == []
+    assert time.monotonic() - t0 >= 0.15, "empty-queue timeout honored"
+    q.push_many([_tpu_pod("qc", 1, cpu="1")])
+    q.push_many([_tpu_pod("qc", 1, cpu="7")])
+    drained = q.pop_many(8, timeout=0.0)
+    assert [p["metadata"]["name"] for p in drained] == ["qc"], \
+        "re-push of a queued name replaces in place, no duplicate"
+    assert drained[0]["spec"]["containers"][0]["resources"] \
+        ["requests"]["cpu"] == "7", "replace must keep the newest object"
+
+
+def _check_batch_differential() -> None:
+    """Mass release driven through the batch cycle and the pod-at-a-time
+    oracle on identically-built fleets: same pods bound to the same
+    nodes and chips, and the assignment's losers parked for retry —
+    never dropped."""
+    placements = [_drive_batch(batch_on) for batch_on in (True, False)]
+    assert placements[0] == placements[1], "batch placement drift"
+
+
+def _drive_batch(batch_on: bool) -> Dict[str, Any]:
+    from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+    from kubegpu_tpu.scheduler.core import Scheduler
+
+    rng = random.Random(7)
+    api = InMemoryAPIServer()
+    for i in range(4):
+        api.create_node(_mesh_node(f"b{i}", (2 * (i % 2), 2 * (i // 2), 0)))
+    saved_v = os.environ.get("KGTPU_VECTORIZE")
+    saved_b = os.environ.get("KGTPU_BATCH")
+    os.environ["KGTPU_VECTORIZE"] = "1"
+    os.environ["KGTPU_BATCH"] = "1" if batch_on else "0"
+    try:
+        sched = Scheduler(api, _device_scheduler())
+    finally:
+        for key, saved in (("KGTPU_VECTORIZE", saved_v),
+                           ("KGTPU_BATCH", saved_b)):
+            if saved is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = saved
+    placements: Dict[str, Any] = {}
+    try:
+        names: List[str] = []
+        for i in range(12):
+            # the whole burst lands BEFORE the first pass: several
+            # equivalence classes, over-subscribing the 16-chip fleet
+            pod = _tpu_pod(f"m{i}", rng.choice([1, 1, 2, 4]),
+                           priority=rng.choice([0, 0, 10]))
+            api.create_pod(pod)
+            names.append(pod["metadata"]["name"])
+        sched.run_until_idle()
+        for name in names:
+            pod = api.get_pod(name)
+            chips: List[str] = []
+            pi = codec.annotation_to_pod_info(pod.get("metadata") or {})
+            for cont in pi.running_containers.values():
+                chips.extend(sorted(cont.allocate_from.values()))
+            placements[name] = ((pod.get("spec") or {}).get("nodeName"),
+                                tuple(chips))
+        unbound = sum(1 for name in names if placements[name][0] is None)
+        assert unbound > 0, "fleet not over-subscribed — widen the burst"
+        assert sched.queue.pending_count() == unbound, "losers not requeued"
+    finally:
+        sched.stop()
+    return placements
+
+
 KILL_CHECKS: Tuple[Tuple[str, Callable[[], None]], ...] = (
+    ("batch-model", _check_batch_model),
     ("mesh-tables", _check_mesh_tables),
     ("equivalence-model", _check_equivalence_model),
     ("score-kernels", _check_score_kernels),
@@ -1715,6 +1924,8 @@ KILL_CHECKS: Tuple[Tuple[str, Callable[[], None]], ...] = (
     ("mask-memo", _check_mask_memo),
     ("preempt-differential", _check_preempt_differential),
     ("stream-differential", _check_stream_differential),
+    ("batch-differential", _check_batch_differential),
+    ("queue-model", _check_queue_model),
 )
 
 

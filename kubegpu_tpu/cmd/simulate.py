@@ -78,6 +78,22 @@ def _fit_cache_summary() -> dict:
             "verdict_timeouts": metrics.FIT_VERDICT_TIMEOUTS.value}
 
 
+def _batch_summary() -> dict:
+    """Whole-backlog batch scheduling health (metrics.py): cycles run,
+    pods and equivalence classes per cycle, and the rolling bound-pod
+    throughput gauge — a run where the batch path never engaged (zero
+    cycles under a multi-pod workload with KGTPU_BATCH unset) is the
+    regression this summary makes visible."""
+    cycles = metrics.SCHED_BATCH_SIZE.n
+    return {"cycles": cycles,
+            "pods_per_cycle_mean": round(
+                metrics.SCHED_BATCH_SIZE.total / max(cycles, 1), 2),
+            "classes_per_cycle_mean": round(
+                metrics.SCHED_BATCH_CLASSES.total / max(cycles, 1), 2),
+            "throughput_pods_per_s": round(
+                metrics.SCHED_THROUGHPUT.value, 1)}
+
+
 def _data_plane_summary() -> dict:
     """Binder-pipeline, watch-batching, and wire-transport health
     (metrics.py): bind latency p50/count, live binder depth, last watch
@@ -234,6 +250,7 @@ def run_chaos_scenario(seed: int = 0, lost_after_s: float = 0.9,
                 "final_placement": final,
                 "evicted_pods": lifecycle.evicted_total,
                 "fit_cache": _fit_cache_summary(),
+                "batch": _batch_summary(),
                 "data_plane": _data_plane_summary(),
                 "chaos_faults": {f"{c}:{k}": n for (c, k), n
                                  in sorted(net.faults.items())}}
@@ -357,6 +374,7 @@ def run_chip_kill_scenario(seed: int = 0,
                 "relists": sched.resync_count,
                 "injected": [list(f[:3]) for f in chaos.injected],
                 "fit_cache": _fit_cache_summary(),
+                "batch": _batch_summary(),
                 "data_plane": _data_plane_summary()}
     finally:
         repair.stop()
@@ -1065,8 +1083,9 @@ def _run_simulation(args) -> int:
 
     fit_cache = _fit_cache_summary()
     data_plane = _data_plane_summary()
+    batch = _batch_summary()
     doc = {"placements": rows, "fit_cache": fit_cache,
-           "data_plane": data_plane}
+           "batch": batch, "data_plane": data_plane}
     if n_sched > 1:
         doc["ha"] = {"schedulers": n_sched, **_ha_summary()}
     if args.json:
@@ -1080,6 +1099,10 @@ def _run_simulation(args) -> int:
         print(f"fit cache: {fit_cache['hits']} hits / "
               f"{fit_cache['misses']} misses / "
               f"{fit_cache['invalidations']} invalidations")
+        print(f"batch: {batch['cycles']} cycles, "
+              f"{batch['pods_per_cycle_mean']} pods/cycle, "
+              f"{batch['classes_per_cycle_mean']} classes/cycle, "
+              f"{batch['throughput_pods_per_s']} pods/s bound")
         print(f"data plane: {data_plane['bind_count']} binds "
               f"(p50 {data_plane['bind_p50_ms']} ms, "
               f"{data_plane['bind_inflight']} in flight); last watch "

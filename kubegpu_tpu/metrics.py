@@ -366,6 +366,19 @@ FIT_VECTOR_NODES_PER_PASS = Histogram(  # analysis: disable=metric-registration 
     "fit_vector_nodes_per_pass", start_us=1.0, factor=2.0, count=15)
 FIT_SCALAR_FALLBACK = Counter("fit_scalar_fallback_total")
 FIT_VERDICT_TIMEOUTS = Counter("fit_verdict_timeouts_total")
+# Whole-backlog batch scheduling (scheduler/batch.py + the batch cycle
+# in scheduler/core.py): sched_batch_size histograms how many admitted
+# pods one drained backlog carried; sched_batch_classes_per_cycle how
+# many distinct filter/score passes that cycle paid (batch classes +
+# serial-fallback pods) — size/classes is the amortization factor the
+# batch path exists for. sched_throughput_pods_per_s is the headline
+# bind-commit rate over a short rolling window, fed by every commit
+# path (single, coalesced batch, gang).
+SCHED_BATCH_SIZE = Histogram(  # analysis: disable=metric-registration -- pod-count histogram; the unit IS pods-per-cycle, not a time/bytes quantity the suffix vocabulary covers
+    "sched_batch_size", start_us=1.0, factor=2.0, count=12)
+SCHED_BATCH_CLASSES = Histogram(  # analysis: disable=metric-registration -- class-count histogram; the unit IS classes-per-cycle, not a time/bytes quantity the suffix vocabulary covers
+    "sched_batch_classes_per_cycle", start_us=1.0, factor=2.0, count=12)
+SCHED_THROUGHPUT = Gauge("sched_throughput_pods_per_s")
 
 
 def all_metrics() -> list:

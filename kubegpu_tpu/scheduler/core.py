@@ -25,8 +25,8 @@ from typing import Any, Callable, Iterable
 from kubegpu_tpu import metrics, obs
 from kubegpu_tpu.analysis.explore import probe
 from kubegpu_tpu.core import codec, grammar
-from kubegpu_tpu.scheduler import (factory, interpod, predicates, priorities,
-                                   vectorized)
+from kubegpu_tpu.scheduler import (batch, factory, interpod, predicates,
+                                   priorities, vectorized)
 from kubegpu_tpu.scheduler.cache import SchedulerCache
 from kubegpu_tpu.scheduler.equivalence import (devolumed_class,
                                                equivalence_class)
@@ -1486,6 +1486,21 @@ class Scheduler:
         self.quota = quota
         if quota is not None:
             quota.requeue = self.queue.push
+            # batch-aware gates re-admit a whole release under one queue
+            # wake instead of one per pod
+            quota.requeue_many = self.queue.push_many
+        # Whole-backlog batch scheduling (scheduler/batch.py): one pass
+        # drains the ready backlog and schedules it as one assignment
+        # problem — one fleet filter/score pass per equivalence class,
+        # single-node refits per award. Captured once at construction;
+        # KGTPU_BATCH=0 keeps the pod-at-a-time loop as oracle/fallback
+        # (the KGTPU_VECTORIZE=0 discipline).
+        self._batch = batch.enabled()
+        # (monotonic, pods) samples of committed binds for the headline
+        # sched_throughput_pods_per_s gauge; bind workers append
+        # concurrently with the drainer
+        self._throughput_lock = threading.Lock()
+        self._bound_window: deque = deque()
         self._stop = threading.Event()
         # A transport exposing batched watch delivery (HTTPAPIClient)
         # gets the whole batch applied under one cache lock; the
@@ -1730,6 +1745,7 @@ class Scheduler:
         either side observes."""
         ops: list = []
         post: list = []
+        pushes: list = []  # added-unbound pods -> ONE push_many
         wake = False
         for kind, event, obj in events:
             if kind == "node":
@@ -1757,7 +1773,7 @@ class Scheduler:
                     else:
                         post.append((self.quota.pod_pending, (obj,)))
                 if event == "added" and not node_name:
-                    post.append((self.queue.push, (obj,)))
+                    pushes.append(obj)
                 elif event in ("added", "modified") and node_name:
                     # a bound pod (possibly a competing replica's bind
                     # arriving as "modified"): charge idempotently and
@@ -1787,16 +1803,147 @@ class Scheduler:
             self.cache.apply_batch(ops)
         for fn, args in post:
             fn(*args)
+        if pushes:
+            # one admission, one wake, one depth publish for the whole
+            # batch — a pod deleted or bound by a LATER event in the same
+            # batch is re-admitted here and dropped by the pop-time
+            # freshness check, the same convergence the per-event path
+            # already relies on for a one-delivery-stale mirror
+            self.queue.push_many(pushes)
         if wake:
             self.queue.move_all_to_active()
 
     # ---- the loop (`scheduler.go:439-502`) ---------------------------------
 
     def schedule_one(self, timeout: float = 0.0) -> bool:
-        """One pass; returns False when the queue stayed empty."""
+        """One pass; returns False when the queue stayed empty. With
+        batch scheduling on (the default; ``KGTPU_BATCH=0`` reverts to
+        the pod-at-a-time oracle) one pass drains the whole ready
+        backlog and schedules it as one assignment problem."""
+        if self._batch:
+            pods = self.queue.pop_many(batch.MAX_BATCH_PODS,
+                                       timeout=timeout)
+            if not pods:
+                return False
+            self._schedule_backlog(pods)
+            return True
         kube_pod = self.queue.pop(timeout=timeout)
         if kube_pod is None:
             return False
+        kube_pod = self._prepare_backlog_pod(kube_pod)
+        if kube_pod is not None:
+            self._schedule_admitted(kube_pod)
+        return True
+
+    def _schedule_backlog(self, pods: list) -> None:
+        """One batch cycle: intake every popped pod (shard/freshness/
+        gang/quota — identical per-pod treatment to the serial loop),
+        group the admitted remainder by batch class, then award hosts
+        in the exact pop order the serial loop would have used. Each
+        class pays ONE fleet filter/score pass (its first member's);
+        every award dirties the awarded host in all live class passes
+        and charges the cycle's capacity ledger, so the next pick sees
+        it — a refit of one node, not a pass over the fleet."""
+        from kubegpu_tpu.scheduler.gang import gang_key
+
+        self.cache.expire_assumed()
+        if len(pods) == 1:
+            # trickle shape: a single-pod cycle can share nothing, so
+            # skip class grouping (and its content hash) entirely and
+            # take the serial tail verbatim — the batch path costs
+            # nothing when the queue never builds a backlog
+            admitted = self._prepare_backlog_pod(pods[0])
+            if admitted is not None:
+                metrics.SCHED_BATCH_SIZE.observe(1)
+                metrics.SCHED_BATCH_CLASSES.observe(1)
+                self._schedule_admitted(admitted)
+            return
+        ledger = batch.CapacityLedger()
+        passes: dict = {}  # class key -> ClassPass | None (None: serial)
+        counted: set = set()
+        n_scheduled = 0
+        n_classes = 0
+        for popped in pods:
+            # intake AND scheduling run per pod in pop order — a gang
+            # that completes during a later pod's intake must see every
+            # earlier pod's award, exactly as the serial loop's
+            # pop/schedule interleaving would have shown it
+            kube_pod = self._prepare_backlog_pod(popped)
+            if kube_pod is None:
+                if passes and gang_key(popped) is not None:
+                    # the pod routed to the gang handler, which may have
+                    # just committed a whole gang: node state moved under
+                    # every open class pass, so drop the cycle's shared
+                    # state and let later pods re-open against fresh truth
+                    passes.clear()
+                    ledger = batch.CapacityLedger()
+                continue
+            n_scheduled += 1
+            key = batch.batch_class(self.generic, kube_pod)
+            cp = None
+            if key is None:
+                n_classes += 1
+            else:
+                if key not in counted:
+                    counted.add(key)
+                    n_classes += 1
+                if key in passes:
+                    cp = passes[key]
+                    if cp is not None:
+                        # class-pass reuse IS the equivalence cache
+                        # working: every node served without a recompute
+                        # folds into the fit-memo effectiveness counters
+                        # (the refit lookups account for themselves)
+                        self.cache.equivalence.record(
+                            max(len(cp.feasible) + len(cp.failures)
+                                - len(cp.dirty), 0), 0)
+                else:
+                    cp = batch.open_class_pass(self.generic, key, kube_pod)
+                    passes[key] = cp
+            if cp is None:
+                # unbatchable pod (volumes, affinity, gang leftovers,
+                # extenders...) — the serial path IS the batch fallback
+                host = self._schedule_admitted(kube_pod)
+                chips, core = self._pod_demand(kube_pod)
+            else:
+                batch.refresh_class_pass(self.generic, cp, ledger)
+                host = self._schedule_admitted(kube_pod, cp)
+                chips, core = cp.chips, cp.core_requests
+            if host is None:
+                continue
+            # ledger balances must never UNDERestimate remaining
+            # capacity (covers() prunes without a refit): the first
+            # award on a node seeds from its post-award snapshot — the
+            # award is already subtracted there — later ones decrement
+            ledger.note_award(host, self.cache.snapshot_node(host),
+                              chips, core)
+            for other in passes.values():
+                if other is not None:
+                    other.dirty.add(host)
+        if n_scheduled:
+            metrics.SCHED_BATCH_SIZE.observe(n_scheduled)
+            metrics.SCHED_BATCH_CLASSES.observe(n_classes)
+
+    def _pod_demand(self, kube_pod: dict) -> tuple:
+        """(chips, core requests) a placed pod consumes, for the batch
+        ledger. Chip demand may UNDERcount for exotic request shapes
+        (absolute device paths) — an undercharge only ever costs an
+        extra refit, never a wrong prune."""
+        try:
+            info = codec.kube_pod_to_pod_info(kube_pod,
+                                              invalidate_existing=True)
+            chips = batch.pod_chip_demand(info)
+        except Exception:
+            chips = 0
+        return chips, _pod_core_requests(kube_pod)
+
+    def _prepare_backlog_pod(self, kube_pod: dict) -> dict | None:
+        """Per-pod intake, shared verbatim by the serial loop and the
+        batch cycle: shard ownership, informer-mirror freshness, gang
+        routing, and the DRF quota gate. Returns the fresh, admitted
+        pod ready for a scheduling cycle — or None when the pod was
+        fully handled here (parked, gang-buffered, deleted, already
+        bound, or over fair share)."""
         name = kube_pod["metadata"]["name"]
         if self._shard_owned is not None and \
                 not self._shard_owned(self._shard_key(kube_pod)):
@@ -1805,7 +1952,7 @@ class Scheduler:
             # holder dies (work stealing), and the coordinator fires
             # move_all_to_active so stolen pods skip the park delay
             self.queue.park(kube_pod, self.SHARD_PARK_S)
-            return True
+            return None
         # Freshness check against the informer mirror (no GET round trip
         # per pod — the upstream scheduler trusts its informer the same
         # way); the API is consulted only when the mirror misses. A copy
@@ -1816,15 +1963,15 @@ class Scheduler:
             try:
                 current = self.api.get_pod(name)
             except KeyError:
-                return True  # deleted while queued
+                return None  # deleted while queued
             except Exception:
                 # transient transport failure: the pod was already popped,
                 # so dropping it here would lose it forever — park it with
                 # backoff instead and let the next pass re-fetch
                 self.queue.add_unschedulable(kube_pod)
-                return True
+                return None
         if (current.get("spec") or {}).get("nodeName"):
-            return True  # already bound elsewhere
+            return None  # already bound elsewhere
         kube_pod = current
 
         from kubegpu_tpu.scheduler.gang import gang_key
@@ -1832,18 +1979,34 @@ class Scheduler:
         gang = gang_key(kube_pod)
         if gang is not None:
             self._handle_gang_pod(kube_pod, *gang)
-            return True
+            return None
 
         if self.quota is not None and \
                 not self._quota_admit([kube_pod], kube_pod):
-            return True  # over fair share: parked in the gate
+            return None  # over fair share: parked in the gate
+        return kube_pod
 
+    def _schedule_admitted(self, kube_pod: dict,
+                           cp: Any = None) -> str | None:
+        """One scheduling cycle for an admitted pod: pick a host, assume
+        volumes, allocate devices, assume, bind. ``cp`` is the pod's
+        shared batch ClassPass — the host then comes from the class's
+        score table (``batch.pick_host``) instead of a fresh fleet pass;
+        every error path is the serial one, shared verbatim. Returns the
+        host on a successful award (reached assume+bind), else None."""
+        name = kube_pod["metadata"]["name"]
         metrics.SCHEDULE_ATTEMPTS.inc()
         t0 = time.perf_counter()
-        self.cache.expire_assumed()
+        if cp is None:
+            self.cache.expire_assumed()
         with obs.span("schedule_cycle", pod=name, proc=self.obs_name) as cyc:
             try:
-                host = self.generic.schedule(kube_pod)
+                if cp is None:
+                    host = self.generic.schedule(kube_pod)
+                else:
+                    host = batch.pick_host(self.generic, cp)
+                    if host is None:
+                        raise FitError(name, dict(cp.failures))
                 if not self._assume_volumes(kube_pod, host):
                     # volume state moved between the fit pass and host
                     # selection (another pod grabbed the PV): requeue, the
@@ -1853,7 +2016,7 @@ class Scheduler:
                     self._event(name, "Warning", "FailedScheduling",
                                 f"volume binding lost race on {host}")
                     self.queue.add_unschedulable(kube_pod)
-                    return True
+                    return None
                 with obs.span("allocate", pod=name, proc=self.obs_name,
                               node=host) as sp:
                     self.generic.allocate_devices(kube_pod, host)
@@ -1878,7 +2041,7 @@ class Scheduler:
                     self.queue.push(kube_pod)
                 else:
                     self.queue.add_unschedulable(kube_pod)
-                return True
+                return None
             except Exception as err:
                 # NOT a FitError: an internal code fault (the round-2
                 # NameError masqueraded as "unschedulable" through this
@@ -1898,7 +2061,7 @@ class Scheduler:
                 self._event(name, "Warning", "SchedulerInternalError",
                             f"{type(err).__name__}: {err}")
                 self.queue.add_unschedulable(kube_pod)
-                return True
+                return None
 
             self.cache.assume_pod(kube_pod, host)
             obs.event("assume", pod=name, proc=self.obs_name, node=host)
@@ -1909,7 +2072,7 @@ class Scheduler:
                 self._submit_bind(kube_pod, host, t0, parent=cyc.context())
             else:
                 self._bind(kube_pod, host, t0, parent=cyc.context())
-        return True
+        return host
 
     def _quota_forget(self, *pods: dict) -> None:
         """Discharge quota in-flight charges for pods whose scheduling
@@ -2165,6 +2328,7 @@ class Scheduler:
             metrics.BIND_LATENCY_MS.observe((now - ts) * 1e3)
             metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
             metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
+        self._note_bound(len(ready))
         self._events_batch(events)
 
     def _bind_batch_pessimistic(self, items: list) -> list:
@@ -2414,6 +2578,7 @@ class Scheduler:
                 spans[name].finish(outcome="committed")
                 metrics.E2E_SCHEDULING_LATENCY.observe(
                     (time.perf_counter() - t0) * 1e6)
+            self._note_bound(len(pinned_members))
         except Exception as err:
             # Release every assume EXCEPT members a delegated binder
             # already bound (they are placed; their charge must stand).
@@ -2705,6 +2870,25 @@ class Scheduler:
             return False
         return self.volume_binder.assume(kube_pod, snap.kube_node)
 
+    THROUGHPUT_WINDOW_S = 5.0
+
+    def _note_bound(self, count: int) -> None:
+        """Fold ``count`` freshly committed binds into the headline
+        ``sched_throughput_pods_per_s`` gauge — a rolling window over
+        recent commits, so both the steady trickle and a batch cycle's
+        burst read as a rate. Bind workers call this concurrently with
+        the spool drainer."""
+        now = time.monotonic()
+        with self._throughput_lock:
+            window = self._bound_window
+            window.append((now, count))
+            cutoff = now - self.THROUGHPUT_WINDOW_S
+            while window and window[0][0] < cutoff:
+                window.popleft()
+            total = sum(c for _, c in window)
+            span = max(now - window[0][0], 0.05)
+        metrics.SCHED_THROUGHPUT.set(total / span)
+
     def _bind(self, kube_pod: dict, host: str, t0: float,
               attempts: int = 1, parent: Any = None) -> bool:
         """Volumes first (the kubelet must find claims bound when the pod
@@ -2753,6 +2937,7 @@ class Scheduler:
             (now - tb) * 1e3)
         metrics.BINDING_LATENCY.observe((now - tb) * 1e6)
         metrics.E2E_SCHEDULING_LATENCY.observe((now - t0) * 1e6)
+        self._note_bound(1)
         return True
 
     def _bind_write(self, name: str, kube_pod: dict, host: str,

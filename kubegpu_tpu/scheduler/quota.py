@@ -139,6 +139,10 @@ class DRFQuotaGate:
         # racer: single-writer -- wired once by the owning Scheduler's
         # constructor before any concurrent caller exists
         self.requeue = requeue
+        # Optional batch form (a queue's ``push_many``): a 256-pod
+        # release becomes ONE queue wake and ONE depth publish instead
+        # of 256 of each. Falls back to per-pod ``requeue`` when unset.
+        self.requeue_many = None
 
     # ---- capacity + usage feeds (informer thread) --------------------------
 
@@ -261,11 +265,13 @@ class DRFQuotaGate:
                 self.pod_bound(pod)
             else:
                 self.pod_pending(pod)
-        requeue = self.requeue
-        if requeue is not None:
-            for pod in survivors:
-                if pod["metadata"]["name"] in listed:
-                    requeue(pod)
+        alive = [pod for pod in survivors
+                 if pod["metadata"]["name"] in listed]
+        if self.requeue_many is not None:
+            self.requeue_many(alive)
+        elif self.requeue is not None:
+            for pod in alive:
+                self.requeue(pod)
 
     # ---- the gate (scheduling loop) ----------------------------------------
 
@@ -521,5 +527,10 @@ class DRFQuotaGate:
                 self._unpark_locked(pod["metadata"]["name"])
         for pod in to_push:
             probe("quota.release")
-            requeue(pod)
+        requeue_many = self.requeue_many
+        if requeue_many is not None:
+            requeue_many(to_push)
+        else:
+            for pod in to_push:
+                requeue(pod)
         return len(to_push)

@@ -1,0 +1,291 @@
+"""Whole-backlog batch scheduling: differential proof against the
+pod-at-a-time oracle.
+
+The batch cycle (`scheduler/batch.py` + `Scheduler._schedule_backlog`)
+claims placement parity with the serial engine: same pods, same fleet,
+same placements modulo the documented per-class freshness window. These
+tests hold it to that — the randomized stream from the vectorized
+differential is replayed under `KGTPU_BATCH=1` and `KGTPU_BATCH=0`, a
+mass release exercises the shared class pass directly, and the
+cycle-local `CapacityLedger` / `pick_host` / wake-coalescing pieces get
+exact-boundary unit coverage (these kill the pinned batch mutants:
+capacity-decrement off-by-one, class-key collision, losers-not-requeued).
+"""
+
+import random
+
+import pytest
+
+from kubegpu_tpu import metrics
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer
+from kubegpu_tpu.scheduler import batch, vectorized
+from kubegpu_tpu.scheduler.queue import SchedulingQueue
+
+from tests.test_scheduler_core import flat_tpu_node, make_scheduler, tpu_pod
+from tests.test_vectorized import build_cluster, drive_stream
+
+pytestmark = pytest.mark.skipif(not vectorized.available(),
+                                reason="numpy unavailable")
+
+
+# ---- stream differential: batch vs serial oracle ----------------------------
+
+
+def run_batch_differential(seed, monkeypatch_env, batch_on):
+    monkeypatch_env.setenv("KGTPU_VECTORIZE", "1")
+    monkeypatch_env.setenv("KGTPU_BATCH", "1" if batch_on else "0")
+    rng = random.Random(seed)
+    api = build_cluster(rng)
+    sched = make_scheduler(api)
+    assert sched._batch == batch_on
+    try:
+        return drive_stream(api, sched, rng)
+    finally:
+        sched.stop()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_stream_placements_identical_batch_vs_serial(seed, monkeypatch):
+    batched = run_batch_differential(seed, monkeypatch, batch_on=True)
+    serial = run_batch_differential(seed, monkeypatch, batch_on=False)
+    assert batched == serial
+
+
+def mass_release_placements(monkeypatch_env, batch_on, seed):
+    """The shape the batch cycle exists for: a whole burst lands in the
+    queue BEFORE the first scheduling pass, mixing several equivalence
+    classes, and over-subscribing the fleet so losers must requeue."""
+    monkeypatch_env.setenv("KGTPU_VECTORIZE", "1")
+    monkeypatch_env.setenv("KGTPU_BATCH", "1" if batch_on else "0")
+    rng = random.Random(seed)
+    api = InMemoryAPIServer()
+    for i in range(6):
+        api.create_node(flat_tpu_node(f"host{i}", chips=4))
+    sched = make_scheduler(api)
+    try:
+        names = []
+        for i in range(24):
+            chips = rng.choice([1, 1, 1, 2, 2, 4])
+            pod = tpu_pod(f"p{i}", chips, priority=rng.choice([0, 0, 5]))
+            api.create_pod(pod)
+            names.append(pod["metadata"]["name"])
+        sched.run_until_idle()
+        out = {}
+        for name in names:
+            live = api.get_pod(name)
+            out[name] = (live.get("spec") or {}).get("nodeName")
+        return out
+    finally:
+        sched.stop()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_mass_release_placements_identical(seed, monkeypatch):
+    batched = mass_release_placements(monkeypatch, True, seed)
+    serial = mass_release_placements(monkeypatch, False, seed)
+    assert batched == serial
+    assert any(v is not None for v in batched.values())
+
+
+def test_mass_release_batches_and_requeues_losers(monkeypatch):
+    """Losers of the assignment (fleet full) park for retry — they are
+    NOT silently dropped — and the batch metrics observe the cycle."""
+    monkeypatch.setenv("KGTPU_BATCH", "1")
+    metrics.reset_all()
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=2))
+    sched = make_scheduler(api)
+    try:
+        for i in range(5):
+            api.create_pod(tpu_pod(f"p{i}", 1))
+        sched.run_until_idle()
+        bound = [i for i in range(5)
+                 if (api.get_pod(f"p{i}").get("spec") or {}).get("nodeName")]
+        assert len(bound) == 2
+        # the three losers are parked unschedulable, pending retry
+        assert sched.queue.pending_count() == 3
+        assert metrics.SCHED_BATCH_SIZE.n >= 1
+        assert metrics.SCHED_BATCH_SIZE.total >= 5
+        assert metrics.SCHED_BATCH_CLASSES.n >= 1
+        assert metrics.SCHED_THROUGHPUT.value > 0
+    finally:
+        sched.stop()
+
+
+# ---- shared class pass vs the serial filter/score twins ---------------------
+
+
+def test_class_pass_matches_serial_filter_and_selection(monkeypatch):
+    """`open_class_pass` is declared twin-of `find_nodes_that_fit` and
+    `pick_host` twin-of `select_host`: same feasible set, same failure
+    reasons, and — from the same cursor state — the same chosen host."""
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    rng = random.Random(3)
+    api = build_cluster(rng)
+    sched = make_scheduler(api)
+    try:
+        pod = tpu_pod("probe", 2)
+        key = batch.batch_class(sched.generic, pod)
+        assert key is not None
+        cp = batch.open_class_pass(sched.generic, key, pod)
+        assert cp is not None
+        feasible, failures, snaps, meta = \
+            sched.generic.find_nodes_that_fit(pod)
+        assert cp.feasible == feasible
+        assert cp.failures == failures
+        scored = sched.generic.prioritize_nodes(pod, dict(feasible),
+                                                snaps, meta)
+        sched.generic._last_node_index = 0
+        serial_choice = sched.generic.select_host(scored)
+        sched.generic._last_node_index = 0
+        assert batch.pick_host(sched.generic, cp) == serial_choice
+    finally:
+        sched.stop()
+
+
+def test_batch_class_key_is_strict_content_hash(monkeypatch):
+    """Class-key collision guard: pods share a key iff their
+    scheduling-relevant content matches — chip demand splits the key,
+    metadata.name and ownerReferences do not (the owner shortcut is
+    deliberately dropped so one representative pass is provably valid
+    for every member)."""
+    monkeypatch.setenv("KGTPU_VECTORIZE", "1")
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    try:
+        a = tpu_pod("a", 1)
+        b = tpu_pod("b", 1)
+        c = tpu_pod("c", 2)
+        owned = tpu_pod("d", 1)
+        owned["metadata"]["ownerReferences"] = [{"uid": "u-1",
+                                                 "kind": "ReplicaSet"}]
+        ka = batch.batch_class(sched.generic, a)
+        assert ka is not None
+        assert batch.batch_class(sched.generic, b) == ka
+        assert batch.batch_class(sched.generic, c) != ka
+        assert batch.batch_class(sched.generic, owned) == ka
+    finally:
+        sched.stop()
+
+
+# ---- cycle-local capacity ledger -------------------------------------------
+
+
+def test_capacity_ledger_exact_decrements():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    try:
+        snap = sched.cache.snapshot_node("host0")
+        led = batch.CapacityLedger()
+        # unseeded: no information, never prunes
+        assert led.covers("host0", 99, {"cpu": 10 ** 9})
+        led.seed("host0", snap)
+        assert led.covers("host0", 4, {})
+        assert not led.covers("host0", 5, {})
+        led.charge("host0", 1, {})
+        assert led.covers("host0", 3, {})
+        assert not led.covers("host0", 4, {})
+        led.charge("host0", 3, {})
+        assert led.covers("host0", 0, {})
+        assert not led.covers("host0", 1, {})
+        # core headroom is an exact boundary too
+        res = next(iter(snap.core_allocatable))
+        free = (snap.core_allocatable[res]
+                - snap.requested_core.get(res, 0))
+        led2 = batch.CapacityLedger()
+        led2.seed("host0", snap)
+        assert led2.covers("host0", 0, {res: free})
+        assert not led2.covers("host0", 0, {res: free + 1})
+        led2.charge("host0", 0, {res: 1})
+        assert not led2.covers("host0", 0, {res: free})
+        assert led2.covers("host0", 0, {res: free - 1})
+    finally:
+        sched.stop()
+
+
+def test_capacity_ledger_first_award_seeds_post_award():
+    """`note_award`'s first touch of a node seeds from the POST-award
+    snapshot — the award is already subtracted there, so seeding AND
+    charging would double-count; later awards decrement the balance."""
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    try:
+        api.create_pod(tpu_pod("a", 1))
+        sched.run_until_idle()
+        snap = sched.cache.snapshot_node("host0")  # 3 chips free
+        led = batch.CapacityLedger()
+        led.note_award("host0", snap, 1, {})
+        assert led.covers("host0", 3, {})      # NOT double-charged to 2
+        assert not led.covers("host0", 4, {})
+        led.note_award("host0", snap, 1, {})   # second award: charges
+        assert led.covers("host0", 2, {})
+        assert not led.covers("host0", 3, {})
+    finally:
+        sched.stop()
+
+
+# ---- admission wake coalescing ---------------------------------------------
+
+
+def test_push_many_one_wake_one_depth_publish():
+    """A 256-pod release admits under ONE lock hold: one `notify_all`,
+    one `sched_queue_depth` republish — the per-pod `push` loop used to
+    wake the scheduling thread and republish the gauge 256 times."""
+    q = SchedulingQueue()
+    wakes = []
+    publishes = []
+    orig_notify = q._lock.notify_all
+    orig_publish = q._publish_depth_locked
+
+    def counting_notify():
+        wakes.append(1)
+        orig_notify()
+
+    def counting_publish():
+        publishes.append(1)
+        orig_publish()
+
+    q._lock.notify_all = counting_notify
+    q._publish_depth_locked = counting_publish
+    q.push_many([tpu_pod(f"r{i}", 1, priority=i % 3) for i in range(256)])
+    assert len(wakes) == 1
+    assert len(publishes) == 1
+    assert q.pending_count() == 256
+    # heap order is preserved: priority desc, FIFO within a priority
+    drained = q.pop_many(256, timeout=0.0)
+    assert len(drained) == 256
+    prios = [int(p["spec"]["priority"]) for p in drained]
+    assert prios == sorted(prios, reverse=True)
+
+
+def test_event_batch_coalesces_admissions_into_push_many():
+    api = InMemoryAPIServer()
+    api.create_node(flat_tpu_node("host0", chips=4))
+    sched = make_scheduler(api)
+    try:
+        batch_calls = []
+        single_calls = []
+        orig_many = sched.queue.push_many
+        sched.queue.push_many = lambda pods: (batch_calls.append(len(pods)),
+                                              orig_many(pods))[1]
+        sched.queue.push = lambda pod: single_calls.append(1)
+        events = [("pod", "added", tpu_pod(f"r{i}", 1)) for i in range(256)]
+        sched._on_event_batch(events)
+        assert batch_calls == [256]
+        assert single_calls == []
+    finally:
+        sched.stop()
+
+
+def test_pop_many_drains_ready_run_in_heap_order():
+    q = SchedulingQueue()
+    for name, prio in (("lo", 0), ("hi", 9), ("mid", 4)):
+        q.push(tpu_pod(name, 1, priority=prio))
+    got = [p["metadata"]["name"] for p in q.pop_many(2, timeout=0.0)]
+    assert got == ["hi", "mid"]          # bounded drain, heap order
+    got = [p["metadata"]["name"] for p in q.pop_many(8, timeout=0.0)]
+    assert got == ["lo"]
+    assert q.pop_many(8, timeout=0.0) == []
