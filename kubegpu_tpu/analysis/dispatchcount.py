@@ -178,49 +178,86 @@ def _jax_usable() -> Optional[str]:
     return None
 
 
-def smoke(tokens: int = 8) -> int:
-    """Fixed-shape decode loop under the counter; prints the bench JSON
-    keys; rc 1 when the fixed-shape section recompiled after warmup."""
+def smoke(tokens: int = 32, chunk: int = 16) -> int:
+    """Fixed-shape decode loop + fused decode chunk under the counter;
+    prints the bench JSON keys. rc 1 when either fixed-shape section
+    recompiled after warmup, or when the fused section's
+    ``serve_dispatches_per_token`` exceeds its ``1/chunk`` budget (50%
+    slack for the ceil on the last partial chunk) — the dispatch
+    amortization the fused serving data plane exists to buy."""
     reason = _jax_usable()
     if reason is not None:
         print(json.dumps({"skipped": f"jax unusable: {reason}"}))
         return 0
+    chunk = max(1, min(chunk, tokens))  # a chunk can't exceed the workload
     install()
     reset()
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     # tiny decode-shaped step: fixed [S] token/pos vectors, carried
     # cache, one jitted call per token — the shape discipline serve.py's
-    # _decode contract declares
+    # _decode contract declares (the per-token ORACLE path)
     def step(cache: Any, tok: Any, pos: Any) -> Any:
         cache = cache + tok[None, :].astype(cache.dtype)
         return cache, (tok + 1) % 7, pos + 1
 
+    # fused-chunk twin: one dispatch scans `chunk` steps on device — the
+    # shape discipline of serve.py's _chunk_step contract
+    def chunk_step(cache: Any, tok: Any, pos: Any) -> Any:
+        def body(carry: Any, _: Any) -> Any:
+            cache, tok, pos = carry
+            return step(cache, tok, pos), tok
+
+        (cache, tok, pos), toks = lax.scan(
+            body, (cache, tok, pos), None, length=chunk)
+        return cache, tok, pos, toks
+
     jstep = jax.jit(step, donate_argnums=(0,))
+    jchunk = jax.jit(chunk_step, donate_argnums=(0,))
     cache = jnp.zeros((4, 4), jnp.float32)
     tok = jnp.zeros(4, jnp.int32)
     pos = jnp.zeros(4, jnp.int32)
     with section("warmup"):
         cache, tok, pos = jstep(cache, tok, pos)
+        cache, tok, pos, _ = jchunk(cache, tok, pos)
     with section("decode_fixed"):
         for _ in range(tokens):
             cache, tok, pos = jstep(cache, tok, pos)
         jax.block_until_ready(cache)
+    with section("serve_fused"):
+        done = 0
+        while done < tokens:
+            cache, tok, pos, _ = jchunk(cache, tok, pos)
+            done += chunk
+        jax.block_until_ready(cache)
     dec = section_counts("decode_fixed")
+    fused = section_counts("serve_fused")
+    spt = fused["dispatches"] / tokens
+    budget = 1.5 / chunk
     out = {
         "decode_dispatches_per_token": dec["dispatches"] / tokens,
-        "serve_dispatches_per_token": dec["dispatches"] / tokens,
+        "serve_dispatches_per_token": spt,
+        "serve_dispatch_budget_per_token": budget,
         "workload_recompiles_total": counts()["recompiles_total"],
         "decode_fixed_recompiles": dec["compiles"],
+        "serve_fused_recompiles": fused["compiles"],
     }
     print(json.dumps(out))
-    if dec["compiles"] > 0:
-        print(f"error: fixed-shape decode section recompiled "
-              f"{dec['compiles']}x after warmup — a retrace hazard the "
-              "`# traced-shapes:` contracts should have caught")
-        return 1
-    return 0
+    rc = 0
+    for name, sec in (("decode", dec), ("fused serve", fused)):
+        if sec["compiles"] > 0:
+            print(f"error: fixed-shape {name} section recompiled "
+                  f"{sec['compiles']}x after warmup — a retrace hazard "
+                  "the `# traced-shapes:` contracts should have caught")
+            rc = 1
+    if spt > budget:
+        print(f"error: serve_dispatches_per_token {spt:.4f} exceeds the "
+              f"fused budget {budget:.4f} (1/chunk + 50% slack) — the "
+              "chunk is not amortizing dispatches")
+        rc = 1
+    return rc
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -230,13 +267,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="jit dispatch/compile counter (device-boundary "
                     "analyzer, dynamic half)")
     parser.add_argument("--smoke", action="store_true",
-                        help="run the fixed-shape decode smoke and gate "
-                             "on zero post-warmup recompiles")
-    parser.add_argument("--tokens", type=int, default=8,
-                        help="smoke decode-loop length (default 8)")
+                        help="run the fixed-shape decode + fused-chunk "
+                             "smoke and gate on zero post-warmup "
+                             "recompiles and the per-token dispatch "
+                             "budget")
+    parser.add_argument("--tokens", type=int, default=32,
+                        help="smoke decode-loop length (default 32)")
+    parser.add_argument("--chunk", type=int, default=16,
+                        help="fused decode-chunk length (default 16)")
     args = parser.parse_args(argv)
     if args.smoke:
-        return smoke(args.tokens)
+        return smoke(args.tokens, args.chunk)
     parser.error("nothing to do: pass --smoke")
     return 2
 

@@ -197,10 +197,23 @@ def main(argv=None) -> int:
         outs = [srv.result(r) for r in rids]
         stats = {"mode": "spec-serve" if args.spec_server else "serve",
                  "slots": args.slots,
+                 # which data plane served: the fused on-device chunk
+                 # (default) or the per-token oracle (KGTPU_FUSED_SERVE=0)
+                 "data_plane": "fused" if srv.fused else "hostloop",
+                 "chunk": srv.chunk,
                  "tokens": sum(len(o) for o in outs)}
         if args.prefix_cache:
             stats["prefix_hits"] = srv.prefix_hits
             stats["prefix_misses"] = srv.prefix_misses
+        from kubegpu_tpu import metrics as _metrics
+
+        # per-request latency from the serving histograms (a fresh
+        # process, so the samples are exactly this run's)
+        if _metrics.SERVE_TTFT_MS.n:
+            stats["ttft_p50_ms"] = round(
+                _metrics.SERVE_TTFT_MS.percentile(0.5), 3)
+            stats["itl_p50_ms"] = round(
+                _metrics.SERVE_ITL_MS.percentile(0.5), 3)
     wall = time.perf_counter() - t0
 
     if restored_step is not None:

@@ -94,6 +94,23 @@ def _batch_summary() -> dict:
                 metrics.SCHED_THROUGHPUT.value, 1)}
 
 
+def _serving_summary() -> dict:
+    """Fused serving data plane health (metrics.py): TTFT/ITL latency
+    percentiles from the chunk-boundary histograms plus the demand
+    gauges the autoscaler would key on. Only attached when a serving
+    workload actually ran in-process (the chaos/placement scenarios
+    schedule pods, they don't decode), so an all-zero block never
+    muddies a scheduler-only doc."""
+    return {"requests": metrics.SERVE_TTFT_MS.n,
+            "ttft_p50_ms": round(metrics.SERVE_TTFT_MS.percentile(0.5), 3),
+            "ttft_p99_ms": round(metrics.SERVE_TTFT_MS.percentile(0.99), 3),
+            "itl_p50_ms": round(metrics.SERVE_ITL_MS.percentile(0.5), 3),
+            "itl_p99_ms": round(metrics.SERVE_ITL_MS.percentile(0.99), 3),
+            "queue_depth": metrics.SERVE_QUEUE_DEPTH.value,
+            "slot_utilization": round(
+                metrics.SERVE_SLOT_UTILIZATION.value, 3)}
+
+
 def _data_plane_summary() -> dict:
     """Binder-pipeline, watch-batching, and wire-transport health
     (metrics.py): bind latency p50/count, live binder depth, last watch
@@ -244,16 +261,19 @@ def run_chaos_scenario(seed: int = 0, lost_after_s: float = 0.9,
         if sorted(len(c) for c in chips.values()) != [4, 4] or \
                 len(set(all_chips)) != 8:
             raise RuntimeError(f"chip leak/short allocation: {chips}")
-        return {"recovery_ms": round(recovery_ms, 1),
-                "victim": victim,
-                "first_placement": first,
-                "final_placement": final,
-                "evicted_pods": lifecycle.evicted_total,
-                "fit_cache": _fit_cache_summary(),
-                "batch": _batch_summary(),
-                "data_plane": _data_plane_summary(),
-                "chaos_faults": {f"{c}:{k}": n for (c, k), n
-                                 in sorted(net.faults.items())}}
+        doc = {"recovery_ms": round(recovery_ms, 1),
+               "victim": victim,
+               "first_placement": first,
+               "final_placement": final,
+               "evicted_pods": lifecycle.evicted_total,
+               "fit_cache": _fit_cache_summary(),
+               "batch": _batch_summary(),
+               "data_plane": _data_plane_summary(),
+               "chaos_faults": {f"{c}:{k}": n for (c, k), n
+                                in sorted(net.faults.items())}}
+        if metrics.SERVE_TTFT_MS.n:
+            doc["serving"] = _serving_summary()
+        return doc
     finally:
         lifecycle.stop()
         for adv in advs.values():
@@ -366,16 +386,19 @@ def run_chip_kill_scenario(seed: int = 0,
                     f"no CheckpointRequested event for {name}")
         if sched.resync_count:
             raise RuntimeError(f"watch relisted {sched.resync_count}x")
-        return {"recovery_ms": round(recovery_ms, 1),
-                "victim": {"node": victim_node, "chip": victim_chip},
-                "first_placement": first,
-                "final_placement": final,
-                "repairs": repair.repaired_total,
-                "relists": sched.resync_count,
-                "injected": [list(f[:3]) for f in chaos.injected],
-                "fit_cache": _fit_cache_summary(),
-                "batch": _batch_summary(),
-                "data_plane": _data_plane_summary()}
+        doc = {"recovery_ms": round(recovery_ms, 1),
+               "victim": {"node": victim_node, "chip": victim_chip},
+               "first_placement": first,
+               "final_placement": final,
+               "repairs": repair.repaired_total,
+               "relists": sched.resync_count,
+               "injected": [list(f[:3]) for f in chaos.injected],
+               "fit_cache": _fit_cache_summary(),
+               "batch": _batch_summary(),
+               "data_plane": _data_plane_summary()}
+        if metrics.SERVE_TTFT_MS.n:
+            doc["serving"] = _serving_summary()
+        return doc
     finally:
         repair.stop()
         for adv in advs.values():
@@ -1088,6 +1111,8 @@ def _run_simulation(args) -> int:
            "batch": batch, "data_plane": data_plane}
     if n_sched > 1:
         doc["ha"] = {"schedulers": n_sched, **_ha_summary()}
+    if metrics.SERVE_TTFT_MS.n:
+        doc["serving"] = _serving_summary()
     if args.json:
         print(json.dumps(doc, indent=2))
     else:

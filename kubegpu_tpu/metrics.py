@@ -379,6 +379,18 @@ SCHED_BATCH_SIZE = Histogram(  # analysis: disable=metric-registration -- pod-co
 SCHED_BATCH_CLASSES = Histogram(  # analysis: disable=metric-registration -- class-count histogram; the unit IS classes-per-cycle, not a time/bytes quantity the suffix vocabulary covers
     "sched_batch_classes_per_cycle", start_us=1.0, factor=2.0, count=12)
 SCHED_THROUGHPUT = Gauge("sched_throughput_pods_per_s")
+# Serving data plane (workload/serve.py): serve_ttft_ms spans
+# submit -> first emitted token (queue wait + bucketed prefill + the
+# admission readback); serve_itl_ms is the steady-state inter-token
+# latency — on the fused path one chunk dispatch's wall clock divided by
+# the tokens that slot emitted, so a frozen-slot-heavy chunk honestly
+# shows its per-token cost. serve_queue_depth / serve_slot_utilization
+# are the live demand signal the autoscaler scenario consumes: queued
+# requests not yet admitted, and the admitted fraction of decode slots.
+SERVE_TTFT_MS = Histogram("serve_ttft_ms", start_us=0.25)
+SERVE_ITL_MS = Histogram("serve_itl_ms", start_us=0.01)
+SERVE_QUEUE_DEPTH = Gauge("serve_queue_depth")
+SERVE_SLOT_UTILIZATION = Gauge("serve_slot_utilization")  # 0..1 ratio
 
 
 def all_metrics() -> list:

@@ -222,6 +222,89 @@ def truncated_probs(logits, temperature: float, top_k: int, top_p: float):
     return jax.nn.softmax(z, axis=-1)
 
 
+def _select_token_rows(logits, keys, temperature: float, top_k: int,
+                       top_p: float):
+    """Per-row-keyed variant of `_select_token`: row ``i`` of
+    ``logits [B, V]`` samples with its OWN key ``keys[i]``. The serving
+    layer keys every selection by (request, position) so the sampled
+    stream of a request is a pure function of its rng lineage — the same
+    tokens whatever batch it shares, whichever step or fused chunk emits
+    them, and whether admission happened early or late. Shares
+    `_truncate_logits` with `_select_token`, so both see the identical
+    truncated support; greedy ignores the keys entirely."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    z = _truncate_logits(logits.astype(jnp.float32) / temperature,
+                         top_k, top_p)
+    return jax.vmap(lambda k, row: jax.random.categorical(k, row))(keys, z)
+
+
+def make_decode_chunk(cfg: TransformerConfig, mesh=None, chunk: int = 16,
+                      temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, eos_id: int | None = None):
+    """Build the FUSED DECODE CHUNK the serving loop dispatches: one
+    `lax.scan` generating up to ``chunk`` tokens for every batch row in
+    a single device program, so the host pays one dispatch (and one
+    readback) per chunk instead of one per token.
+
+    ``chunk_step(params, cache, tok, pos, active, budget, skeys) ->
+    (new_cache, toks [B, chunk], n_emit [B], tok, pos, active)``:
+
+    - ``tok``/``pos`` are each row's last emitted token and its absolute
+      position (the forward-step invariant `serve.DecodeServer` keeps);
+    - ``active [B] bool`` masks rows that should emit; inactive rows
+      ride along FROZEN: their ``tok``/``pos`` stop advancing and each
+      iteration rewrites the same K/V position with the same values —
+      idempotent, and overwritten by prefill when the slot is reused;
+    - ``budget [B] int32`` is each row's remaining ``max_new`` quota;
+    - ``skeys [B, 2] uint32`` are per-row sampling key roots: the
+      selection at position ``p`` uses ``fold_in(skeys[b], p)``
+      (`_select_token_rows`), making sampled tokens position-keyed and
+      therefore identical between this fused path and the per-token
+      oracle path.
+
+    EOS (when ``eos_id`` is set) and budget exhaustion are detected ON
+    DEVICE: a row that emits EOS or its budget-th token freezes for the
+    rest of the chunk, so each row's emissions are a clean prefix of
+    ``toks[b]`` of length ``n_emit[b]`` — everything the host needs
+    comes back in ONE batched transfer."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    step = make_forward_step(cfg, mesh)
+    top_k = validate_sampling(cfg, temperature, top_k, top_p)
+    sampling = temperature != 0.0
+
+    def chunk_step(params, cache, tok, pos, active, budget, skeys):
+        def body(carry, _):
+            cache, tok, pos, active, emitted = carry
+            logits, cache = step(params, cache, tok[:, None], pos)
+            if sampling:
+                rkeys = jax.vmap(jax.random.fold_in)(skeys, pos)
+                nxt = _select_token_rows(logits[:, -1, :], rkeys,
+                                         temperature, top_k, top_p)
+            else:
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            emit = active
+            nxt = jnp.where(emit, nxt, tok)       # frozen rows hold
+            pos = jnp.where(emit, pos + 1, pos)
+            emitted = emitted + emit.astype(jnp.int32)
+            alive = emitted < budget
+            if eos_id is not None:
+                alive &= nxt != eos_id            # EOS is emitted, THEN
+            active = active & alive               # the row freezes
+            return (cache, nxt, pos, active, emitted), \
+                jnp.where(emit, nxt, 0)
+
+        carry0 = (cache, tok, pos, active, jnp.zeros_like(pos))
+        (cache, tok, pos, active, emitted), toks = lax.scan(
+            body, carry0, None, length=chunk)
+        return (cache, jnp.swapaxes(toks, 0, 1), emitted, tok, pos,
+                active)
+
+    return chunk_step
+
+
 def _select_token(logits, key, temperature: float, top_k: int,
                   top_p: float):
     """Pick the next token per batch row from ``logits [B, V]``.

@@ -14,14 +14,32 @@ once —
   at ``p`` is generated, and a query at position ``q`` only attends
   ``kv <= q`` — every attended entry has been overwritten by a real
   write first.
-- **step**: ONE jitted forward for all slots at per-row positions
-  (`make_forward_step`'s vector ``start_pos``), sampling or greedy via
-  `_select_token`. Idle slots ride along at position 0 with a dummy
-  token (static shapes beat masking them out; their cache writes land in
-  a slot that prefill fully overwrites on reuse).
+- **fused chunk** (the default data plane): ONE jitted `lax.scan`
+  (`decode.make_decode_chunk`) generates up to ``chunk`` tokens for all
+  slots per dispatch, detecting per-slot EOS/``max_new`` ON DEVICE and
+  freezing finished rows behind an active mask, so the host pays one
+  dispatch and ONE batched readback per chunk instead of per token.
+  Continuous batching happens at chunk boundaries: ``step()`` drains
+  finished slots, admits queued requests through the bucketed prefill,
+  then launches the next chunk — idle slots ride along masked, shapes
+  stay static, everything compiles once.
 - **finish**: on EOS or the request's ``max_new``, the slot returns to
   the free list and the next queued request is admitted — requests never
   wait for a whole batch to drain, which is the point.
+
+``KGTPU_FUSED_SERVE=0`` disables the fused chunk and runs the original
+per-token host loop — one jitted forward per generated token — which
+survives as the differential ORACLE (mirroring ``KGTPU_VECTORIZE`` /
+``KGTPU_BATCH``): tests/test_serve_fused.py pins token-for-token float32
+parity between the two paths, greedy and sampled.
+
+Sampling keys are position-keyed per request: the selection at absolute
+position ``p`` of request ``rid`` uses ``fold_in(fold_in(rng, rid), p)``
+(`decode._select_token_rows`). A request's sampled stream is therefore a
+pure function of its rng lineage — independent of which slot it lands
+in, which other requests share the batch, when it was admitted, and
+whether the fused chunk or the per-token oracle emitted it. That is
+what makes cross-path sampled parity testable at all.
 
 Numerics: per-request tokens match `make_generate` exactly in float32
 (asserted by tests/test_serve.py). On TPU in bfloat16 the padded-bucket
@@ -34,6 +52,8 @@ token margins dwarf rounding.
 
 from __future__ import annotations
 
+import os
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -41,7 +61,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from kubegpu_tpu.workload.decode import (_select_token, init_cache,
+from kubegpu_tpu import metrics
+from kubegpu_tpu.workload.decode import (_select_token, _select_token_rows,
+                                         init_cache, make_decode_chunk,
                                          make_forward_step, truncated_probs,
                                          validate_sampling)
 from kubegpu_tpu.workload.model import TransformerConfig
@@ -54,6 +76,7 @@ class _Request:
     max_new: int
     out: list = field(default_factory=list)
     done: bool = False
+    t_submit: float = 0.0
 
 
 def _bucket_for(n: int, buckets: tuple) -> int:
@@ -72,6 +95,12 @@ class DecodeServer:
     via ``temperature``/``top_k``/``top_p`` + ``rng`` like
     `make_generate`.
 
+    The data plane is the FUSED DECODE CHUNK: each ``step()`` admits
+    what fits, then dispatches one jitted scan that emits up to
+    ``chunk`` tokens per slot with on-device EOS/``max_new`` freezing
+    and one batched readback (``KGTPU_FUSED_SERVE=0`` falls back to the
+    per-token oracle loop).
+
     ``prefix_cache_size > 0`` enables PREFIX REUSE: the K/V of served
     prompts is retained (LRU, that many entries) and a request whose
     prompt extends a stored one splices the cached rows in and prefills
@@ -80,12 +109,16 @@ class DecodeServer:
     position-identical. ``prefix_hits``/``prefix_misses`` count reuse.
 
     With ``draft_params``/``draft_cfg`` the server decodes
-    SPECULATIVELY per slot: each step proposes ``lookahead`` draft
+    SPECULATIVELY per slot: each round proposes ``lookahead`` draft
     tokens for every slot, verifies all slots in one batched target
     forward, and emits each slot's accepted prefix plus one token —
     greedy-exact, and distribution-exact under sampling (both target
     and draft rows truncated-and-renormalized, `speculative.py`'s
-    acceptance rule vmapped over slots).
+    acceptance rule vmapped over slots). On the fused path the whole
+    round — draft scan, target verify, accept/resample, commit and
+    freezing — is ONE jitted program, and ``spec_rounds`` consecutive
+    rounds ride in a single dispatch with one batched readback.
+    ``spec_accepted``/``spec_proposed`` track the live acceptance rate.
     """
 
     def __init__(self, cfg: TransformerConfig, params, slots: int = 4,
@@ -94,9 +127,14 @@ class DecodeServer:
                  top_p: float = 1.0, eos_id: int | None = None,
                  prefill_buckets: tuple = (32, 128, 512), rng=None,
                  draft_params=None, draft_cfg: TransformerConfig | None = None,
-                 lookahead: int = 4, prefix_cache_size: int = 0):
+                 lookahead: int = 4, prefix_cache_size: int = 0,
+                 chunk: int = 16, spec_rounds: int = 4):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if spec_rounds < 1:
+            raise ValueError(f"spec_rounds must be >= 1, got {spec_rounds}")
         if (draft_params is None) != (draft_cfg is None):
             raise ValueError("draft_params and draft_cfg go together")
         self.cfg = cfg
@@ -108,7 +146,13 @@ class DecodeServer:
         self.top_k = int(validate_sampling(cfg, self.temperature, top_k,
                                            top_p))
         self.top_p = float(top_p)
-        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if hasattr(rng, "dtype") and jnp.issubdtype(rng.dtype,
+                                                    jax.dtypes.prng_key):
+            rng = jax.random.key_data(rng)  # raw [2] uint32 throughout
+        self.rng = rng
+        self.chunk = int(chunk)
+        self.fused = os.environ.get("KGTPU_FUSED_SERVE", "1") != "0"
         # max_seq is always the terminal bucket: any prompt that fits the
         # cache must be admissible, just at the coarsest padding
         self.buckets = tuple(sorted(
@@ -118,18 +162,23 @@ class DecodeServer:
         self.cache = init_cache(cfg, slots, self.max_seq)
         self.pos = np.zeros(slots, np.int32)        # next position per slot
         self.tok = np.zeros(slots, np.int32)        # last emitted token
+        # per-slot sampling key root = fold_in(rng, rid) of the resident
+        # request; zeros while idle (greedy never reads them)
+        self.slot_key = np.zeros((slots, 2), np.uint32)
         self.slot_req: list = [None] * slots        # _Request or None
         self._free = list(range(slots))
         self._queue: list = []
         self._requests: dict = {}
         self._next_rid = 0
-        self._tick = 0
 
-        def prefill(params, cache, tokens, slot, true_len, key):
+        def prefill(params, cache, tokens, slot, true_len, rkey):
             """Pad-to-bucket prompt pass for ONE slot; returns the updated
             big cache and the slot's sampled first token. Selection runs
             inside the trace so admission pays ONE scalar readback, not a
-            vocab-row transfer + eager select per request."""
+            vocab-row transfer + eager select per request. ``rkey`` is
+            the request's key root; the first selection happens at
+            position ``true_len - 1``, so its key is the same
+            position-keyed fold the decode paths use."""
             small = init_cache(cfg, 1, tokens.shape[1])
             logits, small = self._fstep(params, small, tokens, 0)
             new_cache = []
@@ -139,6 +188,7 @@ class DecodeServer:
                         big[k], sm[k], (slot, 0, 0, 0)) for k in ("k", "v")})
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], true_len - 1, axis=0, keepdims=False)
+            key = jax.random.fold_in(rkey, true_len - 1)
             first = _select_token(last[None, :], key, self.temperature,
                                   self.top_k, self.top_p)[0]
             return new_cache, first.astype(jnp.int32)
@@ -148,7 +198,7 @@ class DecodeServer:
         # place instead of copying the whole multi-slot cache per token
         # traced-shapes: tokens [1, bucket] int32 — varies per prefill
         # bucket (one trace per bucket by design); slot/true_len scalar
-        # int32, key [2] uint32
+        # int32, rkey [2] uint32
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
         # -- prefix reuse: stored K/V of previously-served prompts lets a
@@ -169,12 +219,14 @@ class DecodeServer:
         self.prefix_misses = 0
 
         def rem_prefill(params, cache, stored, rem_tokens, slot, plen,
-                        rem_true, key):
+                        rem_true, rkey):
             """Splice a stored prefix (``[1, b, ...]`` per layer) into a
             fresh row, run the remainder chunk at position ``plen``, and
             write the row back into the big cache at ``slot``; returns
             the cache and the sampled first token (device-side selection,
-            as in ``prefill``)."""
+            as in ``prefill``). The selection position is the FULL
+            prompt's last token, ``plen + rem_true - 1``, so a prefix
+            hit samples the identical first token as a full prefill."""
             s_max = cache[0]["k"].shape[1]
             row = []
             for big, st in zip(cache, stored):
@@ -191,13 +243,14 @@ class DecodeServer:
                         big[k], rw[k], (slot, 0, 0, 0)) for k in ("k", "v")})
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], rem_true - 1, axis=0, keepdims=False)
+            key = jax.random.fold_in(rkey, plen + rem_true - 1)
             first = _select_token(last[None, :], key, self.temperature,
                                   self.top_k, self.top_p)[0]
             return new_cache, first.astype(jnp.int32)
 
         # traced-shapes: rem_tokens [1, bucket] int32 — varies per
         # remainder bucket; stored pytree [1, plen_bucket] per layer —
-        # varies per stored-prefix bucket; scalars int32, key [2] uint32
+        # varies per stored-prefix bucket; scalars int32, rkey [2] uint32
         self._rem_prefill = jax.jit(rem_prefill, donate_argnums=(1,))
 
         def snapshot_prefix(cache, slot, b: int):
@@ -213,19 +266,39 @@ class DecodeServer:
 
         self._snapshot_prefix = snapshot_prefix
 
-        def decode(params, cache, tok, pos, key):
+        def decode(params, cache, tok, pos, skeys):
             logits, cache = self._fstep(params, cache, tok[:, None], pos)
-            nxt = _select_token(logits[:, -1, :], key, self.temperature,
-                                self.top_k, self.top_p)
+            if self.temperature != 0.0:
+                rkeys = jax.vmap(jax.random.fold_in)(skeys, pos)
+            else:
+                rkeys = skeys  # greedy: keys unread
+            nxt = _select_token_rows(logits[:, -1, :], rkeys,
+                                     self.temperature, self.top_k,
+                                     self.top_p)
             return cache, nxt.astype(jnp.int32)
 
-        # traced-shapes: tok/pos [S] int32, key [2] uint32 — fixed per
-        # server (S = slots), one trace for the server's lifetime
+        # traced-shapes: tok/pos [S] int32, skeys [S, 2] uint32 — fixed
+        # per server (S = slots), one trace for the server's lifetime
         self._decode = jax.jit(decode, donate_argnums=(1,))
+
+        # -- fused decode chunk: the default serving data plane. One
+        # dispatch emits up to `chunk` tokens per slot with on-device
+        # EOS/budget freezing (decode.make_decode_chunk has the chunk
+        # semantics; the kill switch is read once at construction).
+        chunk_step = make_decode_chunk(
+            cfg, mesh, chunk=self.chunk, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p, eos_id=eos_id)
+        # traced-shapes: tok/pos/budget [S] int32, active [S] bool,
+        # skeys [S, 2] uint32 — fixed per server, one trace for the
+        # server's lifetime (chunk length is static by construction)
+        self._chunk_step = jax.jit(chunk_step, donate_argnums=(1,))
 
         # -- speculative mode: a draft model proposes k tokens per slot,
         # the target verifies every slot's chunk in ONE batched forward
         self.spec = draft_params is not None
+        self.spec_rounds = int(spec_rounds)
+        self.spec_accepted = 0   # drafts the target accepted
+        self.spec_proposed = 0   # drafts proposed (k per active slot)
         if self.spec:
             if draft_cfg.vocab != cfg.vocab:
                 raise ValueError("draft and target must share a vocabulary")
@@ -244,35 +317,49 @@ class DecodeServer:
             sampling = self.temperature != 0.0
             k = self.k
 
-            def pick(logits, key):
-                """[S, V] -> sampled/greedy token (and its truncated
-                distribution row when sampling)."""
+            def round_keys(skeys, pos):
+                """Per-slot key root for ONE speculative round: keyed by
+                (request, round-start position) so both serve paths —
+                per-round host loop and fused multi-round scan — derive
+                the identical randomness for the identical round."""
+                return jax.vmap(jax.random.fold_in)(skeys, pos)
+
+            def pick_rows(logits, rkeys):
+                """[S, V] + per-row keys -> next token per slot (and the
+                truncated distribution row each was sampled from)."""
                 if sampling:
                     p = truncated_probs(logits, self.temperature,
                                         self.top_k, self.top_p)
-                    return jax.random.categorical(
-                        key, jnp.log(jnp.maximum(p, 1e-30))), p
+                    toks = jax.vmap(
+                        lambda kk, row: jax.random.categorical(
+                            kk, jnp.log(jnp.maximum(row, 1e-30))))(rkeys, p)
+                    return toks, p
                 return jnp.argmax(logits, axis=-1), jnp.zeros(())
 
-            def spec_propose(dparams, dcache, prev, tok, pos, key):
+            def spec_propose(dparams, dcache, prev, tok, pos, skeys):
                 """k draft tokens per slot. First step reprocesses
                 [prev, tok] at pos-1: after a fully-accepted round the
                 draft never saw its own k-th proposal (K/V hole at
                 pos-1); re-writing prev there fills it, idempotently
                 otherwise — same catch-up trick as
-                speculative.draft_propose, batched."""
+                speculative.draft_propose, batched. Draft step ``i``
+                samples with ``fold_in(round_key, i)`` per slot."""
+                rkeys = round_keys(skeys, pos)
+
+                def fold_i(i):
+                    return jax.vmap(
+                        lambda kk: jax.random.fold_in(kk, i))(rkeys)
+
                 chunk = jnp.stack([prev, tok], axis=1)         # [S, 2]
                 start = jnp.maximum(pos - 1, 0)
                 logits, dcache = self._dstep(dparams, dcache, chunk, start)
-                first, q0 = pick(logits[:, -1, :],
-                                 jax.random.fold_in(key, 0))
+                first, q0 = pick_rows(logits[:, -1, :], fold_i(0))
 
                 def body(carry, i):
                     dcache, t, p = carry
                     logits, dcache = self._dstep(dparams, dcache,
                                                  t[:, None], p)
-                    nxt, q = pick(logits[:, -1, :],
-                                  jax.random.fold_in(key, i))
+                    nxt, q = pick_rows(logits[:, -1, :], fold_i(i))
                     return (dcache, nxt, p + 1), (nxt, q)
 
                 (dcache, _, _), (toks, qs) = lax.scan(
@@ -286,9 +373,11 @@ class DecodeServer:
                     q_rows = jnp.zeros(())
                 return dcache, drafts.astype(jnp.int32), q_rows
 
-            def spec_verify(params, cache, chunk, pos, key, q_rows):
+            def spec_verify(params, cache, chunk, pos, skeys, q_rows):
                 """One batched target forward over every slot's
-                [last, d1..dk] chunk; per-slot acceptance. Greedy
+                [last, d1..dk] chunk; per-slot acceptance. The accept /
+                resample key is ``fold_in(round_key, k)`` per slot —
+                disjoint from the draft-step indices 0..k-1. Greedy
                 ignores ``q_rows`` (pass a dummy scalar)."""
                 logits, cache = self._fstep(params, cache, chunk, pos)
                 s = chunk.shape[0]
@@ -296,12 +385,14 @@ class DecodeServer:
                     from kubegpu_tpu.workload.speculative import \
                         accept_resample
 
+                    rkeys = round_keys(skeys, pos)
+                    akeys = jax.vmap(
+                        lambda kk: jax.random.fold_in(kk, k))(rkeys)
                     p_rows = truncated_probs(
                         logits.reshape(s * (k + 1), -1), self.temperature,
                         self.top_k, self.top_p).reshape(s, k + 1, -1)
                     n_acc, extra = jax.vmap(accept_resample)(
-                        p_rows, q_rows, chunk[:, 1:],
-                        jax.random.split(key, s))
+                        p_rows, q_rows, chunk[:, 1:], akeys)
                     return cache, n_acc, extra
                 greedy = jnp.argmax(logits, axis=-1)       # [S, k+1]
                 agree = chunk[:, 1:] == greedy[:, :-1]
@@ -312,12 +403,111 @@ class DecodeServer:
                     greedy, n_acc[:, None], axis=1)[:, 0]
                 return cache, n_acc, extra
 
-            # traced-shapes: prev/tok/pos [S] int32, key [2] uint32 —
-            # fixed per server, one trace for the server's lifetime
+            def spec_commit(chunk2, n_acc, extra, prev, tok, pos, active,
+                            budget):
+                """The round's emission + freezing, ON DEVICE: the
+                emitted tokens are ``drafts[:n_acc] + [extra]``,
+                truncated at the first EOS or the budget, exactly the
+                host commit loop's semantics. Returns the masked
+                candidate row [S, k+1] (valid prefix of length n_emit),
+                per-slot n_emit, and the advanced carry state —
+                continuing rows advance ``n_acc + 1`` positions with the
+                standard catch-up anchor; finished/frozen rows hold."""
+                s = chunk2.shape[0]
+                idx = jnp.arange(k + 1)[None, :]
+                drafts_pad = jnp.concatenate(
+                    [chunk2[:, 1:], jnp.zeros((s, 1), jnp.int32)], axis=1)
+                cand = jnp.where(idx == n_acc[:, None],
+                                 extra[:, None].astype(jnp.int32),
+                                 drafts_pad)
+                emit = (idx <= n_acc[:, None]) & (idx < budget[:, None]) \
+                    & active[:, None]
+                if self.eos_id is not None:
+                    is_eos = cand == self.eos_id
+                    before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+                        - is_eos.astype(jnp.int32)
+                    emit &= before == 0        # EOS is emitted, THEN
+                    hit_eos = jnp.any(emit & is_eos, axis=1)
+                else:
+                    hit_eos = jnp.zeros(s, bool)
+                n_emit = jnp.sum(emit.astype(jnp.int32), axis=1)
+                fin = hit_eos | (n_emit >= budget)
+                cont = active & ~fin
+                anchor = jnp.take_along_axis(
+                    chunk2, n_acc[:, None], axis=1)[:, 0]
+                prev = jnp.where(cont, anchor, prev)
+                tok = jnp.where(cont, extra.astype(jnp.int32), tok)
+                pos = jnp.where(cont, pos + n_acc + 1, pos)
+                budget = budget - n_emit
+                return (jnp.where(emit, cand, 0), n_emit, prev, tok, pos,
+                        cont, budget)
+
+            # oracle-path jits (KGTPU_FUSED_SERVE=0): one dispatch per
+            # propose and one per verify, host-side commit per round
+            # traced-shapes: prev/tok/pos [S] int32, skeys [S, 2] uint32
+            # — fixed per server, one trace for the server's lifetime
             self._spec_propose = jax.jit(spec_propose, donate_argnums=(1,))
-            # traced-shapes: chunk [S, k+1] int32, pos [S] int32, q_rows
-            # [S, k, V] f32 (or scalar when greedy) — fixed per server
+            # traced-shapes: chunk [S, k+1] int32, pos [S] int32, skeys
+            # [S, 2] uint32, q_rows [S, k, V] f32 (or scalar when
+            # greedy) — fixed per server
             self._spec_verify = jax.jit(spec_verify, donate_argnums=(1,))
+            self._spec_commit = spec_commit  # host path commits eagerly
+
+            R = self.spec_rounds
+
+            def spec_fused(params, dparams, cache, dcache, prev, tok, pos,
+                           active, budget, skeys):
+                """``spec_rounds`` speculative rounds in ONE dispatch:
+                each scan iteration is draft-propose -> target-verify ->
+                accept/resample -> commit, all on device. Every round's
+                emissions land contiguously in a per-slot output buffer
+                (each round writes its full k+1 candidate row at the
+                slot's running offset and advances the offset by that
+                round's n_emit, so later rounds overwrite the invalid
+                tail and the valid tokens stay a clean prefix). Finished
+                slots freeze and ride the remaining rounds masked."""
+                buf0 = jnp.zeros((slots, R * (k + 1)), jnp.int32)
+                off0 = jnp.zeros(slots, jnp.int32)
+                acc0 = jnp.zeros(slots, jnp.int32)
+
+                def round_body(carry, _):
+                    (cache, dcache, prev, tok, pos, active, budget, off,
+                     buf, acc_n, acc_d) = carry
+                    was_active = active
+                    dcache, drafts, q_rows = spec_propose(
+                        dparams, dcache, prev, tok, pos, skeys)
+                    chunk2 = jnp.concatenate([tok[:, None], drafts],
+                                             axis=1)
+                    cache, n_acc, extra = spec_verify(
+                        params, cache, chunk2, pos, skeys, q_rows)
+                    (cand, n_emit, prev, tok, pos, active,
+                     budget) = spec_commit(chunk2, n_acc, extra, prev,
+                                           tok, pos, active, budget)
+                    buf = jax.vmap(
+                        lambda row, c, o: lax.dynamic_update_slice(
+                            row, c, (o,)))(buf, cand, off)
+                    off = off + n_emit
+                    acc_n = acc_n + jnp.where(was_active, n_acc, 0)
+                    acc_d = acc_d + jnp.where(was_active, k, 0)
+                    return (cache, dcache, prev, tok, pos, active,
+                            budget, off, buf, acc_n, acc_d), None
+
+                (cache, dcache, prev, tok, pos, active, _, off, buf,
+                 acc_n, acc_d), _ = lax.scan(
+                    round_body,
+                    (cache, dcache, prev, tok, pos, active, budget, off0,
+                     buf0, acc0, acc0), None, length=R)
+                return (cache, dcache, buf, off, prev, tok, pos, active,
+                        acc_n, acc_d)
+
+            # traced-shapes: prev/tok/pos/budget [S] int32, active [S]
+            # bool, skeys [S, 2] uint32 — fixed per server, one trace
+            # for the server's lifetime (k and spec_rounds are static)
+            # donate the caches AND the [S] carry vectors (prev/tok/
+            # pos/active): all thread in and out every dispatch, and
+            # the host uploads fresh buffers each step anyway
+            self._spec_fused = jax.jit(
+                spec_fused, donate_argnums=(2, 3, 4, 5, 6, 7))
 
             def dprefill(dparams, dcache, tokens, slot):
                 small = init_cache(draft_cfg, 1, tokens.shape[1])
@@ -351,9 +541,11 @@ class DecodeServer:
                 + f" exceeds max_seq {self.max_seq}")
         rid = self._next_rid
         self._next_rid += 1
-        req = _Request(rid, list(prompt), max_new)
+        req = _Request(rid, list(prompt), max_new,
+                       t_submit=time.perf_counter())
         self._requests[rid] = req
         self._queue.append(req)
+        metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         return rid
 
     def result(self, rid: int) -> list | None:
@@ -374,63 +566,158 @@ class DecodeServer:
     def pending(self) -> int:
         return len(self._queue) + sum(r is not None for r in self.slot_req)
 
+    @property
+    def spec_acceptance(self) -> float:
+        """Live draft-acceptance rate (accepted / proposed)."""
+        return self.spec_accepted / max(1, self.spec_proposed)
+
     def step(self) -> int:
-        """Admit what fits, decode for every active slot — one token per
-        step, or up to ``lookahead + 1`` in speculative mode. Returns
-        the number of active slots stepped."""
+        """Admit what fits, then decode for every active slot: one fused
+        chunk (up to ``chunk`` tokens per slot — or ``spec_rounds``
+        speculative rounds — per dispatch), or a single token on the
+        per-token oracle path (``KGTPU_FUSED_SERVE=0``). Returns the
+        number of active slots stepped."""
         while self._free and self._queue:
             self._admit(self._free.pop(0), self._queue.pop(0))
+        metrics.SERVE_QUEUE_DEPTH.set(len(self._queue))
         active = [s for s in range(self.slots)
                   if self.slot_req[s] is not None]
+        metrics.SERVE_SLOT_UTILIZATION.set(len(active) / self.slots)
         if not active:
             return 0
         if self.spec:
-            return self._spec_step(active)
-        key = jax.random.fold_in(self.rng, self._tick)
-        self._tick += 1
+            return self._spec_fused_step(active) if self.fused \
+                else self._spec_step(active)
+        if self.fused:
+            return self._fused_step(active)
+        t0 = time.perf_counter()
         # ONE upload per step: tok and pos ride a single [2, S] transfer
         # and are sliced apart device-side (two jnp.asarray calls were
         # two host->device dispatches per token)
         tp = jnp.asarray(np.stack([self.tok, self.pos]))
         self.cache, nxt = self._decode(self.params, self.cache, tp[0],
-                                       tp[1], key)
+                                       tp[1], jnp.asarray(self.slot_key))
         # host-sync: allowed -- the per-step token readback is the
-        # product: EOS tests and per-request output append are host
-        # decisions (ONE batched [S] transfer per step)
+        # product on the oracle path: EOS tests and per-request output
+        # append are host decisions (ONE batched [S] transfer per step)
         nxt = np.asarray(nxt)
+        itl_ms = (time.perf_counter() - t0) * 1e3
         for s in active:
             req = self.slot_req[s]
             tok = int(nxt[s])
             req.out.append(tok)
             self.tok[s] = tok
             self.pos[s] += 1
+            metrics.SERVE_ITL_MS.observe(itl_ms)
             if (self.eos_id is not None and tok == self.eos_id) or \
                     len(req.out) >= req.max_new:
                 self._finish(s)
         return len(active)
 
+    def _budget_mask(self, active: list):
+        """Per-slot remaining ``max_new`` quota + active mask for the
+        fused programs (idle slots: zero budget, masked off)."""
+        budget = np.zeros(self.slots, np.int32)
+        amask = np.zeros(self.slots, bool)
+        for s in active:
+            budget[s] = self.slot_req[s].max_new - len(self.slot_req[s].out)
+            amask[s] = True
+        return budget, amask
+
+    def _fused_step(self, active: list) -> int:
+        """One fused decode chunk for the whole batch: up to ``chunk``
+        tokens per slot in one dispatch, EOS/budget freezing on device,
+        ONE batched readback at the chunk boundary."""
+        t0 = time.perf_counter()
+        budget, amask = self._budget_mask(active)
+        # ONE upload per chunk: tok/pos/budget ride a single [3, S]
+        # transfer and are sliced apart device-side
+        up = jnp.asarray(np.stack([self.tok, self.pos, budget]))
+        self.cache, toks, n_emit, tok_n, pos_n, _ = self._chunk_step(
+            self.params, self.cache, up[0], up[1], jnp.asarray(amask),
+            up[2], jnp.asarray(self.slot_key))
+        # host-sync: allowed -- ONE batched readback per CHUNK (the
+        # fused data plane's whole point): every slot's emitted prefix,
+        # count, and carry state ride a single transfer; EOS/max_new
+        # were already decided on device
+        toks, n_emit, tok_n, pos_n = jax.device_get(
+            (toks, n_emit, tok_n, pos_n))
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        for s in active:
+            req = self.slot_req[s]
+            new = [int(x) for x in toks[s, :int(n_emit[s])]]
+            req.out.extend(new)
+            self.tok[s] = int(tok_n[s])
+            self.pos[s] = int(pos_n[s])
+            if new:
+                metrics.SERVE_ITL_MS.observe(wall_ms / len(new))
+            if (self.eos_id is not None and new
+                    and new[-1] == self.eos_id) or \
+                    len(req.out) >= req.max_new:
+                self._finish(s)
+        return len(active)
+
+    def _spec_fused_step(self, active: list) -> int:
+        """``spec_rounds`` fused speculative rounds in one dispatch:
+        draft scans, batched verifies, acceptance and commit all on
+        device; ONE batched readback returns every slot's contiguous
+        emissions plus the advanced carry state."""
+        t0 = time.perf_counter()
+        budget, amask = self._budget_mask(active)
+        up = jnp.asarray(np.stack([self.prev, self.tok, self.pos, budget]))
+        (self.cache, self.dcache, buf, n_tot, prev_n, tok_n, pos_n,
+         act_n, acc_n, acc_d) = self._spec_fused(
+            self.params, self.draft_params, self.cache, self.dcache,
+            up[0], up[1], up[2], jnp.asarray(amask), up[3],
+            jnp.asarray(self.slot_key))
+        # host-sync: allowed -- ONE batched readback per fused dispatch
+        # covering spec_rounds speculative rounds: emissions, counts,
+        # carry state and acceptance tallies in a single transfer
+        got = jax.device_get(
+            (buf, n_tot, prev_n, tok_n, pos_n, act_n, acc_n, acc_d))
+        buf, n_tot, prev_n, tok_n, pos_n, act_n, acc_n, acc_d = got
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        self.spec_accepted += int(acc_n.sum())
+        self.spec_proposed += int(acc_d.sum())
+        for s in active:
+            req = self.slot_req[s]
+            new = [int(x) for x in buf[s, :int(n_tot[s])]]
+            req.out.extend(new)
+            if new:
+                metrics.SERVE_ITL_MS.observe(wall_ms / len(new))
+            if not bool(act_n[s]):
+                self._finish(s)
+            else:
+                self.prev[s] = int(prev_n[s])
+                self.tok[s] = int(tok_n[s])
+                self.pos[s] = int(pos_n[s])
+        return len(active)
+
     def _spec_step(self, active: list) -> int:
-        """One speculative round for the whole batch: k draft proposals
-        per slot, one batched target verify, per-slot acceptance."""
-        key = jax.random.fold_in(self.rng, self._tick)
-        self._tick += 1
-        kd, kv = jax.random.split(key)
+        """One speculative round for the whole batch on the ORACLE path:
+        k draft proposals per slot, one batched target verify, per-slot
+        acceptance, commit on host."""
+        t0 = time.perf_counter()
         # ONE upload per round: prev/tok/pos ride a single [3, S]
         # transfer and are sliced apart device-side (the previous four
         # jnp.asarray calls were four host->device dispatches per round)
         htp = jnp.asarray(np.stack([self.prev, self.tok, self.pos]))
+        skeys = jnp.asarray(self.slot_key)
         self.dcache, drafts, q_rows = self._spec_propose(
-            self.draft_params, self.dcache, htp[0], htp[1], htp[2], kd)
+            self.draft_params, self.dcache, htp[0], htp[1], htp[2], skeys)
         chunk = jnp.concatenate([htp[1][:, None], drafts], axis=1)
         self.cache, n_acc, extra = self._spec_verify(
-            self.params, self.cache, chunk, htp[2], kv, q_rows)
+            self.params, self.cache, chunk, htp[2], skeys, q_rows)
         # host-sync: allowed -- one batched transfer per round (remote
         # rigs pay RTT per fetch; three sequential gets tripled the
         # round's latency floor)
         n_acc, extra, chunk_np = jax.device_get((n_acc, extra, chunk))
+        wall_ms = (time.perf_counter() - t0) * 1e3
         for s in active:
             req = self.slot_req[s]
             n = int(n_acc[s])
+            self.spec_accepted += n
+            self.spec_proposed += self.k
             # the round's tokens: n accepted drafts + correction/bonus
             new = [int(x) for x in chunk_np[s, 1:n + 1]] + [int(extra[s])]
             emitted = []
@@ -440,6 +727,7 @@ class DecodeServer:
                         len(req.out) + len(emitted) >= req.max_new:
                     break
             req.out.extend(emitted)
+            metrics.SERVE_ITL_MS.observe(wall_ms / len(emitted))
             if (self.eos_id is not None and self.eos_id in emitted) or \
                     len(req.out) >= req.max_new:
                 self._finish(s)
@@ -511,19 +799,21 @@ class DecodeServer:
                 # decode.make_generate refuses up front) — full prefill
                 # instead of a corrupting shortcut
                 hit = None
-        key = jax.random.fold_in(self.rng, self._tick)
-        self._tick += 1
+        # the request's key root: every selection of this request, on
+        # every path, folds its position into this key
+        req_key = jax.random.fold_in(self.rng, req.rid)
         if hit is not None:
             rem_padded = np.zeros((1, rb), np.int32)
             rem_padded[0, :len(rem)] = rem
             self.cache, first_t = self._rem_prefill(
                 self.params, self.cache, stored, jnp.asarray(rem_padded),
-                jnp.int32(slot), jnp.int32(plen), jnp.int32(len(rem)), key)
+                jnp.int32(slot), jnp.int32(plen), jnp.int32(len(rem)),
+                req_key)
             self.prefix_hits += 1
         else:
             self.cache, first_t = self._prefill(
                 self.params, self.cache, jnp.asarray(padded),
-                jnp.int32(slot), jnp.int32(n), key)
+                jnp.int32(slot), jnp.int32(n), req_key)
             if self.prefix_cache_size:
                 self.prefix_misses += 1
         if self.prefix_cache_size:
@@ -532,10 +822,16 @@ class DecodeServer:
         # admitted request (selection already ran inside the prefill
         # trace); the host must see the token for EOS + output append
         first = int(first_t)
+        metrics.SERVE_TTFT_MS.observe(
+            (time.perf_counter() - req.t_submit) * 1e3)
         req.out.append(first)
         self.slot_req[slot] = req
         self.tok[slot] = first
         self.pos[slot] = n
+        # host-sync: allowed -- one [2] uint32 key mirror per ADMITTED
+        # request (not per token): the host keeps it to re-upload with
+        # every fused dispatch so selection keys survive slot recycling
+        self.slot_key[slot] = np.asarray(req_key, np.uint32)
         if self.spec:
             self.dcache = self._dprefill(
                 self.draft_params, self.dcache, jnp.asarray(padded),
@@ -551,6 +847,7 @@ class DecodeServer:
         self.slot_req[slot] = None
         self.pos[slot] = 0
         self.tok[slot] = 0
+        self.slot_key[slot] = 0
         if self.spec:
             self.prev[slot] = 0
         self._free.append(slot)

@@ -158,9 +158,15 @@ def make_speculative_generate(target_cfg: TransformerConfig,
         round after a full accept proposes against a zeroed cache row
         and acceptance collapses.
 
-        NOTE: `serve.DecodeServer._spec_propose` is this function's
-        batched (per-slot) twin — any change to the catch-up logic or
-        the q-row plumbing must be mirrored there."""
+        NOTE: `serve.DecodeServer` carries this function's batched
+        (per-slot) twin — both the per-round oracle jit and the fused
+        multi-round device program (`spec_fused`), which also reuses
+        `accept_resample` verbatim under `vmap`. Any change to the
+        catch-up logic or the q-row plumbing must be mirrored there.
+        The twins differ only in key lineage: this single-stream path
+        folds the draft index into one caller key, while the server
+        derives position-keyed per-slot keys so its streams are
+        batching-invariant."""
         chunk = jnp.stack([prev, token], axis=1)        # [1, 2]
         logits, cache = d_step(params, cache, chunk, pos - 1)
         first, q0 = pick(logits[:, -1, :], jax.random.fold_in(key, 0))

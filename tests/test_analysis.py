@@ -328,7 +328,11 @@ def test_host_sync_report_ranks_serving_loop_first():
     assert roots, "the serving loops must appear in the inventory"
     top = roots[0]
     assert top["function"] == "DecodeServer.run"
-    assert top["syncs_per_iteration"] == 3
+    # the fused data plane's full reachable set: the per-token oracle
+    # readback, the fused chunk readback, the fused + oracle spec
+    # readbacks, and the two once-per-admission scalars/key mirrors —
+    # each a deliberate, batched (or per-request) transfer
+    assert top["syncs_per_iteration"] == 6
     assert top["h2d_per_iteration"] >= 1
     assert all(site["waived"] for site in top["sites"])
     from kubegpu_tpu.analysis.rules import deviceflow

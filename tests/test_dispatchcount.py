@@ -112,7 +112,10 @@ def test_smoke_cli_emits_bench_keys_and_gates():
         pytest.skip(f"smoke skipped itself: {out['skipped']}")
     assert out["decode_dispatches_per_token"] == 1.0
     assert out["decode_fixed_recompiles"] == 0
-    assert "serve_dispatches_per_token" in out
+    assert out["serve_fused_recompiles"] == 0
+    # the fused-chunk section must amortize dispatches under its budget
+    assert out["serve_dispatches_per_token"] <= \
+        out["serve_dispatch_budget_per_token"]
     assert "workload_recompiles_total" in out
 
 
