@@ -531,6 +531,146 @@ def config_bind_pipeline(n_hosts: int = 64, n_pods: int = 96,
     return out
 
 
+def config_fanout(n_subs: int = 1000, n_proxies: int = 0,
+                  n_events: int = 200, pace_s: float = 0.002):
+    """Control-plane fan-out (ISSUE 20): one apiserver event stream
+    re-served to ``n_subs`` concurrent watch subscribers — directly off
+    the apiserver's event log (``n_proxies=0``), or sharded across
+    ``n_proxies`` watch-cache proxy replicas, each holding ONE upstream
+    subscription and fanning out from its local window.
+
+    The subscribers are fake (in-process closures on the stream wire's
+    subscriber seam, ``threaded=False``) so one process can hold 100k of
+    them: every subscriber counts delivered frame bytes; a sampled
+    subset (~64) decodes its frames and measures the end-to-end
+    create->delivered push lag against the creation stamp the workload
+    thread records — same clock (perf_counter), same process, so no
+    wall-clock skew. Returns push-lag p50/p99, per-replica byte rate,
+    and the encode/delivery counts that prove the encode-once fan-out:
+    per-replica encodes track the EVENT stream, not the subscriber
+    count."""
+    from kubegpu_tpu.cluster import stream
+    from kubegpu_tpu.cluster.httpapi import serve_api
+    from kubegpu_tpu.cluster.proxy import WatchCacheProxy
+
+    import threading
+
+    while _LIVE_CLUSTERS:
+        _LIVE_CLUSTERS.pop().close()
+    mem = InMemoryAPIServer()
+    server, url = serve_api(mem)
+    replicas = []
+    created_at: dict = {}
+    lags: list = []
+    try:
+        for i in range(n_proxies):
+            replicas.append(WatchCacheProxy(url, name=f"fanout{i}"))
+        logs = [r.event_log for r in replicas] \
+            if replicas else [server.event_log]
+        # every subscriber counts bytes; every ``sample_every``-th also
+        # decodes (64-ish decoders regardless of n_subs — decode cost
+        # must not become the thing the bench measures)
+        sample_every = max(1, n_subs // 64)
+        byte_cells = [[0] for _ in logs]
+        lag_lock = threading.Lock()
+
+        def make_send(cell, sampled):
+            def send(data: bytes) -> None:
+                cell[0] += len(data)
+                if sampled and data[0] == stream.PUSH:
+                    out = codec.decode_watch_batch(data[13:])
+                    now = time.perf_counter()
+                    for _seq, kind, event, obj in out["events"]:
+                        if kind != "pod" or event != "added":
+                            continue
+                        t0 = created_at.get(obj["metadata"]["name"])
+                        if t0 is not None:
+                            with lag_lock:
+                                lags.append((now - t0) * 1e3)
+            return send
+
+        subs = []
+        encodes0 = [log.stream_encodes for log in logs]
+        delivered0 = [log.stream_deliveries for log in logs]
+        for i in range(n_subs):
+            log = logs[i % len(logs)]
+            subs.append(log.add_stream_subscriber(
+                make_send(byte_cells[i % len(logs)],
+                          i % sample_every == 0),
+                since=log.seq(), threaded=False))
+        # one pump driver per SERVING log: the apiserver's own fan-out
+        # thread only exists for threaded (socket) subscribers, and the
+        # proxies' downstream population here is entirely fake — the
+        # drivers stand in for the transport's pump, nothing else. The
+        # 1 s wait costs no push latency (the pump's wait is notified on
+        # every append); a shorter wait would ping all n_subs
+        # subscribers on every idle expiry
+        stop = threading.Event()
+
+        def drive(log):
+            while not stop.is_set():
+                log.pump_once(wait_s=1.0)
+
+        drivers = [threading.Thread(target=drive, args=(log,),
+                                    daemon=True) for log in logs]
+        for d in drivers:
+            d.start()
+        # create-only workload: a paced create stream. Deletes would
+        # coalesce with their create inside the proxy's (one hop wider)
+        # windows and fold the ``added`` events away — biasing WHICH
+        # pods the samplers ever see and measuring coalescing, not
+        # fan-out. Every create below reaches every subscriber.
+        t0 = time.perf_counter()
+        for i in range(n_events):
+            name = f"fan{i}"
+            created_at[name] = time.perf_counter()
+            mem.create_pod(make_pod(name, 1))
+            time.sleep(pace_s)
+        # drain: every replica window caught up to the apiserver head,
+        # then every subscriber cursor at its own log's head
+        head = server.event_log.seq()
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(log.seq() >= head for log in logs) and \
+                    all(s.cursor >= logs[i % len(logs)].seq()
+                        for i, s in enumerate(subs)):
+                break
+            time.sleep(0.01)
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        for d in drivers:
+            d.join(timeout=5.0)
+        encodes = [log.stream_encodes - e0
+                   for log, e0 in zip(logs, encodes0)]
+        delivered = [log.stream_deliveries - d0
+                     for log, d0 in zip(logs, delivered0)]
+        with lag_lock:
+            lag_sorted = sorted(lags)
+        assert lag_sorted, "fan-out ran but no sampled subscriber " \
+            "ever saw a pod event"
+        out = {
+            "subscribers": n_subs,
+            "replicas": len(replicas),
+            "push_lag_p50_ms": round(
+                lag_sorted[len(lag_sorted) // 2], 3),
+            "push_lag_p99_ms": round(
+                lag_sorted[min(len(lag_sorted) - 1,
+                               int(len(lag_sorted) * 0.99))], 3),
+            "bytes_per_s_per_replica": round(
+                max(c[0] for c in byte_cells) / max(elapsed, 1e-9)),
+            "encodes_per_replica": max(encodes),
+            "deliveries": sum(delivered),
+        }
+        if replicas:
+            out["upstream_lag_p99_ms"] = round(
+                metrics.PROXY_UPSTREAM_LAG_MS.percentile(0.99), 3)
+        return out
+    finally:
+        for r in replicas:
+            r.stop()
+        server.shutdown()
+
+
 def wire_parity_check() -> list:
     """JSON-vs-stream parity gate: the identical read/watch/error
     sequence against ONE server over both wires must produce deep-equal
@@ -2091,6 +2231,52 @@ def main():
             tf["front_door"]["quota_parked_total"]
     except Exception as e:  # noqa: BLE001
         per_config["multitenant_churn_error"] = f"{type(e).__name__}: {e}"
+    # The same front door fronted by 2 watch-cache proxy replicas
+    # (ISSUE 20): the abusive tenant floods READS, absorbed entirely at
+    # the proxy tier — the scenario asserts the apiserver's request
+    # rate stays flat vs quiet and the p99 hold still stands.
+    try:
+        from kubegpu_tpu.cmd.simulate import run_tenant_flood_scenario
+
+        tf2 = run_tenant_flood_scenario(churn_pods=16, proxies=2)
+        per_config["multitenant_proxy_p99_ratio"] = tf2["p99_ratio"]
+        per_config["multitenant_proxy_api_quiet_req_per_s"] = \
+            tf2["apiserver_quiet_req_per_s"]
+        per_config["multitenant_proxy_api_flood_req_per_s"] = \
+            tf2["apiserver_flood_req_per_s"]
+    except Exception as e:  # noqa: BLE001
+        per_config["multitenant_proxy_error"] = f"{type(e).__name__}: {e}"
+    # Watch fan-out (ISSUE 20 headline): push-lag percentiles at 1k
+    # subscribers direct vs through 2 proxy replicas, then the 100k-
+    # subscriber run sharded across 4 replicas (KGTPU_BENCH_SKIP_100K=1
+    # downscales to 4k for quick local reruns, same idiom as SKIP_4K).
+    try:
+        fo_direct = config_fanout(n_subs=1000, n_proxies=0)
+        per_config["fanout_direct_1k_p50_ms"] = \
+            fo_direct["push_lag_p50_ms"]
+        per_config["fanout_direct_1k_p99_ms"] = \
+            fo_direct["push_lag_p99_ms"]
+        fo_proxy = config_fanout(n_subs=1000, n_proxies=2)
+        per_config["fanout_proxy_1k_p50_ms"] = fo_proxy["push_lag_p50_ms"]
+        per_config["fanout_proxy_1k_p99_ms"] = fo_proxy["push_lag_p99_ms"]
+        per_config["fanout_proxy_vs_direct_p99"] = round(
+            fo_proxy["push_lag_p99_ms"]
+            / max(fo_direct["push_lag_p99_ms"], 1e-9), 2)
+        big = 4000 if os.environ.get("KGTPU_BENCH_SKIP_100K") == "1" \
+            else 100_000
+        fo_big = config_fanout(n_subs=big, n_proxies=4, n_events=120,
+                               pace_s=0.005)
+        per_config["fanout_100k_subscribers"] = big
+        per_config["fanout_100k_p50_ms"] = fo_big["push_lag_p50_ms"]
+        per_config["fanout_100k_p99_ms"] = fo_big["push_lag_p99_ms"]
+        per_config["fanout_100k_bytes_per_s_per_proxy"] = \
+            fo_big["bytes_per_s_per_replica"]
+        per_config["fanout_100k_encodes_per_proxy"] = \
+            fo_big["encodes_per_replica"]
+        per_config["fanout_100k_upstream_lag_p99_ms"] = \
+            fo_big["upstream_lag_p99_ms"]
+    except Exception as e:  # noqa: BLE001
+        per_config["fanout_error"] = f"{type(e).__name__}: {e}"
     while _LIVE_CLUSTERS:
         _LIVE_CLUSTERS.pop().close()
     if not os.environ.get("KGTPU_BENCH_SKIP_WORKLOAD"):
@@ -2219,6 +2405,34 @@ def smoke():
     assert tf["quota_parked"] > 0 or tf["flood"]["rejected"] > 0, \
         "tenant flood ran but neither the DRF gate nor the front " \
         "door ever engaged"
+    # Watch fan-out smoke (ISSUE 20): 1k subscribers direct vs through
+    # 2 proxy replicas. Gates: (1) the proxied push-lag p99 within 2x
+    # of direct plus a 5 ms hop allowance — the extra hop is a fixed
+    # cost (socket + decode/re-encode + one more pump batching
+    # boundary) that a pure ratio double-counts at these single-digit-
+    # ms scales; one retry absorbs a noisy pass, same idiom as the
+    # sampler-overhead gate. (2) encode-once fan-out — per-replica
+    # encodes track the event stream while deliveries track
+    # subscribers, so each encoded frame must serve a large share of a
+    # replica's population.
+    for attempt in (1, 2):
+        fo_direct = config_fanout(n_subs=1000, n_proxies=0, n_events=120)
+        fo_proxy = config_fanout(n_subs=1000, n_proxies=2, n_events=120)
+        fo_limit = 2.0 * fo_direct["push_lag_p99_ms"] + 5.0
+        if fo_proxy["push_lag_p99_ms"] <= fo_limit or attempt == 2:
+            break
+    assert fo_proxy["push_lag_p99_ms"] <= fo_limit, \
+        f"proxied fan-out p99 {fo_proxy['push_lag_p99_ms']:.2f} ms " \
+        f"blew 2x the direct p99 + 5 ms " \
+        f"({fo_direct['push_lag_p99_ms']:.2f} ms) — the proxy hop is " \
+        f"no longer a wash at 1k subscribers"
+    for fo, subs_per_replica in ((fo_direct, 1000), (fo_proxy, 500)):
+        reuse = fo["deliveries"] \
+            / max(fo["encodes_per_replica"] * max(fo["replicas"], 1), 1)
+        assert reuse >= 0.5 * subs_per_replica, \
+            f"fan-out encoded once per {reuse:.0f} deliveries at " \
+            f"{subs_per_replica} subscribers/replica — the encode-" \
+            f"once window cache stopped amortizing"
     while _LIVE_CLUSTERS:
         _LIVE_CLUSTERS.pop().close()
     hits = metrics.FIT_CACHE_HITS.value
@@ -2275,6 +2489,10 @@ def smoke():
         "scale_1k_node_smoke_p50_ms": round(
             statistics.median(ha) * 1e3, 3),
         "multitenant_p99_ratio": tf["p99_ratio"],
+        "fanout_direct_1k_p99_ms": fo_direct["push_lag_p99_ms"],
+        "fanout_proxy_1k_p99_ms": fo_proxy["push_lag_p99_ms"],
+        "fanout_proxy_encodes_per_replica":
+            fo_proxy["encodes_per_replica"],
         "quota_parked_total": tf["front_door"]["quota_parked_total"],
         "apf_rejects_total": sum(
             tf["front_door"]["apf_rejects_total"].values()),

@@ -38,6 +38,16 @@ pairing is broken). Four paired surfaces are checked:
   client code consumes is a one-sided surface (exactly the
   retry-after bug class — the server advises ``retry_after_s``, the
   client's retry policy silently ignores it).
+* **forward tables** — a hop module (one assigning
+  ``FORWARDED_ROUTES``, i.e. the watch-cache proxy) re-serves the
+  route-table module's whole client surface: every first segment a
+  package client can reach must appear in ``LOCAL_ROUTES`` or
+  ``FORWARDED_ROUTES`` (a segment in neither is a request the hop
+  404s that the origin serves — a hole in the hop), and the hop's
+  ``_forward()`` must re-raise exactly the typed-error pairs the
+  origin's dispatch sites map — anything less degrades a typed error
+  to a generic failure crossing the hop, anything more is dead hop
+  surface.
 
 Everything is matched by name and structure over the AST — no imports,
 no execution — so the fixtures and the real tree are judged alike.
@@ -53,6 +63,8 @@ from kubegpu_tpu.analysis.engine import Context, Finding, SourceFile
 ROUTE_TABLE_FN = "_route_request"
 CLIENT_REQ = "_req"
 ERROR_BODY_FN = "_error_body"
+FORWARD_TABLES = ("LOCAL_ROUTES", "FORWARDED_ROUTES")
+FORWARD_FN = "_forward"
 FRAME_REGISTRY = "_FRAME_TYPES"
 SEND_FNS = frozenset({"send_frame", "encode_frame", "send_raw"})
 TAG_PREFIX = "_T_"
@@ -64,8 +76,9 @@ class WireContract:
     name = "wire-contract"
     description = ("client routes vs the _route_request table, "
                    "_FRAME_TYPES send vs dispatch, _T_* encode vs "
-                   "decode tag sets, and typed-error status maps must "
-                   "be mutually exhaustive across both wires")
+                   "decode tag sets, typed-error status maps across "
+                   "both wires, and the proxy hop's forward tables vs "
+                   "the client surface they must cover")
 
     def run(self, sources: list, ctx: Context) -> Iterator[Finding]:
         for src in sources:
@@ -78,6 +91,7 @@ class WireContract:
                 yield from self._check_error_detail(src)
             yield from self._check_codec_tags(src)
         yield from self._check_frame_types(sources)
+        yield from self._check_forward_tables(sources)
 
     # ---- routes -------------------------------------------------------------
 
@@ -248,6 +262,79 @@ class WireContract:
                     f"but no dispatch site ever maps it — dead client "
                     f"surface or a missing server mapping")
 
+    # ---- forward tables (the proxy hop) -------------------------------------
+
+    def _check_forward_tables(self, sources: list) -> Iterator[Finding]:
+        """Cross-source, like frame types: the client surface and the
+        canonical typed-error union come from the route-table modules
+        (the ones defining ``_route_request`` — its importers serve the
+        SAME table, so they add nothing); each hop module is then held
+        to both. kubeclient-style ``_req`` callers speaking a foreign
+        wire don't define a route table, so they never leak into the
+        surface the hop must cover."""
+        client_segs: Dict[str, str] = {}  # first segment -> method
+        canonical: Set[Tuple[str, int]] = set()
+        saw_origin = False
+        for src in sources:
+            if not any(isinstance(node, ast.FunctionDef)
+                       and node.name == ROUTE_TABLE_FN
+                       for node in ast.walk(src.tree)):
+                continue
+            saw_origin = True
+            for _call, method, path in _client_requests(src.tree):
+                seg = _first_segment(path)
+                if seg is not None:
+                    client_segs.setdefault(seg, method)
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    canonical |= _server_error_pairs(node)
+        if not saw_origin:
+            return  # no origin in view: nothing to hold a hop against
+        for src in sources:
+            tables: Dict[str, Set[str]] = {}
+            table_line = 0
+            for node in src.tree.body:
+                if not isinstance(node, ast.Assign):
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name) and \
+                            target.id in FORWARD_TABLES:
+                        tables[target.id] = _string_members(node.value)
+                        if target.id == FORWARD_TABLES[1]:
+                            table_line = node.lineno
+            if FORWARD_TABLES[1] not in tables:
+                continue
+            covered: Set[str] = set().union(*tables.values())
+            for seg in sorted(set(client_segs) - covered):
+                yield Finding(
+                    self.name, src.path, table_line,
+                    f"client sends {client_segs[seg]} /{seg} but the "
+                    f"hop routes it neither locally (LOCAL_ROUTES) nor "
+                    f"upstream (FORWARDED_ROUTES) — a hole in the hop: "
+                    f"the proxy 404s a request the origin serves")
+            hop_pairs: Set[Tuple[str, int]] = set()
+            hop_line = table_line
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node.name == FORWARD_FN:
+                    hop_pairs |= _client_error_pairs(node)
+                    hop_line = node.lineno
+            for exc, status in sorted(canonical - hop_pairs):
+                yield Finding(
+                    self.name, src.path, hop_line,
+                    f"origin dispatch maps {exc} -> {status} but the "
+                    f"hop's {FORWARD_FN}() never re-raises {exc} from "
+                    f"{status} — the typed error degrades to a generic "
+                    f"failure crossing the hop")
+            for exc, status in sorted(hop_pairs - canonical):
+                yield Finding(
+                    self.name, src.path, hop_line,
+                    f"{FORWARD_FN}() re-raises {exc} from status "
+                    f"{status} but no origin dispatch site maps it — "
+                    f"dead hop surface, or a missing origin mapping")
+
     # ---- error-detail keys --------------------------------------------------
 
     def _check_error_detail(self, src: SourceFile) -> Iterator[Finding]:
@@ -316,6 +403,15 @@ def _name_refs(node: ast.AST) -> List[str]:
         elif isinstance(sub, ast.Attribute):
             out.append(sub.attr)
     return out
+
+
+def _string_members(value: ast.AST) -> Set[str]:
+    """String constants in a route-table literal: the members of
+    ``frozenset({"pods", ...})`` (a Call wrapping a Set) or a bare
+    set/tuple/list literal."""
+    return {sub.value for sub in ast.walk(value)
+            if isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)}
 
 
 def _client_requests(tree: ast.AST) \

@@ -41,6 +41,13 @@ class NotFound(KeyError):
         super().__init__(message)
         self.per_pod = dict(per_pod or {})
 
+    def __str__(self) -> str:
+        # KeyError's str() repr-quotes its message, which re-quotes on
+        # every reconstruct -> re-serialize pass — through the
+        # watch-cache proxy the error text must round-trip verbatim, so
+        # a hop is invisible in the body a client sees
+        return str(self.args[0]) if self.args else ""
+
 
 class Conflict(RuntimeError):
     """Optimistic-concurrency refusal: the write would contradict

@@ -146,16 +146,26 @@ class TenantFlood:
     abusive client (one RTT per request); 0 is an infinitely fast
     attacker. Counts are returned by :meth:`stop`:
     ``accepted``/``rejected`` (typed 429s)/``errored``.
+
+    ``mode="read"`` floods list/get traffic instead of pod creates —
+    the watch-cache-proxy scenario's abuser: reads are exactly what a
+    proxy replica absorbs from its mirror, so a read flood at the proxy
+    tier must leave the apiserver's request rate flat while a create
+    flood would still forward upstream (bounded by the replica's own
+    front door).
     """
 
     def __init__(self, client_factory, tenant: str = "abuser",
                  threads: int = 4, chips: int = 1,
-                 pace_s: float = 0.001):
+                 pace_s: float = 0.001, mode: str = "mutate"):
+        if mode not in ("mutate", "read"):
+            raise ValueError(f"unknown flood mode {mode!r}")
         self._factory = client_factory
         self.tenant = tenant
         self.threads = threads
         self.chips = chips
         self.pace_s = pace_s
+        self.mode = mode
         self._stop = threading.Event()
         # racer: single-writer -- start()/stop() are the driver
         # thread's lifecycle calls; flood workers never touch these
@@ -186,7 +196,10 @@ class TenantFlood:
         while not self._stop.is_set():
             name = f"{self.tenant}-flood-{next(self._seq)}"
             try:
-                client.create_pod(self._flood_pod(name))
+                if self.mode == "read":
+                    client.list_pods()
+                else:
+                    client.create_pod(self._flood_pod(name))
                 with self._lock:
                     self.accepted += 1
             except TooManyRequests:

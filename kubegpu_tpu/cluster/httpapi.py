@@ -252,10 +252,18 @@ class _EventLog:
     ``floor`` is the highest sequence number no longer replayable
     (snapshot compaction or the in-memory trim); a client presenting an
     older ``since`` is answered with a full-relist signal instead of a
-    silent gap."""
+    silent gap.
+
+    With ``attach=False`` the log records nothing on its own: it is the
+    watch-cache proxy's downstream window (cluster/proxy.py), fed
+    UPSTREAM events carrying their upstream sequence numbers through
+    :meth:`reset` / :meth:`ingest` / :meth:`backfill` — the seq space
+    stays the apiserver's own (global, WAL-continued), which is what
+    keeps resume seq-exact when a client migrates between a proxy
+    replica and the apiserver."""
 
     def __init__(self, api: InMemoryAPIServer, limit: int = 10000,
-                 wal=None):
+                 wal=None, attach: bool = True):
         import os as _os
 
         self._lock = threading.Condition()
@@ -288,7 +296,8 @@ class _EventLog:
             self._events = list(tail)[-limit:]
             if len(tail) > limit:
                 self._floor = self._events[0][0] - 1
-        api.add_watcher(self._record)
+        if attach:
+            api.add_watcher(self._record)
 
     # Recent events carried INSIDE each snapshot: they are already
     # reflected in the snapshotted state (never re-applied on recovery)
@@ -308,6 +317,67 @@ class _EventLog:
     def tail(self, k: int) -> list:
         with self._lock:
             return list(self._events[-k:]) if k > 0 else []
+
+    def stream_subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    # ---- proxy-mode ingest (cluster/proxy.py, attach=False) ---------------
+
+    def reset(self, head_seq: int, epoch: str) -> None:
+        """Adopt an upstream position wholesale: drop any window held,
+        continue from the upstream head under the upstream epoch. Used
+        at proxy sync, and again whenever the upstream relists us (its
+        own window is gone, so ours is garbage too) — every downstream
+        cursor below the new head then receives the same honest relist
+        signal the upstream gave, and an epoch change propagates so
+        clients detect a non-durable apiserver restart THROUGH the
+        proxy exactly as they would directly."""
+        with self._lock:
+            self._events = []
+            self._seq = head_seq
+            self._floor = head_seq
+            self.epoch = epoch
+            self._lock.notify_all()
+
+    def ingest(self, events: list, head_seq: int) -> None:
+        """Record one upstream watch batch WITH its upstream sequence
+        numbers. The batch arrives coalesced, so chain seqs can
+        interleave across objects — sort before appending to keep the
+        log bisectable; per-object order survives (an object's seqs
+        only move forward). Trimming advances the floor exactly like
+        the recording path."""
+        with self._lock:
+            batch = sorted((tuple(ev) for ev in events
+                            if ev[0] > self._seq),
+                           key=lambda ev: ev[0])
+            self._events.extend(batch)
+            if head_seq > self._seq:
+                self._seq = head_seq
+            if len(self._events) > self.limit:
+                drop = len(self._events) - self.limit
+                self._floor = self._events[drop - 1][0]
+                self._events = self._events[drop:]
+            self._lock.notify_all()
+
+    def backfill(self, events: list, new_floor: int) -> None:
+        """Extend the replayable window DOWNWARD: a downstream watcher
+        presented a cursor below our floor and the upstream — whose
+        window is deeper — replayed the gap. Only events below our
+        current first seq prepend (the rest are already here); a
+        coalesced chain whose merged seq landed inside our window is
+        dropped with nothing lost — watch events carry whole objects,
+        so the in-window event already holds that object's state. The
+        floor drops to ``new_floor`` so the watcher resumes seq-exact
+        instead of relisting."""
+        with self._lock:
+            first = self._events[0][0] if self._events else self._seq + 1
+            prefix = sorted((tuple(ev) for ev in events
+                             if new_floor < ev[0] < first),
+                            key=lambda ev: ev[0])
+            self._events = prefix + self._events
+            self._floor = min(self._floor, new_floor)
+            self._lock.notify_all()
 
     def _record(self, kind, event, obj):
         # self._wal is set once in __init__ and never reassigned — it is
@@ -490,6 +560,7 @@ class _EventLog:
                           if not s.is_dead() and s.cursor != self._seq]
             seq = self._seq
             floor = self._floor
+            epoch = self.epoch
             events = []
             if behind:
                 in_window = [s.cursor for s in behind
@@ -512,15 +583,21 @@ class _EventLog:
         # purpose (cross-process stamp, like the advertiser heartbeat).
         now_ts = time.time()  # analysis: disable=monotonic-time -- cross-process push-lag stamp, like the heartbeat annotation
         sent = 0
-        cache: dict = {}
+        relist_frame = None
+        cache: dict = {}    # (kinds, cursor) -> frame
+        encoded: dict = {}  # filtered-window signature -> frame
         for sub in behind:
             if sub.cursor < floor or sub.cursor > seq:
                 # outside the replayable window (compaction/trim, or a
                 # cursor from another server life): explicit relist
                 # signal, exactly like the long-poll contract
-                payload = codec.encode_watch_batch(
-                    [], seq, relist=True, epoch=self.epoch, ts=now_ts)
-                sub.offer(stream.encode_frame(stream.PUSH, 0, payload))
+                if relist_frame is None:
+                    payload = codec.encode_watch_batch(
+                        [], seq, relist=True, epoch=epoch,
+                        ts=now_ts)
+                    relist_frame = stream.encode_frame(
+                        stream.PUSH, 0, payload)
+                sub.offer(relist_frame)
                 sub.cursor = seq
                 sent += 1
                 continue
@@ -530,15 +607,27 @@ class _EventLog:
                 window = [e for e in events
                           if e[0] > sub.cursor
                           and (sub.kinds is None or e[1] in sub.kinds)]
-                window, folded = coalesce_events(window)
-                t0 = time.perf_counter()
-                payload = codec.encode_watch_batch(
-                    window, seq, coalesced=folded, epoch=self.epoch,
-                    ts=now_ts)
-                frame = stream.encode_frame(stream.PUSH, 0, payload)
-                metrics.FRAME_ENCODE_MS.observe(
-                    (time.perf_counter() - t0) * 1e3)
-                self.stream_encodes += 1
+                # Distinct (kinds, cursor) cohorts whose FILTERED
+                # windows coincide — cursors straddling only
+                # filtered-out events, or different kind filters
+                # passing the same events — must share one encode: the
+                # signature keys the frame by the events actually
+                # delivered (seqs are unique, so equal seq tuples mean
+                # equal windows), so steady-state fan-out encodes once
+                # TOTAL, not once per cursor cohort.
+                sig = tuple(e[0] for e in window)
+                frame = encoded.get(sig)
+                if frame is None:
+                    window, folded = coalesce_events(window)
+                    t0 = time.perf_counter()
+                    payload = codec.encode_watch_batch(
+                        window, seq, coalesced=folded, epoch=epoch,
+                        ts=now_ts)
+                    frame = stream.encode_frame(stream.PUSH, 0, payload)
+                    metrics.FRAME_ENCODE_MS.observe(
+                        (time.perf_counter() - t0) * 1e3)
+                    self.stream_encodes += 1
+                    encoded[sig] = frame
                 cache[key] = frame
             sub.offer(frame)
             self.stream_deliveries += 1
@@ -775,6 +864,29 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
                                       body)
         return _route_request(api, log, method, parts, query, body)
 
+    return _serve_transport(_dispatch, log, host=host, port=port,
+                            stream_wire=stream_wire, wal=wal)
+
+
+def _serve_transport(dispatch, log: _EventLog, host: str = "127.0.0.1",
+                     port: int = 0, stream_wire: bool = True, wal=None,
+                     on_subscribe=None, role: str = "apiserver"):
+    """The transport half of :func:`serve_api`, parameterized over the
+    admission + routing callable so the watch-cache proxy
+    (cluster/proxy.py) serves the IDENTICAL dual-wire surface — same
+    framing, same typed-exception -> status mapping, same REJECT flow
+    control — over its own dispatch. ``on_subscribe(since)`` runs
+    before a stream SUB registers (the proxy backfills a below-floor
+    cursor from the deeper upstream window there); ``role`` labels the
+    per-server request counter so a fronted apiserver's request rate is
+    measurable apart from its proxies'. Returns ``(server, base_url)``;
+    the server exposes its event log as ``server.event_log``."""
+
+    def _dispatch(method: str, parts: list, query: dict, body,
+                  peer: str):
+        metrics.API_REQUESTS.labels(role).inc()
+        return dispatch(method, parts, query, body, peer)
+
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 so keep-alive works: every _send sets Content-Length,
         # which is what lets the connection persist across requests — a
@@ -880,6 +992,20 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
                             raise stream.FrameError(
                                 "malformed subscribe frame")
                         kinds = args.get("kinds")
+                        since = int(args.get("since") or 0)
+                        if on_subscribe is not None:
+                            # watch-cache proxy: a cursor below this
+                            # log's floor may be replayable from the
+                            # deeper upstream window — backfill BEFORE
+                            # registering, so the subscriber resumes
+                            # seq-exact instead of relisting
+                            try:
+                                on_subscribe(since)
+                            except Exception:
+                                slog.warning(
+                                    "subscribe backfill from upstream "
+                                    "failed; the pump will relist",
+                                    exc_info=True)
                         # ack BEFORE registering: once the subscriber is
                         # in the fan-out, the pump may push immediately,
                         # and a PUSH must never overtake the ack on this
@@ -912,7 +1038,7 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
                         sub = log.add_stream_subscriber(
                             send=lambda data: stream.send_raw(
                                 conn, wlock, data),
-                            since=int(args.get("since") or 0),
+                            since=since,
                             kinds=tuple(kinds) if kinds else None,
                             batch_s=float(args.get("batch") or 0.0),
                             on_dead=sever)
@@ -1048,8 +1174,11 @@ def serve_api(api: InMemoryAPIServer, host: str = "127.0.0.1", port: int = 0,
             self.server_close()
 
     server = Server((host, port), Handler)
+    # the log is closure state for the handlers; tests and the fan-out
+    # bench need it by name (encode-once accounting, fake subscribers)
+    server.event_log = log
     threading.Thread(target=server.serve_forever, daemon=True,
-                     name="apiserver-http").start()
+                     name=f"{role}-http").start()
     return server, f"http://{host}:{server.server_address[1]}"
 
 
@@ -1084,11 +1213,17 @@ class HTTPAPIClient:
     def __init__(self, base_url: str, timeout: float = 30.0,
                  watch_batch_s: float = 0.0,
                  watch_kinds: tuple | None = None,
-                 wire: str = stream.WIRE_JSON):
+                 wire: str = stream.WIRE_JSON,
+                 transport_label: str | None = None):
         if wire not in (stream.WIRE_JSON, stream.WIRE_STREAM):
             raise ValueError(f"unknown wire {wire!r}")
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # transport_bytes_total{wire} attribution override: the proxy's
+        # upstream client reports its hop as wire="proxy", so the
+        # upstream leg of a fronted deployment is measurable apart from
+        # the client legs (which keep their json/stream labels)
+        self.transport_label = transport_label
         # the wire in effect; "stream" may negotiate down to "json" on
         # the first round trip against an upgrade-less server
         self.wire = wire
@@ -1161,9 +1296,10 @@ class HTTPAPIClient:
             payload = resp.read()
             # body bytes only (HTTP headers uncounted — the json wire's
             # real framing overhead is larger than this shows)
-            metrics.TRANSPORT_BYTES.labels(stream.WIRE_JSON, "tx").inc(
+            label = self.transport_label or stream.WIRE_JSON
+            metrics.TRANSPORT_BYTES.labels(label, "tx").inc(
                 len(data) if data else 0)
-            metrics.TRANSPORT_BYTES.labels(stream.WIRE_JSON, "rx").inc(
+            metrics.TRANSPORT_BYTES.labels(label, "rx").inc(
                 len(payload))
             return resp.status, payload
         except Exception:
@@ -1187,7 +1323,8 @@ class HTTPAPIClient:
         if conn is None or conn.closed:
             if self._stop.is_set():
                 raise ConnectionError("client is closed")
-            conn = stream.StreamConn.connect(self.base_url, timeout)
+            conn = stream.StreamConn.connect(
+                self.base_url, timeout, label=self.transport_label)
             self._local.stream = conn
             with self._conn_lock:
                 self._stream_conns.add(conn)
@@ -1322,6 +1459,31 @@ class HTTPAPIClient:
         ``_count_retry`` (any thread's request can be shed)."""
         with self._conn_lock:
             self.throttled_count += 1
+
+    def forward(self, method: str, path: str, body=None, timeout=None):
+        """Hop-transparent round trip: returns the raw ``(status,
+        document)`` pair for ANY status. The watch-cache proxy forwards
+        through this instead of :meth:`_req` because a hop must not act
+        like an endpoint: typed errors are not raised here (the proxy
+        re-raises them itself so its OWN transport re-maps them to the
+        identical status + error body), and an upstream 429's advised
+        ``retry_after_s`` passes through unshortened instead of
+        disciplining the proxy's retry loop. Transport faults retry
+        exactly like ``_req`` — idempotent verbs only."""
+        attempts = self.RETRY_ATTEMPTS \
+            if method in self.IDEMPOTENT_METHODS else 1
+        for attempt in range(attempts):
+            try:
+                return self._wire_roundtrip(
+                    method, path, body, timeout or self.timeout)
+            except (urllib.error.URLError, http.client.HTTPException,
+                    ConnectionError, TimeoutError, OSError):
+                if attempt + 1 >= attempts:
+                    raise
+                self._count_retry()
+                backoff = min(self.RETRY_CAP_S,
+                              self.RETRY_BASE_S * 2 ** attempt)
+                self._stop.wait(backoff * (0.5 + random.random() / 2.0))
 
     # -- node/pod surface ---------------------------------------------------
 
@@ -1579,7 +1741,8 @@ class HTTPAPIClient:
         fallback to the JSON long-poll, same cursor)."""
         conn = None
         try:
-            conn = stream.StreamConn.connect(self.base_url, 10.0)
+            conn = stream.StreamConn.connect(
+                self.base_url, 10.0, label=self.transport_label)
             with self._conn_lock:
                 if self._stop.is_set():
                     # close() already swept the connection set; a conn

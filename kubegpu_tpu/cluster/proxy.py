@@ -1,0 +1,373 @@
+"""Watch-cache proxy: the horizontally-scalable control-plane fan-out tier.
+
+One apiserver process cannot push watch deltas to 100k clients — the
+encode is already shared (the event-log pump encodes each window once),
+but the sends, the sockets, and the per-subscriber bookkeeping all live
+on one box. Upstream kube-apiserver answers this with the watch cache:
+a tier that holds ONE subscription against the source of truth and
+re-serves thousands of watchers from a local event window. This module
+is that tier for this control plane:
+
+* **One upstream subscription.** The proxy dials the apiserver once
+  (stream SUB via cluster/stream.py, negotiated down to JSON long-poll
+  against an upgrade-less server) and feeds every pushed batch into its
+  own ``_EventLog`` in ``attach=False`` mode — the log records nothing
+  itself; it carries the UPSTREAM sequence numbers. Because the seq
+  space is global (WAL-continued across apiserver restarts), resume is
+  seq-exact THROUGH the proxy: a client can migrate between a proxy
+  replica and the apiserver, in either direction, without a relist.
+* **Downstream fan-out reuses the pump.** The proxy serves the
+  identical dual-wire surface through ``_serve_transport`` — same
+  framing, same typed-error mapping, same encode-once pump — so N
+  downstream watchers cost the apiserver exactly one subscription's
+  worth of load no matter what N is.
+* **Reads from the mirror, writes forwarded.** GETs are served from a
+  mirrored ``InMemoryAPIServer`` maintained by ``restore_object``
+  replay (the WAL recovery primitive — watch events carry whole
+  objects, so replay is idempotent upsert). Everything else is
+  forwarded upstream through :meth:`HTTPAPIClient.forward`, a
+  hop-transparent round trip: typed errors (429/403/404/409) are
+  re-raised here so the proxy's OWN transport re-maps them to the
+  identical status + error body the apiserver would have sent.
+  Leases are deliberately NOT served locally — a lease answer must be
+  fresh and atomic, and the mirror is neither.
+* **Shared-nothing replicas behind APF.** Each proxy carries its own
+  front door (``apf=``): an abusive tenant saturates only the replica
+  its flows hash to, and the system band (leases, watch, health) stays
+  exempt at every hop.
+
+A cursor below the proxy's own floor is not necessarily a gap: the
+upstream window is deeper (WAL-backed). The SUB path's
+``on_subscribe`` hook and the long-poll watch route both call
+:meth:`WatchCacheProxy._ensure_window` first, which replays the missing
+prefix from upstream (``_EventLog.backfill``) so the subscriber resumes
+seq-exact instead of relisting — this is what makes
+direct-apiserver -> proxy migration lossless.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from kubegpu_tpu import metrics, obs
+from kubegpu_tpu.cluster import stream
+from kubegpu_tpu.cluster.apf import TooManyRequests
+from kubegpu_tpu.cluster.apiserver import (Conflict, InMemoryAPIServer,
+                                           NotFound, QuotaExceeded)
+from kubegpu_tpu.cluster.httpapi import (HTTPAPIClient, _EventLog,
+                                         _route_request, _serve_transport)
+
+logger = logging.getLogger(__name__)
+
+# Route tables the wire-contract analyzer checks (analysis/rules/wire.py,
+# forward-table check): every first path segment a package client can
+# reach must appear in one of these — LOCAL_ROUTES are GETs answered
+# from the mirror + this process's own observability surface,
+# FORWARDED_ROUTES go upstream through `forward`. A segment in neither
+# is a request the proxy would 404 that the apiserver would serve: a
+# hole in the hop.
+LOCAL_ROUTES = frozenset({
+    "healthz", "metrics", "debug", "watch", "nodes", "pods", "pvcs",
+    "pvs", "pdbs", "quotas", "services", "rcs", "rss", "statefulsets",
+    "events",
+})
+FORWARDED_ROUTES = frozenset({
+    "nodes", "pods", "podannotations", "bindmany", "pvcs", "pvs",
+    "bindvolume", "quotas", "pdbs", "services", "rcs", "rss",
+    "statefulsets", "events", "leases",
+})
+
+# Mirror bootstrap: every listable kind, with the list route that
+# carries it. Ordered like the apiserver's own stores; quota lists as
+# {tenant: spec} rather than objects, converted below.
+_MIRROR_LISTS = (
+    ("node", "/nodes"),
+    ("pod", "/pods"),
+    ("pvc", "/pvcs"),
+    ("pv", "/pvs"),
+    ("pdb", "/pdbs"),
+    ("service", "/services"),
+    ("rc", "/rcs"),
+    ("rs", "/rss"),
+    ("statefulset", "/statefulsets"),
+    ("quota", "/quotas"),
+    ("event", "/events"),
+)
+
+# A since-cursor far beyond any real head: the watch route answers it
+# with an empty relist carrying the current head seq + epoch — the
+# cheapest "where are you" probe the wire offers.
+_HEAD_PROBE = 1 << 62
+
+
+class WatchCacheProxy:
+    """One proxy replica: sync, subscribe upstream, serve downstream.
+
+    Construction blocks until the first mirror sync succeeds (a proxy
+    that cannot reach its upstream has nothing to serve), then starts
+    the upstream consumer thread and the downstream dual-wire server.
+    ``proxy.url`` is the address clients point at; :meth:`stop` tears
+    the whole replica down.
+    """
+
+    def __init__(self, upstream_url: str, name: str = "proxy",
+                 host: str = "127.0.0.1", port: int = 0,
+                 wire: str = stream.WIRE_STREAM, apf=None,
+                 limit: int = 10000, stream_wire: bool = True,
+                 upstream_batch_s: float = 0.0):
+        self.upstream_url = upstream_url
+        self.name = name
+        self._apf = apf
+        self._upstream_batch_s = upstream_batch_s
+        self._stop = threading.Event()
+        self._conn_lock = threading.Lock()
+        self._sub_conn = None  # live upstream SUB connection, for stop()
+        # the upstream leg reports its bytes as wire="proxy" so a
+        # fronted deployment's apiserver-side load is measurable apart
+        # from the client legs
+        self._upstream = HTTPAPIClient(upstream_url, wire=wire,
+                                       transport_label=stream.WIRE_PROXY)
+        self._mirror = InMemoryAPIServer()
+        self._log = _EventLog(self._mirror, limit=limit, attach=False)
+        # racer: single-writer -- cursor/epoch are written by __init__
+        # (before the consumer thread exists) and then only by the
+        # consumer thread; downstream handlers never read them
+        self._cursor = 0
+        self._epoch = None
+        self._sync()
+        self._thread = threading.Thread(target=self._upstream_loop,
+                                        daemon=True,
+                                        name=f"{name}-upstream")
+        self._thread.start()
+        self._server, self.url = _serve_transport(
+            self._dispatch, self._log, host=host, port=port,
+            stream_wire=stream_wire, on_subscribe=self._ensure_window,
+            role="proxy")
+
+    # ---- downstream: dispatch ---------------------------------------------
+
+    def _dispatch(self, method: str, parts: list, query: dict, body,
+                  peer: str):
+        """The proxy's admission + routing path, shaped exactly like
+        serve_api's: the replica's own APF front door first (so a
+        flooding tenant is shed HERE, its shard, not upstream), then
+        the local-or-forwarded route split."""
+        if self._apf is not None:
+            with self._apf.admit(method, parts, query, body, peer=peer):
+                return self._route(method, parts, query, body)
+        return self._route(method, parts, query, body)
+
+    def _route(self, method: str, parts: list, query: dict, body):
+        head = parts[0] if parts else ""
+        if parts == ["watch"]:
+            # long-poll resume may predate our window; the upstream's
+            # is deeper — backfill before the relist check can fire
+            self._ensure_window(int(query.get("since", 0)))
+            return _route_request(self._mirror, self._log, method,
+                                  parts, query, body)
+        if method == "GET" and head in LOCAL_ROUTES:
+            try:
+                return _route_request(self._mirror, self._log, method,
+                                      parts, query, body)
+            except NotFound:
+                if len(parts) >= 2:
+                    # a point-GET can race the mirror's replication
+                    # lag (object created upstream, event not yet
+                    # applied here): the source of truth gets the
+                    # final word before a client sees a false 404
+                    return self._forward(method, parts, query, body)
+                raise
+        if head in FORWARDED_ROUTES:
+            return self._forward(method, parts, query, body)
+        return 404, {"error": f"no route {method} /{'/'.join(parts)}"}
+
+    def _forward(self, method: str, parts: list, query: dict, body):
+        """One upstream round trip, hop-transparent: raw status in,
+        typed error re-raised out — the proxy's own transport then maps
+        it back to the identical status + error body (retry_after_s and
+        per_pod detail included), so a client cannot tell from an error
+        whether a hop was in the path."""
+        path = "/" + "/".join(parts)
+        if query:
+            path += "?" + "&".join(f"{k}={v}" for k, v in query.items())
+        out = self._upstream.forward(method, path, body)
+        status, doc = out
+        if status == 429:
+            raise HTTPAPIClient._server_error(TooManyRequests, doc)
+        if status == 403:
+            raise HTTPAPIClient._server_error(QuotaExceeded, doc)
+        if status == 404:
+            raise HTTPAPIClient._server_error(NotFound, doc)
+        if status == 409:
+            raise HTTPAPIClient._server_error(Conflict, doc)
+        return status, doc
+
+    def _ensure_window(self, since: int) -> None:
+        """Deepen the local window to cover ``since`` when the upstream
+        can replay it: a client migrating from the apiserver (or an
+        older proxy life) presents a cursor below our floor that is NOT
+        a real gap. On any upstream refusal — relist, epoch mismatch,
+        non-200 — do nothing: the pump then sends the same honest
+        relist the upstream gave us."""
+        if since <= 0 or since >= self._log.floor():
+            return
+        status, doc = self._upstream.forward(
+            "GET", f"/watch?since={since}&timeout=0")
+        if status != 200 or not isinstance(doc, dict) \
+                or doc.get("relist") or doc.get("epoch") != self._log.epoch:
+            return
+        self._log.backfill([tuple(ev) for ev in doc.get("events") or []],
+                           since)
+
+    # ---- upstream: the one subscription -----------------------------------
+
+    def _sync(self) -> None:
+        """Full resync: probe the upstream head, list every kind into a
+        fresh mirror, adopt the head seq + epoch. Lists happen AFTER
+        the head probe, so they may already include later writes —
+        replaying the stream from the probed head over them converges
+        (restore_object is an idempotent whole-object upsert, and a
+        delete of an absent object is tolerated)."""
+        status, doc = self._upstream.forward(
+            "GET", f"/watch?since={_HEAD_PROBE}&timeout=0")
+        if status != 200 or not isinstance(doc, dict):
+            raise ConnectionError(
+                f"upstream head probe answered HTTP {status}")
+        head, epoch = int(doc["seq"]), doc.get("epoch")
+        mirror = InMemoryAPIServer()
+        for kind, path in _MIRROR_LISTS:
+            status, listed = self._upstream.forward("GET", path)
+            if status != 200 or not isinstance(listed, dict):
+                raise ConnectionError(
+                    f"upstream list {path} answered HTTP {status}")
+            items = listed.get("items")
+            if kind == "quota":
+                for tenant, spec in (items or {}).items():
+                    mirror.restore_object(
+                        "quota", "added",
+                        {"metadata": {"name": tenant}, "spec": spec})
+            else:
+                for obj in items or []:
+                    mirror.restore_object(kind, "added", obj)
+        self._mirror = mirror
+        self._cursor = head
+        self._epoch = epoch
+        self._log.reset(head, epoch)
+        logger.info("proxy %s synced at upstream seq %d (epoch %s)",
+                    self.name, head, epoch)
+
+    def _apply(self, out: dict) -> bool:
+        """Apply one upstream watch batch: mirror first (a downstream
+        GET must never see an object the event log already announced),
+        then the local window, then the cursor. Returns False when the
+        upstream declared our cursor unreplayable (relist) or changed
+        identity (epoch) — the caller resyncs and resubscribes, and
+        every downstream watcher inherits the honest relist through
+        ``_EventLog.reset``."""
+        if out.get("relist") or out.get("epoch") != self._epoch \
+                or out["seq"] < self._cursor:
+            self._sync()
+            return False
+        ts = out.get("ts") or 0.0
+        if ts:
+            now = time.time()  # analysis: disable=monotonic-time -- cross-process push-lag stamp, like the pump's
+            metrics.PROXY_UPSTREAM_LAG_MS.observe(
+                max(0.0, (now - ts) * 1e3))
+        events = out.get("events") or []
+        for ev in events:
+            _seq, kind, event, obj = ev
+            self._mirror.restore_object(kind, event, obj)
+        self._log.ingest(events, out["seq"])
+        self._cursor = out["seq"]
+        metrics.PROXY_DOWNSTREAM_WATCHERS.labels(self.name).set(
+            self._log.stream_subscriber_count())
+        return True
+
+    def _upstream_loop(self) -> None:
+        obs.register_thread(f"{self.name}-upstream")
+        warned = False
+        while not self._stop.is_set():
+            conn = None
+            try:
+                try:
+                    conn = stream.StreamConn.connect(
+                        self.upstream_url, 10.0,
+                        label=stream.WIRE_PROXY)
+                except stream.StreamUnsupported:
+                    # upgrade-less upstream: the one subscription is a
+                    # JSON long-poll session instead, same contract
+                    self._json_poll_session()
+                    continue
+                with self._conn_lock:
+                    self._sub_conn = conn
+                ack = conn.subscribe(self._cursor, None,
+                                     self._upstream_batch_s,
+                                     timeout=10.0)
+                if ack.get("epoch") != self._epoch \
+                        or int(ack.get("seq") or 0) < self._cursor:
+                    # upstream restarted without durability (fresh
+                    # epoch / regressed seq space): everything we hold
+                    # is from another life
+                    self._sync()
+                    continue
+                warned = False
+                while not self._stop.is_set():
+                    out = conn.read_push(timeout=30.0)
+                    if out is None:
+                        continue  # liveness PING
+                    if not self._apply(out):
+                        break  # resynced; resubscribe at the new cursor
+            except (ConnectionError, OSError) as e:
+                if not self._stop.is_set() and not warned:
+                    warned = True
+                    logger.warning(
+                        "proxy %s upstream subscription lost (%s); "
+                        "reconnecting", self.name, e)
+                self._stop.wait(0.2)
+            finally:
+                with self._conn_lock:
+                    self._sub_conn = None
+                if conn is not None:
+                    conn.close()
+
+    def _json_poll_session(self) -> None:
+        """The negotiated-down upstream consumer: long-poll /watch and
+        feed batches through the same `_apply` path the stream wire
+        uses. Returns only on stop; transport faults propagate to the
+        outer loop's backoff."""
+        while not self._stop.is_set():
+            status, doc = self._upstream.forward(
+                "GET", f"/watch?since={self._cursor}&timeout=5")
+            if status != 200 or not isinstance(doc, dict):
+                raise ConnectionError(
+                    f"upstream watch poll answered HTTP {status}")
+            self._apply(doc)
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    @property
+    def event_log(self) -> _EventLog:
+        """The downstream window (encode-once accounting, fake
+        subscribers) — same attribute the apiserver's transport
+        exposes as ``server.event_log``."""
+        return self._log
+
+    def downstream_watchers(self) -> int:
+        return self._log.stream_subscriber_count()
+
+    def stop(self) -> None:
+        """Full teardown: downstream server (pump + subscriber writer
+        threads joined, sockets severed), upstream subscription, and
+        the upstream client's keep-alive sockets."""
+        self._stop.set()
+        self._server.shutdown()
+        with self._conn_lock:
+            conn = self._sub_conn
+        if conn is not None:
+            # wake the consumer blocked in read_push NOW, not at its
+            # 30 s timeout
+            conn.close()
+        self._thread.join(timeout=10.0)
+        self._upstream.close()

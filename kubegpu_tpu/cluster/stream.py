@@ -78,6 +78,10 @@ UPGRADE_PATH = "/stream"
 UPGRADE_TOKEN = "kgtpu-stream"
 WIRE_STREAM = "stream"
 WIRE_JSON = "json"
+# transport_bytes_total{wire} attribution for the proxy -> apiserver
+# hop (cluster/proxy.py): same framing as WIRE_STREAM, counted apart so
+# a fronted deployment's upstream leg is measurable on its own
+WIRE_PROXY = "proxy"
 
 
 class FrameError(ConnectionError):
@@ -103,7 +107,7 @@ def encode_frame(ftype: int, rid: int, payload: bytes) -> bytes:
                         zlib.crc32(payload)) + payload
 
 
-def read_frame(rfile: Any) -> Tuple[int, int, bytes]:
+def read_frame(rfile: Any, wire: str = WIRE_STREAM) -> Tuple[int, int, bytes]:
     """Read one frame off a buffered reader; raises :class:`StreamClosed`
     on clean EOF, :class:`FrameError` on anything torn or hostile."""
     probe("stream.read_frame")
@@ -122,24 +126,25 @@ def read_frame(rfile: Any) -> Tuple[int, int, bytes]:
         raise FrameError("truncated frame payload")
     if zlib.crc32(payload) != crc:
         raise FrameError("frame CRC mismatch")
-    metrics.TRANSPORT_BYTES.labels(WIRE_STREAM, "rx").inc(
+    metrics.TRANSPORT_BYTES.labels(wire, "rx").inc(
         _HEADER.size + length)
     return ftype, rid, payload
 
 
 def send_frame(sock: socket.socket, wlock: threading.Lock, ftype: int,
-               rid: int, payload: bytes) -> None:
+               rid: int, payload: bytes,
+               wire: str = WIRE_STREAM) -> None:
     """Write one frame atomically w.r.t. other writers on this socket
     (responses and pushes interleave on the server side)."""
-    send_raw(sock, wlock, encode_frame(ftype, rid, payload))
+    send_raw(sock, wlock, encode_frame(ftype, rid, payload), wire=wire)
 
 
 def send_raw(sock: socket.socket, wlock: threading.Lock,
-             data: bytes) -> None:
+             data: bytes, wire: str = WIRE_STREAM) -> None:
     probe("stream.send_frame")
     with wlock:
         sock.sendall(data)
-    metrics.TRANSPORT_BYTES.labels(WIRE_STREAM, "tx").inc(len(data))
+    metrics.TRANSPORT_BYTES.labels(wire, "tx").inc(len(data))
 
 
 def _timed(hist: Any, fn: Callable[..., Any], *args: Any) -> Any:
@@ -171,9 +176,14 @@ class StreamConn:
     surfaces as a ``ConnectionError`` for the caller's retry layer.
     """
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket,
+                 label: Optional[str] = None) -> None:
         self._sock = sock
         self._rfile = sock.makefile("rb")
+        # byte-attribution label for this connection's frames (the
+        # proxy's upstream leg counts as WIRE_PROXY, everything else as
+        # the stream wire it is)
+        self._label = label or WIRE_STREAM
         self._wlock = threading.Lock()
         # racer: single-writer -- a StreamConn serves one requesting
         # thread at a time (per-thread keep-alive contract)
@@ -183,7 +193,8 @@ class StreamConn:
         self.closed = False
 
     @classmethod
-    def connect(cls, base_url: str, timeout: float) -> "StreamConn":
+    def connect(cls, base_url: str, timeout: float,
+                label: Optional[str] = None) -> "StreamConn":
         """Dial + upgrade. Raises :class:`StreamUnsupported` when the
         server speaks only JSON HTTP (negotiated fallback), ordinary
         ``OSError``/``ConnectionError`` on real transport faults."""
@@ -206,7 +217,7 @@ class StreamConn:
         except BaseException:
             sock.close()
             raise
-        return cls(sock)
+        return cls(sock, label=label)
 
     def request(self, method: str, path: str, body: object,
                 timeout: float,
@@ -220,9 +231,11 @@ class StreamConn:
                          method, path, body, trace)
         try:
             self._sock.settimeout(timeout)
-            send_frame(self._sock, self._wlock, REQ, rid, payload)
+            send_frame(self._sock, self._wlock, REQ, rid, payload,
+                       wire=self._label)
             while True:
-                ftype, got_rid, data = read_frame(self._rfile)
+                ftype, got_rid, data = read_frame(self._rfile,
+                                                 wire=self._label)
                 if ftype == PING:
                     continue
                 if ftype == REJECT and got_rid == rid:
@@ -254,9 +267,11 @@ class StreamConn:
              "batch": batch_s})
         try:
             self._sock.settimeout(timeout)
-            send_frame(self._sock, self._wlock, SUB, rid, payload)
+            send_frame(self._sock, self._wlock, SUB, rid, payload,
+                       wire=self._label)
             while True:
-                ftype, got_rid, data = read_frame(self._rfile)
+                ftype, got_rid, data = read_frame(self._rfile,
+                                                 wire=self._label)
                 if ftype == PING:
                     continue
                 if ftype != RESP or got_rid != rid:
@@ -275,7 +290,8 @@ class StreamConn:
         ``ConnectionError`` after closing the connection."""
         try:
             self._sock.settimeout(timeout)
-            ftype, _rid, data = read_frame(self._rfile)
+            ftype, _rid, data = read_frame(self._rfile,
+                                            wire=self._label)
             if ftype == PING:
                 return None
             if ftype != PUSH:
@@ -289,6 +305,16 @@ class StreamConn:
 
     def close(self) -> None:
         self.closed = True
+        try:
+            # a reader blocked in recv() does NOT wake when another
+            # thread close()s the fd — it would sit there until the
+            # server's next liveness ping. shutdown() interrupts it NOW
+            # (EOF at the socket layer), which is what makes close()
+            # from a lifecycle path (client.close, proxy.stop) prompt
+            # instead of one ping period late.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             # the makefile reader holds an io-ref on the socket: without
             # closing it the OS fd survives sock.close() until GC — the

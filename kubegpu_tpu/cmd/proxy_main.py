@@ -1,0 +1,78 @@
+"""``kgtpu-proxy``: a watch-cache proxy replica fronting the apiserver.
+
+Holds ONE upstream stream subscription and re-serves thousands of
+downstream watchers from a local event window (cluster/proxy.py) —
+resume stays seq-exact through the proxy because the sequence space is
+the apiserver's own (WAL-continued), so clients migrate between a
+replica and the apiserver without a relist. Reads are answered from the
+mirrored store; writes forward upstream with typed errors intact. Run N
+replicas (shared-nothing) and point each client shard at one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kubegpu_tpu.cmd import common
+
+
+def main(argv=None) -> int:
+    # same rationale as the apiserver binary: a busy encode thread must
+    # not stall a reply for a whole default GIL window
+    sys.setswitchinterval(0.0005)
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--upstream", required=True,
+                        help="apiserver base URL this replica mirrors "
+                             "(e.g. http://127.0.0.1:8070)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8090)
+    parser.add_argument("--name", default="proxy",
+                        help="replica name: labels "
+                             "proxy_downstream_watchers and the "
+                             "upstream consumer thread")
+    parser.add_argument("--wire", choices=("stream", "json"),
+                        default="stream",
+                        help="downstream wire: stream (default) also "
+                             "serves the framed binary wire; json "
+                             "refuses upgrades. The upstream leg "
+                             "negotiates independently")
+    parser.add_argument("--window", type=int, default=10000,
+                        help="local event-window size (events); a "
+                             "resume below it backfills from the "
+                             "deeper upstream window")
+    parser.add_argument("--apf", action="store_true",
+                        help="per-replica priority-&-fairness front "
+                             "door: an abusive tenant saturates only "
+                             "this shard, system traffic stays exempt")
+    common.add_observability_flags(parser)
+    args = parser.parse_args(argv)
+
+    stop_obs = common.start_observability(args)
+    apf = None
+    if args.apf:
+        from kubegpu_tpu.cluster.apf import APFDispatcher
+
+        apf = APFDispatcher()
+    from kubegpu_tpu.cluster.proxy import WatchCacheProxy
+
+    proxy = WatchCacheProxy(args.upstream, name=args.name,
+                            host=args.host, port=args.port,
+                            apf=apf, limit=args.window,
+                            stream_wire=args.wire == "stream")
+    print(f"proxy {args.name} listening at {proxy.url} "
+          f"(upstream {args.upstream})"
+          + (" (APF front door on)" if apf else ""), flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    proxy.stop()
+    stop_obs()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

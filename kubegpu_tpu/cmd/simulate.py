@@ -158,6 +158,28 @@ def _apf_summary() -> dict:
             "quota_parked_total": metrics.QUOTA_PARKED.value}
 
 
+def _proxy_summary(replicas) -> dict:
+    """Watch-cache proxy tier health (cluster/proxy.py + metrics.py):
+    per-server request split (the flood-absorption evidence), live
+    downstream watcher counts, the upstream hop's push lag, and the
+    upstream leg's byte attribution (wire="proxy")."""
+    from kubegpu_tpu.cluster import stream
+
+    return {"api_requests_total": {
+                server: child.value for (server,), child
+                in metrics.API_REQUESTS.children()},
+            "downstream_watchers": {
+                r.name: r.downstream_watchers() for r in replicas},
+            "proxy_upstream_lag_p50_ms": round(
+                metrics.PROXY_UPSTREAM_LAG_MS.percentile(0.5), 3),
+            "proxy_upstream_lag_p99_ms": round(
+                metrics.PROXY_UPSTREAM_LAG_MS.percentile(0.99), 3),
+            "upstream_wire_bytes": {
+                dir_: child.value for (wire, dir_), child
+                in metrics.TRANSPORT_BYTES.children()
+                if wire == stream.WIRE_PROXY}}
+
+
 def _gang_chips(api, name):
     """Chip-id list a bound pod's allocation annotation pins — the raw
     persisted decision, read back via the codec's decode half."""
@@ -605,7 +627,9 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
                               flood_pace_s: float = 0.005,
                               p99_ratio_limit: float = 2.0,
                               deadline_s: float = 60.0,
-                              wire: str = "stream"):
+                              wire: str = "stream",
+                              proxies: int = 0,
+                              api_rate_ratio_limit: float = 1.5):
     """The ``tenant-flood`` chaos scenario: one abusive tenant floods
     pod creates through the priority-&-fairness front door while N
     well-behaved tenants churn 1-chip pods, heartbeats flow, a lease
@@ -625,8 +649,17 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
     * the flood never starved the front door shut for well-behaved
       tenants (their churn completed before the deadline).
 
+    With ``proxies`` > 0, shared-nothing watch-cache proxy replicas
+    (cluster/proxy.py) front the apiserver, each with its own APF front
+    door: tenants shard across replicas, lease renewals ride a proxy's
+    forwarded (exempt) path, and the abuser becomes a READ flood aimed
+    at one replica's mirror — the flood must be absorbed entirely at
+    the proxy tier, so the apiserver-side request rate under flood is
+    asserted flat vs quiet (within ``api_rate_ratio_limit``) and the
+    fair-share/parking checks (create-flood mechanics) don't apply.
+
     Returns the accounting: per-phase p99s, flood counts, front-door
-    and quota summaries."""
+    and quota summaries (plus a proxy-tier summary when fronted)."""
     import threading
 
     from kubegpu_tpu.cluster.apf import (APFDispatcher, BandConfig,
@@ -641,16 +674,32 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
     api = InMemoryAPIServer()
     # a deliberately tight workload band: the flood must queue and shed
     # there while system traffic bypasses the front door entirely
+    workload_band = dict(seats=6, queues=16, queue_len=16,
+                         queue_wait_s=0.5, hand=4)
     apf = APFDispatcher(bands={
-        BAND_WORKLOAD: BandConfig(seats=6, queues=16, queue_len=16,
-                                  queue_wait_s=0.5, hand=4)})
+        BAND_WORKLOAD: BandConfig(**workload_band)})
     server, url = serve_api(api, apf=apf)
     admin = HTTPAPIClient(url, wire=wire)
     mgrs = []
     advs = []
     closers = []
+    replicas: list = []
     elector = lifecycle = sched = None
     try:
+        if proxies > 0:
+            from kubegpu_tpu.cluster.proxy import WatchCacheProxy
+
+            # each replica carries its OWN front door: a flooding
+            # tenant saturates the shard it hashes to, nothing else
+            replicas = [
+                WatchCacheProxy(url, name=f"proxy{i}",
+                                apf=APFDispatcher(bands={
+                                    BAND_WORKLOAD:
+                                        BandConfig(**workload_band)}))
+                for i in range(proxies)]
+
+        def shard_url(i: int) -> str:
+            return replicas[i % len(replicas)].url if replicas else url
         origins = [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)]
         for i, origin in enumerate(origins):
             name = f"host{i}"
@@ -686,7 +735,11 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
                                   lost_after_s=2.0)
         lifecycle.start(interval_s=0.1)
 
-        lease_client = HTTPAPIClient(url, wire=wire)
+        # lease renewals go THROUGH a proxy replica when fronted: the
+        # forwarded path must keep them on the exempt system band at
+        # both hops, or the flood scenario's zero-lease-loss invariant
+        # breaks exactly here
+        lease_client = HTTPAPIClient(shard_url(1), wire=wire)
         closers.append(lease_client)
         elector = Elector(lease_client.acquire_lease, "flood-lease",
                           "survivor", ttl_s=0.6)
@@ -714,12 +767,14 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
 
         tenant_names = [f"tenant-{i}" for i in range(tenants)]
 
-        def churn(tenant, phase, latencies, errors):
+        def churn(idx, tenant, phase, latencies, errors):
             """One well-behaved tenant: sequential create -> bound ->
             delete churn, honoring any front-door retry-after like a
             good citizen. Latency is the full user-visible
-            create->bound span, throttle waits included."""
-            client = HTTPAPIClient(url, wire=wire)
+            create->bound span, throttle waits included. Fronted,
+            each tenant talks to its shard's proxy replica — writes
+            forward upstream, watches and reads are the replica's."""
+            client = HTTPAPIClient(shard_url(idx), wire=wire)
             try:
                 for k in range(churn_pods):
                     pname = f"{tenant}-{phase}-{k}"
@@ -760,10 +815,10 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
             latencies: list = []
             errors: list = []
             threads = [threading.Thread(target=churn,
-                                        args=(t, phase, latencies,
+                                        args=(i, t, phase, latencies,
                                               errors),
                                         daemon=True)
-                       for t in tenant_names]
+                       for i, t in enumerate(tenant_names)]
             for t in threads:
                 t.start()
             for t in threads:
@@ -785,21 +840,39 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
             s = sorted(lat)
             return s[int(0.99 * (len(s) - 1))] * 1e3
 
+        # apiserver-side request rate per phase: the proxy variant's
+        # headline invariant is that this stays FLAT under flood (the
+        # read flood is absorbed at a replica's mirror)
+        apiserver_reqs = metrics.API_REQUESTS.labels("apiserver")
+
+        quiet_reqs0 = apiserver_reqs.value
+        quiet_t0 = time.perf_counter()
         quiet_lat = run_phase("quiet")
+        quiet_req_rate = (apiserver_reqs.value - quiet_reqs0) / \
+            max(time.perf_counter() - quiet_t0, 1e-9)
 
         lease_transitions_before = elector.transitions
         node_lost_before = metrics.NODE_LOST.value
         evicted_before = lifecycle.evicted_total
         quota_parked_before = metrics.QUOTA_PARKED.value
 
+        # fronted: the abuser aims a READ flood at ONE replica (its
+        # shard) — reads are served from that replica's mirror, so the
+        # apiserver must not see the flood at all. Direct: the original
+        # create flood against the apiserver's own front door.
         flood = TenantFlood(
-            lambda: HTTPAPIClient(url, wire=wire),
+            lambda: HTTPAPIClient(shard_url(0), wire=wire),
             tenant="abuser", threads=flood_threads,
-            pace_s=flood_pace_s).start()
+            pace_s=flood_pace_s,
+            mode="read" if replicas else "mutate").start()
+        flood_reqs0 = apiserver_reqs.value
+        flood_t0 = time.perf_counter()
         try:
             flood_lat = run_phase("flood")
         finally:
             flood_counts = flood.stop()
+        flood_req_rate = (apiserver_reqs.value - flood_reqs0) / \
+            max(time.perf_counter() - flood_t0, 1e-9)
 
         quiet_p99 = p99(quiet_lat)
         flood_p99 = p99(flood_lat)
@@ -842,28 +915,52 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
                 f"({sched_client.relist_count} relist(s))")
         quota_parked_during = \
             metrics.QUOTA_PARKED.value - quota_parked_before
-        if gate.parked_count() == 0 and quota_parked_during == 0:
-            # the DELTA, not the process-global counter: earlier runs
-            # in the same process must not mask a no-op gate
-            failures.append("DRF gate never engaged against the flood")
-        if abuser_bound > fair_chips + 1:
-            failures.append(
-                f"abuser bound {abuser_bound} chips, over its fair "
-                f"share of {fair_chips:.1f}")
+        if replicas:
+            # read-flood mechanics: the DRF gate never sees the abuser
+            # (nothing is created), so the invariant moves to the hop —
+            # the apiserver's request rate must stay flat while the
+            # replica absorbs the flood from its mirror
+            if flood_counts["accepted"] + flood_counts["rejected"] == 0:
+                failures.append("read flood never engaged the proxy "
+                                "tier")
+            rate_ratio = flood_req_rate / quiet_req_rate \
+                if quiet_req_rate > 0 else 0.0
+            if rate_ratio > api_rate_ratio_limit:
+                failures.append(
+                    f"apiserver request rate rose {rate_ratio:.2f}x "
+                    f"under flood ({quiet_req_rate:.0f} -> "
+                    f"{flood_req_rate:.0f} req/s, limit "
+                    f"{api_rate_ratio_limit}x): the flood leaked "
+                    f"through the proxy tier")
+        else:
+            if gate.parked_count() == 0 and quota_parked_during == 0:
+                # the DELTA, not the process-global counter: earlier
+                # runs in the same process must not mask a no-op gate
+                failures.append(
+                    "DRF gate never engaged against the flood")
+            if abuser_bound > fair_chips + 1:
+                failures.append(
+                    f"abuser bound {abuser_bound} chips, over its fair "
+                    f"share of {fair_chips:.1f}")
         if failures:
             raise RuntimeError("tenant-flood invariants violated: "
                                + "; ".join(failures))
-        return {"wellbehaved_quiet_p99_ms": round(quiet_p99, 2),
-                "wellbehaved_flood_p99_ms": round(flood_p99, 2),
-                "p99_ratio": round(ratio, 2),
-                "flood": flood_counts,
-                "abuser_bound_chips": abuser_bound,
-                "abuser_fair_chips": round(fair_chips, 1),
-                "quota_parked": quota_parked_during,
-                "front_door": front_door,
-                "lease_transitions": elector.transitions,
-                "watch_relists": sched_client.relist_count,
-                "evictions": lifecycle.evicted_total}
+        out = {"wellbehaved_quiet_p99_ms": round(quiet_p99, 2),
+               "wellbehaved_flood_p99_ms": round(flood_p99, 2),
+               "p99_ratio": round(ratio, 2),
+               "flood": flood_counts,
+               "abuser_bound_chips": abuser_bound,
+               "abuser_fair_chips": round(fair_chips, 1),
+               "quota_parked": quota_parked_during,
+               "front_door": front_door,
+               "lease_transitions": elector.transitions,
+               "watch_relists": sched_client.relist_count,
+               "evictions": lifecycle.evicted_total,
+               "apiserver_quiet_req_per_s": round(quiet_req_rate, 1),
+               "apiserver_flood_req_per_s": round(flood_req_rate, 1)}
+        if replicas:
+            out["proxies"] = _proxy_summary(replicas)
+        return out
     finally:
         if elector is not None:
             elector.stop()
@@ -876,6 +973,8 @@ def run_tenant_flood_scenario(tenants: int = 3, churn_pods: int = 12,
         for closer in closers:
             closer.close()
         admin.close()
+        for replica in replicas:
+            replica.stop()
         server.shutdown()
 
 
@@ -906,6 +1005,15 @@ def main(argv=None) -> int:
                              "well-behaved tenants churn; asserts p99 "
                              "isolation, zero lease losses, zero "
                              "heartbeat evictions")
+    parser.add_argument("--proxies", type=int, default=0,
+                        help="front the apiserver with N shared-nothing "
+                             "watch-cache proxy replicas "
+                             "(cluster/proxy.py), each with its own APF "
+                             "front door; tenants shard across them. "
+                             "With --chaos-tenant-flood the abuser "
+                             "becomes a read flood against one replica "
+                             "and the apiserver-side request rate is "
+                             "asserted flat")
     parser.add_argument("--seed", type=int, default=0,
                         help="chaos transport seed")
     parser.add_argument("--wire", choices=("stream", "json"),
@@ -966,10 +1074,23 @@ def _run_simulation(args) -> int:
         return 0
 
     if args.chaos_tenant_flood:
-        result = run_tenant_flood_scenario(wire=args.wire)
+        result = run_tenant_flood_scenario(wire=args.wire,
+                                           proxies=args.proxies)
         result["wire_protocol"] = args.wire
         if args.json:
             print(json.dumps(result, indent=2))
+        elif args.proxies:
+            print(f"tenant flood ({args.proxies} proxies): well-behaved "
+                  f"p99 {result['wellbehaved_quiet_p99_ms']} -> "
+                  f"{result['wellbehaved_flood_p99_ms']} ms "
+                  f"({result['p99_ratio']}x) while the abuser's read "
+                  f"flood ({result['flood']['accepted']} served / "
+                  f"{result['flood']['rejected']} shed) was absorbed "
+                  f"at the proxy tier — apiserver "
+                  f"{result['apiserver_quiet_req_per_s']} -> "
+                  f"{result['apiserver_flood_req_per_s']} req/s; "
+                  f"0 lease losses, 0 evictions; proxies="
+                  f"{result['proxies']}")
         else:
             print(f"tenant flood: well-behaved p99 "
                   f"{result['wellbehaved_quiet_p99_ms']} -> "

@@ -330,6 +330,19 @@ TRANSPORT_BYTES = LabeledCounter("transport_bytes_total", ("wire", "dir"))
 FRAME_ENCODE_MS = Histogram("frame_encode_ms", start_us=0.002)
 FRAME_DECODE_MS = Histogram("frame_decode_ms", start_us=0.002)
 WATCH_PUSH_LAG_MS = Histogram("watch_push_lag_ms", start_us=0.01)
+# Watch-cache proxy tier (cluster/proxy.py): api_requests_total{server}
+# counts requests each transport role dispatched ("apiserver" vs
+# "proxy") — the tenant-flood --proxies assertion that the apiserver's
+# rate stays flat while the flood lands on the proxy tier reads exactly
+# this split. proxy_downstream_watchers{proxy} is each replica's live
+# downstream subscriber count; proxy_upstream_lag_ms is the upstream
+# hop (apiserver batch-encode stamp -> proxy ingest), kept separate
+# from watch_push_lag_ms so the downstream fan-out cost stays
+# comparable between direct and proxied paths. The proxy's own
+# upstream traffic shows in transport_bytes_total{wire="proxy"}.
+API_REQUESTS = LabeledCounter("api_requests_total", ("server",))
+PROXY_DOWNSTREAM_WATCHERS = LabeledGauge("proxy_downstream_watchers", "proxy")
+PROXY_UPSTREAM_LAG_MS = Histogram("proxy_upstream_lag_ms", start_us=0.01)
 # Multi-tenant front door (cluster/apf.py + scheduler/quota.py):
 # apf_queue_wait_ms is how long admitted requests waited for a band
 # seat; apf_rejects_total{band} counts requests shed with a typed 429 /

@@ -3,8 +3,9 @@
 One module modeling both ends of a dual-wire transport: a route table
 (`_route_request`), a client (`_req` calls), a framed-stream layer
 (`_FRAME_TYPES` + send/dispatch), a tagged codec (`_T_*`), and the
-typed-error maps of two dispatch sites. Each surface is broken on
-exactly one side."""
+typed-error maps of two dispatch sites — plus a proxy hop (forward
+tables + ``_forward``) re-serving the client surface. Each surface is
+broken on exactly one side."""
 
 
 class NotFound(Exception):
@@ -145,3 +146,22 @@ class Client:
     def list_frobs(self):
         # no server route serves /frobs on either wire
         return self._req("GET", "/frobs")["items"]
+
+
+# ---- forward tables: /pods — the one route BOTH ends agree on — is in
+# ---- neither table, so the hop 404s it; and _forward drops the
+# ---- flow-control re-raise --------------------------------------------------
+
+LOCAL_ROUTES = frozenset({"watch"})
+FORWARDED_ROUTES = frozenset({"frobs"})
+
+
+def _forward(upstream, method, path, body):
+    status, doc = upstream(method, path, body)
+    if status == 404:
+        raise NotFound(doc.get("error"))
+    if status == 409:
+        raise Conflict(doc.get("error"))
+    # MISSING: TooManyRequests from 429 — upstream flow control
+    # degrades to a generic failure crossing the hop
+    return status, doc

@@ -138,3 +138,21 @@ class Client:
 
     def create_pod(self, pod):
         return self._req("POST", "/pods", pod)
+
+
+# ---- forward tables: the hop covers the whole client surface and
+# ---- _forward re-raises exactly the origin's typed-error set ---------------
+
+LOCAL_ROUTES = frozenset({"watch"})
+FORWARDED_ROUTES = frozenset({"pods"})
+
+
+def _forward(upstream, method, path, body):
+    status, doc = upstream(method, path, body)
+    if status == 429:
+        raise TooManyRequests(doc.get("error"))
+    if status == 404:
+        raise NotFound(doc.get("error"))
+    if status == 409:
+        raise Conflict(doc.get("error"))
+    return status, doc

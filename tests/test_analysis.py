@@ -170,7 +170,11 @@ def test_wire_contract_flags_each_one_sided_surface():
     # error-detail key the server writes but no client reads (the
     # retry-after bug class)
     assert "'retry_after_s' is written by _error_body()" in msgs
-    assert len(hits) == 7
+    # the proxy hop: /pods lands in neither forward table, and
+    # _forward() drops the flow-control re-raise
+    assert "a hole in the hop" in msgs
+    assert "never re-raises TooManyRequests from 429" in msgs
+    assert len(hits) == 9
 
 
 def test_wire_contract_good_twin_is_clean():

@@ -1,0 +1,269 @@
+"""Watch-cache proxy tier (cluster/proxy.py): seq-exact resume through
+the proxy in every direction a client can migrate — across a proxy
+restart, across a WAL apiserver restart behind a live proxy, and
+between a proxy replica and the apiserver — plus the hop-transparency
+and fault-isolation contracts (typed errors verbatim through the hop;
+a poisoned downstream connection never severs the upstream
+subscription)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubegpu_tpu.cluster import stream
+from kubegpu_tpu.cluster.apiserver import InMemoryAPIServer, NotFound
+from kubegpu_tpu.cluster.httpapi import HTTPAPIClient, serve_api
+from kubegpu_tpu.cluster.proxy import WatchCacheProxy
+from kubegpu_tpu.cluster.wal import WriteAheadLog
+
+
+def _wait_for(pred, timeout_s: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture()
+def upstream():
+    api = InMemoryAPIServer()
+    server, url = serve_api(api)
+    try:
+        yield api, url
+    finally:
+        server.shutdown()
+
+
+def test_reads_watch_and_forwarded_writes_through_proxy(upstream):
+    """The basic tier contract: writes forward upstream, reads answer
+    from the mirror, the watch stream re-serves the UPSTREAM sequence
+    space (zero relists), and a typed error crosses the hop with its
+    text intact — a client cannot tell the proxy was in the path."""
+    api, url = upstream
+    proxy = WatchCacheProxy(url, name="basic")
+    client = HTTPAPIClient(proxy.url, wire="stream")
+    direct = HTTPAPIClient(url, wire="stream")
+    seen: list = []
+    client.add_watcher(
+        lambda k, e, o: seen.append((e, o["metadata"]["name"])))
+    try:
+        client.create_pod({"metadata": {"name": "p1"}})
+        # the write went to the SOURCE OF TRUTH, not some proxy store
+        assert api.get_pod("p1") is not None
+        assert _wait_for(lambda: ("added", "p1") in seen)
+        assert client.get_pod("p1")["metadata"]["name"] == "p1"
+        assert client.relist_count == 0
+        # typed-error parity: same exception, same message, through the
+        # hop as straight at the apiserver
+        with pytest.raises(NotFound) as via_proxy:
+            client.get_pod("nope")
+        with pytest.raises(NotFound) as via_direct:
+            direct.get_pod("nope")
+        assert str(via_proxy.value) == str(via_direct.value)
+    finally:
+        client.close()
+        direct.close()
+        proxy.stop()
+
+
+def test_resume_is_seq_exact_across_proxy_restart(upstream):
+    """A proxy replica dying is a non-event for its watchers: the
+    replacement (same address) syncs to the SAME upstream sequence
+    space, so the reconnecting client resumes at its cursor — every
+    event exactly once, zero relists."""
+    api, url = upstream
+    proxy = WatchCacheProxy(url, name="restarted")
+    port = int(proxy.url.rsplit(":", 1)[1])
+    client = HTTPAPIClient(proxy.url, wire="stream")
+    seen: list = []
+    client.add_watcher(
+        lambda k, e, o: seen.append((e, o["metadata"]["name"])))
+    try:
+        api.create_pod({"metadata": {"name": "before"}})
+        assert _wait_for(lambda: ("added", "before") in seen)
+        proxy.stop()
+        # the gap write lands while NO proxy is serving: the replacement
+        # must carry it to the resuming client from its own window
+        api.create_pod({"metadata": {"name": "gap"}})
+        proxy = WatchCacheProxy(url, name="restarted2", port=port)
+        api.create_pod({"metadata": {"name": "after"}})
+        assert _wait_for(lambda: ("added", "after") in seen)
+        assert seen.count(("added", "gap")) == 1
+        assert seen.count(("added", "before")) == 1
+        assert seen.count(("added", "after")) == 1
+        assert client.relist_count == 0
+        assert client.wire == "stream"
+    finally:
+        client.close()
+        proxy.stop()
+
+
+def test_resume_across_wal_apiserver_restart_behind_live_proxy(tmp_path):
+    """The upstream leg honors the WAL durability contract: an
+    apiserver restart severs the proxy's ONE subscription; the proxy
+    reconnects, the recovered (WAL-continued) sequence space lets it
+    resubscribe at its cursor, and the downstream watcher — whose own
+    connection never dropped — sees the gap served seq-exact. Zero
+    relists anywhere."""
+    api = InMemoryAPIServer()
+    wal = WriteAheadLog(str(tmp_path), fsync=False)
+    server, url = serve_api(api, wal=wal)
+    port = int(url.rsplit(":", 1)[1])
+    proxy = WatchCacheProxy(url, name="over-wal")
+    client = HTTPAPIClient(proxy.url, wire="stream")
+    seen: list = []
+    client.add_watcher(
+        lambda k, e, o: seen.append((e, o["metadata"]["name"])))
+    try:
+        api.create_pod({"metadata": {"name": "before"}})
+        assert _wait_for(lambda: ("added", "before") in seen)
+        server.shutdown()
+        server.server_close()
+        wal.close()
+        api2 = InMemoryAPIServer()
+        wal = WriteAheadLog(str(tmp_path), fsync=False)
+        server, _ = serve_api(api2, port=port, wal=wal)
+        api2.create_pod({"metadata": {"name": "after"}})
+        assert _wait_for(lambda: ("added", "after") in seen, 15.0)
+        assert seen.count(("added", "before")) == 1
+        assert seen.count(("added", "after")) == 1
+        assert client.relist_count == 0
+    finally:
+        client.close()
+        proxy.stop()
+        server.shutdown()
+        server.server_close()
+        wal.close()
+
+
+def test_migration_between_apiserver_and_proxy_is_seq_exact(upstream):
+    """The global-sequence-space payoff, both directions on the raw
+    wire: a watcher carries its cursor apiserver -> proxy (backfilled
+    below the proxy's own floor from the deeper upstream window) and
+    proxy -> apiserver, and every hop resumes seq-exact — no relist
+    frame is ever pushed."""
+    api, url = upstream
+
+    def pushes_until(conn, want: str, timeout_s: float = 10.0):
+        """Read pushes until `want` arrives; returns (names, last_seq,
+        any_relist)."""
+        names: list = []
+        relist = False
+        seq = 0
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and want not in names:
+            out = conn.read_push(timeout=2.0)
+            if out is None:
+                continue
+            relist = relist or bool(out.get("relist"))
+            seq = out["seq"]
+            names.extend(o["metadata"]["name"]
+                         for _s, k, _e, o in out["events"] if k == "pod")
+        assert want in names, f"never saw {want}, got {names}"
+        return names, seq, relist
+
+    direct = stream.StreamConn.connect(url, 10.0)
+    ack = direct.subscribe(0, None, 0.0, timeout=10.0)
+    epoch = ack["epoch"]
+    api.create_pod({"metadata": {"name": "p0"}})
+    _, cursor, relist = pushes_until(direct, "p0")
+    assert not relist
+    direct.close()
+    # proxy created AFTER p0: its window floor is the sync head, so the
+    # migrating cursor is BELOW the proxy's floor — only the upstream
+    # backfill makes this resume instead of relist
+    proxy = WatchCacheProxy(url, name="migrate")
+    api.create_pod({"metadata": {"name": "p1"}})
+    via_proxy = stream.StreamConn.connect(proxy.url, 10.0)
+    ack = via_proxy.subscribe(cursor, None, 0.0, timeout=10.0)
+    assert ack["epoch"] == epoch  # same stream identity through the hop
+    names, cursor, relist = pushes_until(via_proxy, "p1")
+    assert not relist
+    assert "p0" not in names  # seq-exact: no replay of delivered events
+    via_proxy.close()
+    # migrate BACK to the apiserver at the proxy-advanced cursor
+    api.create_pod({"metadata": {"name": "p2"}})
+    direct = stream.StreamConn.connect(url, 10.0)
+    ack = direct.subscribe(cursor, None, 0.0, timeout=10.0)
+    assert ack["epoch"] == epoch
+    names, _, relist = pushes_until(direct, "p2")
+    assert not relist
+    assert "p1" not in names
+    direct.close()
+    proxy.stop()
+
+
+def test_torn_downstream_frame_never_severs_upstream(upstream):
+    """Fault isolation: a downstream client writing garbage onto its
+    framed connection poisons THAT connection only — the transport
+    severs it, the healthy subscriber keeps receiving, and the proxy's
+    one upstream subscription never notices."""
+    api, url = upstream
+    proxy = WatchCacheProxy(url, name="fuzzed")
+    healthy = stream.StreamConn.connect(proxy.url, 10.0)
+    healthy.subscribe(0, None, 0.0, timeout=10.0)
+    poisoned = stream.StreamConn.connect(proxy.url, 10.0)
+    poisoned.subscribe(0, None, 0.0, timeout=10.0)
+    try:
+        assert _wait_for(lambda: proxy.downstream_watchers() == 2)
+        # torn frame: a valid-looking header would also do, but raw
+        # garbage is the worst case the framing layer must contain
+        poisoned._sock.sendall(b"\xde\xad\xbe\xef" * 8)
+        assert _wait_for(lambda: proxy.downstream_watchers() == 1), \
+            "poisoned connection was never severed"
+        # the healthy subscriber still gets pushes end to end — which
+        # also proves the upstream subscription survived
+        api.create_pod({"metadata": {"name": "alive"}})
+        deadline = time.monotonic() + 10.0
+        got: list = []
+        while time.monotonic() < deadline and "alive" not in got:
+            out = healthy.read_push(timeout=2.0)
+            if out:
+                assert not out.get("relist")
+                got.extend(o["metadata"]["name"]
+                           for _s, k, _e, o in out["events"])
+        assert "alive" in got
+        # the poisoned side is dead, not wedged: its next read faults
+        with pytest.raises(ConnectionError):
+            for _ in range(10):
+                poisoned.read_push(timeout=2.0)
+    finally:
+        healthy.close()
+        poisoned.close()
+        proxy.stop()
+
+
+def test_fanout_dedups_identical_filtered_windows():
+    """Satellite of the proxy tier's encode-once economics: cohorts
+    with DIFFERENT (kinds, cursor) keys whose filtered windows contain
+    the same events must share one encode — the signature cache keys
+    the frame by the events actually delivered, so steady-state fan-out
+    encodes once TOTAL, not once per cursor cohort."""
+    from kubegpu_tpu.cluster.httpapi import _EventLog
+
+    api = InMemoryAPIServer()
+    log = _EventLog(api)
+    api.create_node({"metadata": {"name": "n1"}})  # seq 1
+    frames_a: list = []
+    frames_b: list = []
+    frames_c: list = []
+    # a: pod-filtered from 0 (straddles the node event, filtered out)
+    log.add_stream_subscriber(frames_a.append, since=0, kinds=("pod",),
+                              threaded=False)
+    # b: pod-filtered from seq 1 — different cursor, same filtered window
+    log.add_stream_subscriber(frames_b.append, since=log.seq(),
+                              kinds=("pod",), threaded=False)
+    # c: unfiltered from seq 1 — same window again via a different kinds
+    log.add_stream_subscriber(frames_c.append, since=log.seq(),
+                              threaded=False)
+    e0, d0 = log.stream_encodes, log.stream_deliveries
+    api.create_pod({"metadata": {"name": "p1"}})
+    assert log.pump_once() == 3
+    assert log.stream_deliveries - d0 == 3
+    assert log.stream_encodes - e0 == 1, \
+        "identical filtered windows were re-encoded per cohort"
+    assert frames_a == frames_b == frames_c  # byte-identical frames
